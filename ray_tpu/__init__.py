@@ -1,0 +1,211 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+Public core API with the surface of the reference's ``python/ray``
+(``ray.init/remote/get/put/wait/kill`` — ``python/ray/_private/worker.py:1031,
+2222,2335,2391``) over a head runtime that fuses GCS + raylet + object
+directory, with **TPU as a first-class resource** (``num_tpus=``), and an
+AIR-style toolkit (``ray_tpu.train/tune/data/serve/rllib``) rebuilt
+TPU-first on jax/XLA/pjit/pallas.
+
+Subpackages are imported lazily so that the core never drags in jax — a
+worker process only pays for what its tasks use.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+from ray_tpu import exceptions
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import global_worker
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context
+
+__version__ = "0.1.0"
+
+_init_lock = threading.Lock()
+
+
+def init(
+    num_cpus: Optional[int] = None,
+    num_tpus: Optional[int] = None,
+    resources: Optional[Dict[str, float]] = None,
+    ignore_reinit_error: bool = True,
+    **_kwargs,
+) -> None:
+    """Start the head runtime in this process and connect as the driver.
+
+    Analog of ``ray.init`` head-node bootstrap (reference ``worker.py:1031`` →
+    ``node.py:1083 start_ray_processes``): here GCS/raylet/object directory
+    run as threads of the driver process and workers are spawned on demand.
+    """
+    from ray_tpu._private.client import CoreClient
+    from ray_tpu._private.node import Node
+
+    with _init_lock:
+        if global_worker.connected:
+            if ignore_reinit_error:
+                return
+            raise RuntimeError("ray_tpu.init() called twice")
+        node = Node(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
+        client = CoreClient(node.address, node.authkey)
+        client.register_client()
+        global_worker.mode = "driver"
+        global_worker.node = node
+        global_worker.client = client
+        global_worker.node_id = node._head_node_id
+        atexit.register(shutdown)
+
+
+def is_initialized() -> bool:
+    return global_worker.connected
+
+
+def shutdown() -> None:
+    with _init_lock:
+        if not global_worker.connected:
+            return
+        try:
+            global_worker.client.close()
+        except Exception:
+            pass
+        if global_worker.node is not None:
+            global_worker.node.shutdown()
+        global_worker.client = None
+        global_worker.node = None
+        global_worker.mode = None
+        global_worker.function_cache.clear()
+        global_worker.registered_fn_ids.clear()
+
+
+def remote(*args, **kwargs):
+    """``@remote`` decorator for tasks and actors (``ray.remote`` analog).
+
+    Supports ``@remote``, ``@remote(num_cpus=..., num_tpus=..., ...)`` on
+    functions and classes.
+    """
+    if len(args) == 1 and not kwargs and (callable(args[0]) or isinstance(args[0], type)):
+        return _make_remote(args[0], {})
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_tpus=1)")
+
+    def decorator(fn_or_cls):
+        return _make_remote(fn_or_cls, kwargs)
+
+    return decorator
+
+
+def _make_remote(fn_or_cls, options):
+    if isinstance(fn_or_cls, type):
+        return ActorClass(fn_or_cls, options)
+    return RemoteFunction(fn_or_cls, options)
+
+
+def put(value: Any) -> ObjectRef:
+    _ensure_connected()
+    return global_worker.put(value)
+
+
+def get(refs: Union[ObjectRef, Sequence[ObjectRef]], *, timeout: Optional[float] = None):
+    _ensure_connected()
+    if isinstance(refs, ObjectRef):
+        return global_worker.get([refs], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() takes an ObjectRef or a list of them, got {type(refs)}")
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() list elements must be ObjectRefs, got {type(r)}")
+    return global_worker.get(list(refs), timeout=timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: Optional[float] = None,
+) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+    _ensure_connected()
+    if num_returns > len(refs):
+        raise ValueError("num_returns cannot exceed the number of refs")
+    return global_worker.wait(list(refs), num_returns, timeout)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    _ensure_connected()
+    global_worker.client.kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def cluster_resources() -> Dict[str, float]:
+    _ensure_connected()
+    snap = global_worker.client.state_snapshot()
+    totals: Dict[str, float] = {}
+    for res in snap["cluster_resources"].values():
+        for k, v in res.items():
+            totals[k] = totals.get(k, 0.0) + v
+    return totals
+
+
+def available_resources() -> Dict[str, float]:
+    _ensure_connected()
+    snap = global_worker.client.state_snapshot()
+    totals: Dict[str, float] = {}
+    for res in snap["available_resources"].values():
+        for k, v in res.items():
+            totals[k] = totals.get(k, 0.0) + v
+    return totals
+
+
+def nodes() -> List[dict]:
+    _ensure_connected()
+    snap = global_worker.client.state_snapshot()
+    return [
+        {"NodeID": n.node_id, "Alive": n.alive, "Resources": n.resources}
+        for n in snap["nodes"]
+    ]
+
+
+def _ensure_connected() -> None:
+    if not global_worker.connected:
+        init()
+
+
+# Convenience re-exports matching the reference's layout.
+from ray_tpu.util.placement_group import (  # noqa: E402
+    placement_group,
+    remove_placement_group,
+)
+
+__all__ = [
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "RemoteFunction",
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "put",
+    "get",
+    "wait",
+    "kill",
+    "get_actor",
+    "get_runtime_context",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "placement_group",
+    "remove_placement_group",
+    "exceptions",
+]
+
+
+def __getattr__(name):
+    # Lazy AIR-style subpackages (no jax import unless used).
+    import importlib
+
+    if name in ("train", "tune", "data", "serve", "rllib", "air", "util", "models", "ops", "parallel", "cluster_utils", "experimental"):
+        return importlib.import_module(f"ray_tpu.{name}")
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -1,0 +1,157 @@
+"""CoreClient — every process's connection to the head runtime.
+
+Plays the role of the reference's ``CoreWorker`` RPC surface
+(``src/ray/core_worker/core_worker.h:249``): task submission, object
+get/put/wait, actor creation/calls, KV access for function shipping.  Both
+the driver and each worker hold one; replies are routed to blocked callers
+by request id (the client-call manager pattern of ``src/ray/rpc/client_call.h``).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from multiprocessing.connection import Client as MPClient
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private.object_store import ObjectLocation
+
+
+class CoreClient:
+    def __init__(self, address: str, authkey: bytes, worker_id: Optional[bytes] = None, node_id: str = ""):
+        self.conn = MPClient(address, family="AF_UNIX", authkey=authkey)
+        self.send_lock = threading.Lock()
+        self._req_ids = itertools.count(1)
+        self._pending: Dict[int, dict] = {}
+        self._pending_lock = threading.Lock()
+        self._exec_queue: "queue.Queue[dict]" = None  # set by worker loop
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.closed = False
+        self._recv_thread = threading.Thread(target=self._recv_loop, daemon=True, name="core-client-recv")
+        self._recv_thread.start()
+
+    # -- plumbing ----------------------------------------------------------
+    def send(self, msg: dict) -> None:
+        with self.send_lock:
+            self.conn.send(msg)
+
+    def _recv_loop(self) -> None:
+        while not self.closed:
+            try:
+                msg = self.conn.recv()
+            except (EOFError, OSError):
+                self.closed = True
+                # wake all waiters with a connection error
+                with self._pending_lock:
+                    for slot in self._pending.values():
+                        slot["reply"] = {"type": "reply", "error": "connection closed"}
+                        slot["event"].set()
+                if self._exec_queue is not None:
+                    self._exec_queue.put({"type": "exit"})
+                return
+            if msg.get("type") == "reply":
+                with self._pending_lock:
+                    slot = self._pending.pop(msg["req_id"], None)
+                if slot is not None:
+                    slot["reply"] = msg
+                    slot["event"].set()
+            elif self._exec_queue is not None:
+                self._exec_queue.put(msg)
+
+    def request(self, msg: dict, timeout: Optional[float] = None) -> dict:
+        req_id = next(self._req_ids)
+        msg["req_id"] = req_id
+        slot = {"event": threading.Event(), "reply": None}
+        with self._pending_lock:
+            self._pending[req_id] = slot
+        self.send(msg)
+        if not slot["event"].wait(timeout):
+            with self._pending_lock:
+                self._pending.pop(req_id, None)
+            raise TimeoutError("head did not reply")
+        reply = slot["reply"]
+        if reply.get("error"):
+            raise ConnectionError(reply["error"])
+        return reply
+
+    # -- API ---------------------------------------------------------------
+    def register_client(self) -> None:
+        self.send({"type": "register_client"})
+
+    def register_worker(self) -> None:
+        self.send({
+            "type": "register_worker",
+            "worker_id": self.worker_id.hex(),
+            "node_id": self.node_id,
+        })
+
+    def submit_task(self, spec: dict) -> None:
+        self.send({"type": "submit_task", "spec": spec})
+
+    def create_actor(self, spec: dict) -> None:
+        self.send({"type": "create_actor", "spec": spec})
+
+    def submit_actor_task(self, spec: dict) -> None:
+        self.send({"type": "submit_actor_task", "spec": spec})
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
+        self.send({"type": "kill_actor", "actor_id": actor_id, "no_restart": no_restart})
+
+    def seal(self, oid: bytes, loc: ObjectLocation, contained: List[bytes]) -> None:
+        self.send({"type": "seal", "oid": oid, "loc": loc, "contained": contained})
+
+    def get_locations(
+        self, oids: List[bytes], timeout: Optional[float] = None
+    ) -> Optional[Dict[bytes, ObjectLocation]]:
+        """Blocks until all oids are sealed (or timeout -> None)."""
+        reply = self.request({"type": "get_locations", "oids": oids, "timeout": timeout})
+        if reply.get("timeout"):
+            return None
+        return reply["locations"]
+
+    def wait(
+        self, oids: List[bytes], num_returns: int, timeout: Optional[float]
+    ) -> Tuple[List[bytes], Dict[bytes, ObjectLocation]]:
+        reply = self.request({
+            "type": "wait", "oids": oids, "num_returns": num_returns, "timeout": timeout,
+        })
+        return reply["ready"], reply["locations"]
+
+    def kv_put(self, ns: str, key: bytes, value: bytes) -> None:
+        self.send({"type": "kv_put", "ns": ns, "key": key, "value": value})
+
+    def kv_get(self, ns: str, key: bytes, timeout: float = 30.0) -> Optional[bytes]:
+        return self.request({"type": "kv_get", "ns": ns, "key": key}, timeout=timeout)["value"]
+
+    def notify_blocked(self) -> None:
+        self.send({"type": "blocked"})
+
+    def notify_unblocked(self) -> None:
+        self.send({"type": "unblocked"})
+
+    def add_refs(self, oids: List[bytes]) -> None:
+        self.send({"type": "add_ref", "oids": oids})
+
+    def remove_refs(self, oids: List[bytes]) -> None:
+        self.send({"type": "remove_ref", "oids": oids})
+
+    def create_pg(self, spec: dict) -> None:
+        self.send({"type": "create_pg", "spec": spec})
+
+    def remove_pg(self, pg_id: bytes) -> None:
+        self.send({"type": "remove_pg", "pg_id": pg_id})
+
+    def get_actor_by_name(self, name: str):
+        return self.request({"type": "get_actor_by_name", "name": name})["value"]
+
+    def state_snapshot(self) -> dict:
+        return self.request({"type": "state_snapshot"})["value"]
+
+    def close(self) -> None:
+        self.closed = True
+        try:
+            self.conn.close()
+        except Exception:
+            pass

@@ -1,0 +1,73 @@
+"""Flag/config system for the runtime.
+
+TPU-native analog of the reference's ``RAY_CONFIG(type, name, default)`` macro
+table (``src/ray/common/ray_config_def.h:22-728`` materialized as the
+``RayConfig`` singleton in ``src/ray/common/ray_config.h``).  Every flag is
+overridable with a ``RAY_TPU_<NAME>`` environment variable, mirroring the
+reference's ``RAY_<name>`` env override path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any
+
+
+def _env(name: str, default: Any, typ: type) -> Any:
+    raw = os.environ.get(f"RAY_TPU_{name.upper()}")
+    if raw is None:
+        return default
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+@dataclasses.dataclass
+class Config:
+    # -- object store ------------------------------------------------------
+    # Objects at or below this size are carried inline in RPC messages
+    # (analog of the reference's in-process memory store for small/direct
+    # returns, src/ray/core_worker/store_provider/memory_store/).
+    max_direct_call_object_size: int = 100 * 1024
+    # Default object store capacity (bytes); analog of plasma's arena size.
+    object_store_memory: int = 2 * 1024**3
+    # Prefix for named shared-memory segments.
+    shm_prefix: str = "rtpu"
+
+    # -- scheduler ---------------------------------------------------------
+    # Pack nodes until utilization crosses this, then prefer spreading
+    # (reference HybridSchedulingPolicy spread_threshold,
+    # ray_config_def.h scheduler_spread_threshold).
+    scheduler_spread_threshold: float = 0.5
+    # Max workers a node will keep warm beyond its CPU count.
+    maximum_startup_concurrency: int = 8
+    # Seconds an idle worker is kept before being reaped.
+    idle_worker_killing_time_threshold_s: float = 300.0
+
+    # -- fault tolerance ---------------------------------------------------
+    task_max_retries: int = 3
+    actor_max_restarts: int = 0
+    # Health-check cadence for worker processes (GcsHealthCheckManager analog).
+    health_check_period_s: float = 1.0
+
+    # -- timeouts ----------------------------------------------------------
+    get_timeout_warning_s: float = 60.0
+    worker_register_timeout_s: float = 30.0
+
+    # -- logging -----------------------------------------------------------
+    log_to_driver: bool = True
+
+    def __post_init__(self) -> None:
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, _env(f.name, getattr(self, f.name), f.type_ if hasattr(f, "type_") else type(getattr(self, f.name))))
+
+
+_config: Config | None = None
+
+
+def get_config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config()
+    return _config

@@ -1,0 +1,103 @@
+"""GCS — the cluster control-plane tables.
+
+In-process analog of the reference's GCS server
+(``src/ray/gcs/gcs_server/gcs_server.h:75``): actor directory + restart FSM
+state (``gcs_actor_manager.h:270``), node table (``gcs_node_manager.h:39``),
+job/worker bookkeeping, the internal KV used for function shipping
+(``gcs_kv_manager.h:139`` — the reference's FunctionActorManager stores
+pickled functions there, ``python/ray/_private/function_manager.py:56``),
+and placement-group records (``gcs_placement_group_manager.h:221``).
+
+Storage is the ``InMemoryStoreClient`` analog
+(``src/ray/gcs/store_client/in_memory_store_client.h:31``); a pluggable
+persistent backend is the round-2+ path to GCS fault tolerance.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class ActorInfo:
+    actor_id: bytes
+    name: Optional[str]
+    class_name: str
+    state: str = "PENDING_CREATION"  # PENDING_CREATION/ALIVE/RESTARTING/DEAD
+    node_id: Optional[str] = None
+    worker_id: Optional[bytes] = None
+    max_restarts: int = 0
+    num_restarts: int = 0
+    creation_spec: Optional[dict] = None  # kept for restart (lineage)
+    death_cause: Optional[str] = None
+
+
+@dataclass
+class NodeInfo:
+    node_id: str
+    resources: Dict[str, float]
+    alive: bool = True
+    start_time: float = field(default_factory=time.time)
+
+
+@dataclass
+class TaskInfo:
+    task_id: bytes
+    name: str
+    state: str = "PENDING"  # PENDING/RUNNING/FINISHED/FAILED
+    node_id: Optional[str] = None
+    start_time: float = field(default_factory=time.time)
+    end_time: Optional[float] = None
+
+
+@dataclass
+class PlacementGroupInfo:
+    pg_id: bytes
+    bundles: List[Dict[str, float]]
+    strategy: str
+    state: str = "PENDING"  # PENDING/CREATED/REMOVED
+    bundle_nodes: List[Optional[str]] = field(default_factory=list)
+    name: Optional[str] = None
+
+
+class GcsTables:
+    """All control-plane tables behind one lock (single head process)."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.kv: Dict[str, Dict[bytes, bytes]] = {}  # namespace -> key -> val
+        self.actors: Dict[bytes, ActorInfo] = {}
+        self.named_actors: Dict[str, bytes] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.tasks: Dict[bytes, TaskInfo] = {}
+        self.placement_groups: Dict[bytes, PlacementGroupInfo] = {}
+
+    # ---- internal KV (GcsInternalKVManager analog) ----
+    def kv_put(self, ns: str, key: bytes, value: bytes) -> None:
+        with self.lock:
+            self.kv.setdefault(ns, {})[key] = value
+
+    def kv_get(self, ns: str, key: bytes) -> Optional[bytes]:
+        with self.lock:
+            return self.kv.get(ns, {}).get(key)
+
+    def kv_keys(self, ns: str) -> List[bytes]:
+        with self.lock:
+            return list(self.kv.get(ns, {}).keys())
+
+    def kv_del(self, ns: str, key: bytes) -> None:
+        with self.lock:
+            self.kv.get(ns, {}).pop(key, None)
+
+    # ---- snapshots for the state API (dashboard/state_aggregator analog) ----
+    def snapshot(self) -> Dict[str, Any]:
+        with self.lock:
+            return {
+                "actors": list(self.actors.values()),
+                "nodes": list(self.nodes.values()),
+                "tasks": list(self.tasks.values()),
+                "placement_groups": list(self.placement_groups.values()),
+            }

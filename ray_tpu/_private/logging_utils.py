@@ -1,0 +1,28 @@
+"""Logging setup (RAY_LOG / log_monitor analog, kept minimal).
+
+Workers inherit the driver's stdout/stderr, which gives the reference's
+"actor prints appear on the driver" behavior for free on a single machine
+(the reference needs a log monitor + GCS pubsub for this across nodes,
+``python/ray/_private/log_monitor.py:100``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+def get_logger(name: str) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logging.getLogger("ray_tpu").handlers:
+        root = logging.getLogger("ray_tpu")
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter("[ray_tpu %(levelname)s %(name)s] %(message)s"))
+        root.addHandler(h)
+        root.setLevel(os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"))
+    return logger
+
+
+def emit_worker_log(msg: dict) -> None:
+    get_logger("worker").info("%s", msg.get("text", ""))

@@ -1,0 +1,1046 @@
+"""Head process: raylet + GCS + object directory in one event-driven server.
+
+This fuses the roles the reference splits across processes, keeping the same
+seams so they can be split later:
+
+- connection fan-in + message dispatch  <-> raylet ``NodeManager`` gRPC
+  service (``src/ray/raylet/node_manager.h:144``)
+- ``Scheduler``                          <-> ``ClusterTaskManager`` /
+  ``LocalTaskManager`` (``src/ray/raylet/scheduling/cluster_task_manager.h:41``,
+  ``local_task_manager.h:58``) with a hybrid pack/spread policy
+  (``policy/hybrid_scheduling_policy.h:48``)
+- ``NodeState`` resource accounting      <-> ``ClusterResourceManager`` /
+  ``LocalResourceManager`` with **TPU as a predefined resource** next to CPU
+  (the reference's scheduling_ids.h vocabulary extended per SURVEY §2.1)
+- worker pool + dedicated actor workers  <-> ``WorkerPool``
+  (``src/ray/raylet/worker_pool.h:156``)
+- actor restart FSM                      <-> ``GcsActorManager``
+  (``gcs_actor_manager.h:270``)
+- placement-group bundle reservation     <-> ``GcsPlacementGroupManager`` +
+  bundle policies (``bundle_scheduling_policy.h:82-106``)
+- get/wait request parking               <-> raylet ``WaitManager`` +
+  plasma ``GetRequestQueue``
+
+Multiple ``NodeState``s in one head process emulate a multi-node cluster —
+the same trick as the reference's in-process multi-raylet test Cluster
+(``python/ray/cluster_utils.py:99``).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+from dataclasses import dataclass, field
+from multiprocessing.connection import Connection, Listener
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import logging_utils
+from ray_tpu._private.config import get_config
+from ray_tpu._private.gcs import (
+    ActorInfo,
+    GcsTables,
+    NodeInfo,
+    PlacementGroupInfo,
+    TaskInfo,
+)
+from ray_tpu._private.object_store import ObjectLocation, ObjectRegistry
+
+logger = logging_utils.get_logger(__name__)
+
+# Resource names (scheduling_ids.h predefined resources, plus TPU).
+CPU = "CPU"
+TPU = "TPU"
+MEMORY = "memory"
+
+
+def _fits(req: Dict[str, float], avail: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
+
+
+def _acquire(req: Dict[str, float], avail: Dict[str, float]) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+def _release(req: Dict[str, float], avail: Dict[str, float]) -> None:
+    for k, v in req.items():
+        avail[k] = avail.get(k, 0.0) + v
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: bytes
+    node_id: str
+    proc: Optional[subprocess.Popen] = None
+    conn: Optional[Connection] = None
+    state: str = "starting"  # starting/idle/busy/dead
+    is_actor_worker: bool = False
+    actor_id: Optional[bytes] = None
+    current_task: Optional[dict] = None
+    send_lock: threading.Lock = field(default_factory=threading.Lock)
+    blocked: bool = False
+
+    def send(self, msg: dict) -> None:
+        with self.send_lock:
+            self.conn.send(msg)
+
+
+@dataclass
+class NodeState:
+    node_id: str
+    total: Dict[str, float]
+    available: Dict[str, float]
+    tpu_free: List[int]
+    env: Dict[str, str] = field(default_factory=dict)
+    idle: List[WorkerHandle] = field(default_factory=list)
+    starting: int = 0
+    # tasks whose resources are held, waiting for an idle worker
+    ready_queue: deque = field(default_factory=deque)
+    alive: bool = True
+
+    def utilization(self) -> float:
+        fracs = []
+        for k, tot in self.total.items():
+            if tot > 0:
+                fracs.append(1.0 - self.available.get(k, 0.0) / tot)
+        return max(fracs) if fracs else 0.0
+
+
+@dataclass
+class ActorRuntime:
+    info: ActorInfo
+    worker: Optional[WorkerHandle] = None
+    queue: deque = field(default_factory=deque)  # pending method specs
+    running: Optional[dict] = None  # in-flight method spec
+    held: Dict[str, float] = field(default_factory=dict)
+    tpu_ids: List[int] = field(default_factory=list)
+    node_id: Optional[str] = None
+
+
+@dataclass
+class BundleRuntime:
+    node_id: str
+    reserved: Dict[str, float]
+    available: Dict[str, float]
+
+
+@dataclass
+class PGRuntime:
+    info: PlacementGroupInfo
+    bundles: List[BundleRuntime] = field(default_factory=list)
+    ready_oid: Optional[bytes] = None
+
+
+@dataclass
+class _PendingGet:
+    req_id: int
+    conn_send: Any  # callable(msg)
+    oids: List[bytes]
+    deadline: Optional[float]
+    kind: str = "get"  # get | wait
+    num_returns: int = 0
+
+
+class Node:
+    """The head runtime: owns every table and thread of the session."""
+
+    def __init__(
+        self,
+        num_cpus: Optional[int] = None,
+        num_tpus: Optional[int] = None,
+        resources: Optional[Dict[str, float]] = None,
+        session_dir: Optional[str] = None,
+    ):
+        from ray_tpu._private.resource_spec import autodetect_resources
+
+        self.cfg = get_config()
+        self.session_dir = session_dir or (
+            f"/tmp/ray_tpu/session_{os.getpid()}_{os.urandom(4).hex()}"
+        )
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.address = os.path.join(self.session_dir, "raylet.sock")
+        self.authkey = os.urandom(16)
+
+        self.lock = threading.RLock()
+        self.cond = threading.Condition(self.lock)
+        self.registry = ObjectRegistry()
+        self.gcs = GcsTables()
+
+        self.nodes: Dict[str, NodeState] = {}
+        self.actors: Dict[bytes, ActorRuntime] = {}
+        self.pgs: Dict[bytes, PGRuntime] = {}
+        self.pending_tasks: deque = deque()
+        self.pending_pgs: deque = deque()
+        self.running: Dict[bytes, dict] = {}  # task_id -> {spec, worker, node_id, held, tpu_ids}
+        self.workers: Dict[bytes, WorkerHandle] = {}
+        self.pending_gets: List[_PendingGet] = []
+        self._req_counter = 0
+        self._shutdown = False
+        self._head_node_id: str
+
+        total, tpus = autodetect_resources(num_cpus, num_tpus, resources)
+        self._head_node_id = "node-head"
+        self.add_node_state(self._head_node_id, total, tpus)
+
+        self._conn_locks: Dict[int, threading.Lock] = {}
+        self._listener = Listener(self.address, family="AF_UNIX", authkey=self.authkey, backlog=64)
+        self._threads: List[threading.Thread] = []
+        t = threading.Thread(target=self._accept_loop, name="accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._scheduler_loop, name="scheduler", daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._timeout_loop, name="timeouts", daemon=True)
+        t.start()
+        self._threads.append(t)
+        # Prestart one warm worker (WorkerPool prestart analog) to hide
+        # interpreter boot latency on first task.
+        with self.lock:
+            self._spawn_worker(self.nodes[self._head_node_id])
+
+    # ------------------------------------------------------------------
+    # topology
+    # ------------------------------------------------------------------
+    def add_node_state(
+        self,
+        node_id: str,
+        total: Dict[str, float],
+        tpu_ids: Optional[List[int]] = None,
+        env: Optional[Dict[str, str]] = None,
+    ) -> None:
+        with self.lock:
+            ns = NodeState(
+                node_id=node_id,
+                total=dict(total),
+                available=dict(total),
+                tpu_free=list(tpu_ids or []),
+                env=dict(env or {}),
+            )
+            self.nodes[node_id] = ns
+            self.gcs.nodes[node_id] = NodeInfo(node_id=node_id, resources=dict(total))
+            self.cond.notify_all()
+
+    def remove_node_state(self, node_id: str) -> None:
+        """Simulate node death (Cluster.remove_node / chaos NodeKiller analog)."""
+        with self.lock:
+            ns = self.nodes.get(node_id)
+            if ns is None:
+                return
+            ns.alive = False
+            if node_id in self.gcs.nodes:
+                self.gcs.nodes[node_id].alive = False
+            victims = [w for w in self.workers.values() if w.node_id == node_id and w.state != "dead"]
+        for w in victims:
+            try:
+                if w.proc:
+                    w.proc.kill()
+            except Exception:
+                pass
+            self._on_worker_death(w, reason=f"node {node_id} removed")
+        with self.lock:
+            self.cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # connection handling
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except (OSError, EOFError):
+                break
+            t = threading.Thread(target=self._reader_loop, args=(conn,), daemon=True)
+            t.start()
+
+    def _reader_loop(self, conn: Connection) -> None:
+        handle: Optional[WorkerHandle] = None
+        is_client = False
+        with self.lock:
+            self._conn_locks[id(conn)] = threading.Lock()
+        try:
+            while not self._shutdown:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError, pickle.UnpicklingError):
+                    break
+                mtype = msg["type"]
+                if mtype == "register_worker":
+                    handle = self._on_register_worker(conn, msg)
+                elif mtype == "register_client":
+                    is_client = True  # driver or external client connection
+                else:
+                    self._handle_message(conn, handle, msg)
+        finally:
+            if handle is not None:
+                self._on_worker_death(handle, reason="connection closed")
+            elif is_client:
+                pass
+
+    def _conn_lock(self, conn: Connection) -> threading.Lock:
+        with self.lock:
+            return self._conn_locks.setdefault(id(conn), threading.Lock())
+
+    def _reply(self, conn: Connection, msg: dict) -> None:
+        try:
+            with self._conn_lock(conn):
+                conn.send(msg)
+        except (OSError, ValueError):
+            pass
+
+    def _handle_message(self, conn: Connection, worker: Optional[WorkerHandle], msg: dict) -> None:
+        mtype = msg["type"]
+        if mtype == "submit_task":
+            self.submit_task(msg["spec"])
+        elif mtype == "seal":
+            self.seal_object(msg["oid"], msg["loc"], msg.get("contained", []))
+        elif mtype == "get_locations":
+            self._on_get_request(conn, msg, worker)
+        elif mtype == "wait":
+            self._on_wait_request(conn, msg, worker)
+        elif mtype == "task_done":
+            self._on_task_done(worker, msg)
+        elif mtype == "create_actor":
+            self.create_actor(msg["spec"])
+        elif mtype == "submit_actor_task":
+            self.submit_actor_task(msg["spec"])
+        elif mtype == "kill_actor":
+            self.kill_actor(msg["actor_id"], no_restart=msg.get("no_restart", True))
+        elif mtype == "kv_put":
+            self.gcs.kv_put(msg["ns"], msg["key"], msg["value"])
+        elif mtype == "kv_get":
+            val = self.gcs.kv_get(msg["ns"], msg["key"])
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"], "value": val})
+        elif mtype == "blocked":
+            self._on_blocked(worker, True)
+        elif mtype == "unblocked":
+            self._on_blocked(worker, False)
+        elif mtype == "add_ref":
+            for oid in msg["oids"]:
+                self.registry.add_ref(oid)
+        elif mtype == "remove_ref":
+            for oid in msg["oids"]:
+                self.registry.remove_ref(oid)
+        elif mtype == "create_pg":
+            self.create_placement_group(msg["spec"])
+        elif mtype == "remove_pg":
+            self.remove_placement_group(msg["pg_id"])
+        elif mtype == "get_actor_by_name":
+            with self.lock:
+                aid = self.gcs.named_actors.get(msg["name"])
+                info = self.actors[aid].info if aid in self.actors else None
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": (aid, info.creation_spec.get("class_blob_id") if info else None)})
+        elif mtype == "state_snapshot":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"], "value": self._state_snapshot()})
+        elif mtype == "log":
+            logging_utils.emit_worker_log(msg)
+        else:
+            logger.warning("unknown message type %s", mtype)
+
+    # ------------------------------------------------------------------
+    # workers
+    # ------------------------------------------------------------------
+    def _spawn_worker(self, ns: NodeState) -> None:
+        """Fork/exec a language worker (WorkerPool::StartWorkerProcess analog)."""
+        worker_id = os.urandom(8)
+        env = dict(os.environ)
+        env.update(ns.env)
+        env["RAY_TPU_ADDRESS"] = self.address
+        env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
+        env["RAY_TPU_NODE_ID"] = ns.node_id
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker"],
+            env=env,
+        )
+        h = WorkerHandle(worker_id=worker_id, node_id=ns.node_id, proc=proc)
+        self.workers[worker_id] = h
+        ns.starting += 1
+
+    def _on_register_worker(self, conn: Connection, msg: dict) -> WorkerHandle:
+        worker_id = bytes.fromhex(msg["worker_id"])
+        with self.lock:
+            h = self.workers.get(worker_id)
+            if h is None:  # externally started worker (not via pool)
+                h = WorkerHandle(worker_id=worker_id, node_id=msg["node_id"])
+                self.workers[worker_id] = h
+            h.conn = conn
+            h.send_lock = self._conn_lock(conn)
+            h.state = "idle"
+            ns = self.nodes.get(h.node_id)
+            if ns is not None:
+                ns.starting = max(0, ns.starting - 1)
+                ns.idle.append(h)
+            self.cond.notify_all()
+        return h
+
+    def _on_worker_death(self, h: WorkerHandle, reason: str) -> None:
+        from ray_tpu.exceptions import RayActorError, WorkerCrashedError
+
+        with self.lock:
+            if h.state == "dead":
+                return
+            h.state = "dead"
+            ns = self.nodes.get(h.node_id)
+            if ns and h in ns.idle:
+                ns.idle.remove(h)
+            spec = h.current_task
+            h.current_task = None
+        if self._shutdown:
+            return
+        if h.actor_id is not None:
+            self._on_actor_worker_death(h, reason)
+        elif spec is not None:
+            tid = spec["task_id"]
+            with self.lock:
+                rt = self.running.pop(tid, None)
+            if rt is not None:
+                self._release_task_resources(rt)
+            if spec.get("retries_left", 0) > 0:
+                spec["retries_left"] -= 1
+                logger.warning("task %s failed (%s); retrying", spec.get("name"), reason)
+                self.submit_task(spec, _resubmit=True)
+            else:
+                err = WorkerCrashedError(
+                    f"Worker died while running task {spec.get('name')}: {reason}"
+                )
+                self._seal_error_returns(spec, err)
+        with self.lock:
+            self.cond.notify_all()
+
+    def _on_blocked(self, h: Optional[WorkerHandle], blocked: bool) -> None:
+        """Release a blocked worker's CPUs so dependents can run — the
+        reference's NotifyDirectCallTaskBlocked/Unblocked path that prevents
+        nested ray.get deadlock."""
+        if h is None:
+            return
+        with self.lock:
+            if h.blocked == blocked or h.current_task is None:
+                return
+            h.blocked = blocked
+            tid = h.current_task["task_id"] if not h.is_actor_worker else None
+            held = None
+            if h.is_actor_worker and h.actor_id in self.actors:
+                held = self.actors[h.actor_id].held
+                node_id = self.actors[h.actor_id].node_id
+            elif tid is not None and tid in self.running:
+                held = self.running[tid]["held"]
+                node_id = self.running[tid]["node_id"]
+            if held is None:
+                return
+            cpus = {CPU: held.get(CPU, 0.0)}
+            ns = self.nodes.get(node_id)
+            if ns is None or cpus[CPU] == 0.0:
+                return
+            if blocked:
+                _release(cpus, ns.available)
+            else:
+                _acquire(cpus, ns.available)
+            self.cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # objects
+    # ------------------------------------------------------------------
+    def seal_object(self, oid: bytes, loc: ObjectLocation, contained: List[bytes]) -> None:
+        for c in contained:
+            self.registry.add_ref(c)
+        self.registry.seal(oid, loc)
+        self._service_pending_gets()
+        with self.lock:
+            self.cond.notify_all()
+
+    def _on_get_request(self, conn: Connection, msg: dict, worker: Optional[WorkerHandle]) -> None:
+        oids = msg["oids"]
+        timeout = msg.get("timeout")
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        pg = _PendingGet(
+            req_id=msg["req_id"],
+            conn_send=lambda m: self._reply(conn, m),
+            oids=oids,
+            deadline=deadline,
+        )
+        with self.lock:
+            self.pending_gets.append(pg)
+        self._service_pending_gets()
+
+    def _on_wait_request(self, conn: Connection, msg: dict, worker: Optional[WorkerHandle]) -> None:
+        timeout = msg.get("timeout")
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        pg = _PendingGet(
+            req_id=msg["req_id"],
+            conn_send=lambda m: self._reply(conn, m),
+            oids=msg["oids"],
+            deadline=deadline,
+            kind="wait",
+            num_returns=msg["num_returns"],
+        )
+        with self.lock:
+            self.pending_gets.append(pg)
+        self._service_pending_gets()
+
+    def _service_pending_gets(self, now: Optional[float] = None) -> None:
+        now = now or time.monotonic()
+        done: List[Tuple[_PendingGet, dict]] = []
+        with self.lock:
+            remaining = []
+            for pg in self.pending_gets:
+                sealed = [oid for oid in pg.oids if self.registry.is_sealed(oid)]
+                expired = pg.deadline is not None and now >= pg.deadline
+                if pg.kind == "get":
+                    if len(sealed) == len(pg.oids):
+                        locs = {oid: self.registry.get_location(oid) for oid in pg.oids}
+                        done.append((pg, {"type": "reply", "req_id": pg.req_id, "locations": locs}))
+                    elif expired:
+                        done.append((pg, {"type": "reply", "req_id": pg.req_id, "timeout": True}))
+                    else:
+                        remaining.append(pg)
+                else:  # wait
+                    if len(sealed) >= pg.num_returns or expired:
+                        locs = {oid: self.registry.get_location(oid) for oid in sealed}
+                        done.append((pg, {"type": "reply", "req_id": pg.req_id,
+                                          "ready": sealed, "locations": locs}))
+                    else:
+                        remaining.append(pg)
+            self.pending_gets = remaining
+        for pg, reply in done:
+            pg.conn_send(reply)
+
+    def _timeout_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(0.05)
+            self._service_pending_gets()
+
+    # ------------------------------------------------------------------
+    # tasks
+    # ------------------------------------------------------------------
+    def submit_task(self, spec: dict, _resubmit: bool = False) -> None:
+        with self.lock:
+            if not _resubmit:
+                self.gcs.tasks[spec["task_id"]] = TaskInfo(
+                    task_id=spec["task_id"], name=spec.get("name", "task")
+                )
+                for oid in spec["return_ids"]:
+                    self.registry.create_pending(oid)
+            self.pending_tasks.append(spec)
+            self.cond.notify_all()
+
+    def _seal_error_returns(self, spec: dict, err: Exception) -> None:
+        from ray_tpu._private.object_store import store_value
+        from ray_tpu._private.object_ref import ObjectRef
+
+        for oid in spec["return_ids"]:
+            loc, _ = store_value(ObjectRef(oid), err, is_error=True)
+            self.registry.seal(oid, loc)
+        with self.lock:
+            ti = self.gcs.tasks.get(spec["task_id"])
+            if ti:
+                ti.state = "FAILED"
+                ti.end_time = time.time()
+        self._service_pending_gets()
+
+    def _deps_ready(self, spec: dict) -> bool:
+        return all(self.registry.is_sealed(d) for d in spec.get("dep_ids", []))
+
+    def _dep_locations(self, spec: dict) -> Dict[bytes, ObjectLocation]:
+        return {d: self.registry.get_location(d) for d in spec.get("dep_ids", [])}
+
+    def _select_node(self, spec: dict) -> Optional[Tuple[NodeState, Optional[BundleRuntime]]]:
+        """Hybrid pack/spread node selection (HybridSchedulingPolicy analog)."""
+        req = spec.get("resources", {})
+        strategy = spec.get("scheduling_strategy")
+        if isinstance(strategy, dict) and strategy.get("kind") == "placement_group":
+            pgrt = self.pgs.get(strategy["pg_id"])
+            if pgrt is None or pgrt.info.state != "CREATED":
+                return None
+            idx = strategy.get("bundle_index", -1)
+            candidates = pgrt.bundles if idx < 0 else [pgrt.bundles[idx]]
+            for b in candidates:
+                ns = self.nodes.get(b.node_id)
+                if ns and ns.alive and _fits(req, b.available):
+                    return ns, b
+            return None
+        if isinstance(strategy, dict) and strategy.get("kind") == "node_affinity":
+            ns = self.nodes.get(strategy["node_id"])
+            if ns and ns.alive and _fits(req, ns.available):
+                return ns, None
+            if strategy.get("soft"):
+                pass  # fall through to default policy
+            else:
+                return None
+        alive = [n for n in self.nodes.values() if n.alive and _fits(req, n.total)]
+        avail = [n for n in alive if _fits(req, n.available)]
+        if not avail:
+            return None
+        thr = self.cfg.scheduler_spread_threshold
+        below = [n for n in avail if n.utilization() < thr]
+        if below:
+            # pack: most utilized node under the threshold
+            best = max(below, key=lambda n: (n.utilization(), n.node_id == self._head_node_id))
+        else:
+            best = min(avail, key=lambda n: n.utilization())
+        return best, None
+
+    def _scheduler_loop(self) -> None:
+        while not self._shutdown:
+            with self.lock:
+                self.cond.wait(timeout=0.2)
+            try:
+                self._schedule_once()
+            except Exception:
+                logger.error("scheduler error:\n%s", traceback.format_exc())
+
+    def _schedule_once(self) -> None:
+        self._schedule_pgs()
+        self._schedule_actor_creations_and_tasks()
+        # phase 1: move pending tasks to a node's ready queue (resources held)
+        with self.lock:
+            still_pending = deque()
+            while self.pending_tasks:
+                spec = self.pending_tasks.popleft()
+                if not self._deps_ready(spec):
+                    still_pending.append(spec)
+                    continue
+                sel = self._select_node(spec)
+                if sel is None:
+                    still_pending.append(spec)
+                    continue
+                ns, bundle = sel
+                req = spec.get("resources", {})
+                pool = bundle.available if bundle is not None else ns.available
+                _acquire(req, pool)
+                tpu_ids: List[int] = []
+                n_tpu = int(req.get(TPU, 0))
+                if n_tpu > 0:
+                    tpu_ids = [ns.tpu_free.pop() for _ in range(min(n_tpu, len(ns.tpu_free)))]
+                ns.ready_queue.append((spec, tpu_ids, bundle))
+            self.pending_tasks = still_pending
+            # phase 2: dispatch ready tasks to idle workers; spawn if needed
+            for ns in self.nodes.values():
+                if not ns.alive:
+                    continue
+                while ns.ready_queue:
+                    if not ns.idle:
+                        cap = int(ns.total.get(CPU, 1)) + self.cfg.maximum_startup_concurrency
+                        n_workers = sum(
+                            1
+                            for w in self.workers.values()
+                            if w.node_id == ns.node_id and w.state != "dead" and not w.is_actor_worker
+                        )
+                        # Spawn only what the queue needs; python startup is
+                        # expensive, so never boot more than 2 at a time.
+                        need = len(ns.ready_queue) - ns.starting
+                        if need > 0 and n_workers + ns.starting < max(1, cap) and ns.starting < 2:
+                            self._spawn_worker(ns)
+                        break
+                    spec, tpu_ids, bundle = ns.ready_queue.popleft()
+                    w = ns.idle.pop()
+                    self._dispatch(ns, w, spec, tpu_ids, bundle)
+
+    def _dispatch(self, ns: NodeState, w: WorkerHandle, spec: dict, tpu_ids: List[int], bundle) -> None:
+        w.state = "busy"
+        w.current_task = spec
+        self.running[spec["task_id"]] = {
+            "spec": spec,
+            "worker": w,
+            "node_id": ns.node_id,
+            "held": dict(spec.get("resources", {})),
+            "tpu_ids": tpu_ids,
+            "bundle": bundle,
+        }
+        ti = self.gcs.tasks.get(spec["task_id"])
+        if ti:
+            ti.state = "RUNNING"
+            ti.node_id = ns.node_id
+        exec_msg = {
+            "type": "execute",
+            "spec": spec,
+            "dep_locs": self._dep_locations(spec),
+            "tpu_ids": tpu_ids,
+        }
+        try:
+            w.send(exec_msg)
+        except (OSError, ValueError):
+            self._on_worker_death(w, reason="send failed")
+
+    def _release_task_resources(self, rt: dict) -> None:
+        with self.lock:
+            ns = self.nodes.get(rt["node_id"])
+            if ns is None:
+                return
+            held = dict(rt["held"])
+            if rt["worker"].blocked:
+                held[CPU] = held.get(CPU, 0.0) - held.get(CPU, 0.0)  # CPUs already released
+                rt["worker"].blocked = False
+            pool = rt["bundle"].available if rt.get("bundle") is not None else ns.available
+            _release(held, pool)
+            ns.tpu_free.extend(rt.get("tpu_ids", []))
+            self.cond.notify_all()
+
+    def _on_task_done(self, w: WorkerHandle, msg: dict) -> None:
+        spec = msg["spec_ref"]
+        tid = spec["task_id"]
+        with self.lock:
+            rt = self.running.pop(tid, None)
+            w.current_task = None
+            ti = self.gcs.tasks.get(tid)
+            if ti:
+                ti.state = "FAILED" if msg.get("failed") else "FINISHED"
+                ti.end_time = time.time()
+        if rt is not None:
+            self._release_task_resources(rt)
+        # return objects were sealed by the worker via "seal" messages already
+        is_creation = spec.get("is_actor_creation")
+        if is_creation:
+            self._on_actor_started(spec, w, failed=msg.get("failed"), error=msg.get("error_str"))
+        with self.lock:
+            if w.state == "busy" and not w.is_actor_worker:
+                w.state = "idle"
+                ns = self.nodes.get(w.node_id)
+                if ns and ns.alive:
+                    ns.idle.append(w)
+            if w.is_actor_worker and w.actor_id in self.actors:
+                art = self.actors[w.actor_id]
+                if not is_creation:
+                    art.running = None
+            self.cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # actors (GcsActorManager FSM analog)
+    # ------------------------------------------------------------------
+    def create_actor(self, spec: dict) -> None:
+        with self.lock:
+            info = ActorInfo(
+                actor_id=spec["actor_id"],
+                name=spec.get("actor_name"),
+                class_name=spec.get("name", "Actor"),
+                max_restarts=spec.get("max_restarts", 0),
+                creation_spec=spec,
+            )
+            self.gcs.actors[spec["actor_id"]] = info
+            if info.name:
+                self.gcs.named_actors[info.name] = spec["actor_id"]
+            self.actors[spec["actor_id"]] = ActorRuntime(info=info)
+            for oid in spec["return_ids"]:
+                self.registry.create_pending(oid)
+            self.cond.notify_all()
+
+    def _schedule_actor_creations_and_tasks(self) -> None:
+        with self.lock:
+            for art in list(self.actors.values()):
+                info = art.info
+                if info.state in ("PENDING_CREATION", "RESTARTING") and art.worker is None:
+                    spec = info.creation_spec
+                    if not self._deps_ready(spec):
+                        continue
+                    sel = self._select_node(spec)
+                    if sel is None:
+                        continue
+                    ns, bundle = sel
+                    req = spec.get("resources", {})
+                    pool = bundle.available if bundle is not None else ns.available
+                    _acquire(req, pool)
+                    art.held = dict(req)
+                    art.node_id = ns.node_id
+                    art.bundle = bundle
+                    n_tpu = int(req.get(TPU, 0))
+                    art.tpu_ids = [ns.tpu_free.pop() for _ in range(min(n_tpu, len(ns.tpu_free)))]
+                    # dedicated worker for the actor
+                    worker_id = os.urandom(8)
+                    env = dict(os.environ)
+                    env.update(ns.env)
+                    env["RAY_TPU_ADDRESS"] = self.address
+                    env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
+                    env["RAY_TPU_NODE_ID"] = ns.node_id
+                    env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+                    env["RAY_TPU_SESSION_DIR"] = self.session_dir
+                    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+                    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+                    if art.tpu_ids:
+                        env["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in art.tpu_ids)
+                        env["RAY_TPU_ASSIGNED_TPUS"] = env["TPU_VISIBLE_CHIPS"]
+                    proc = subprocess.Popen([sys.executable, "-m", "ray_tpu._private.worker"], env=env)
+                    h = WorkerHandle(
+                        worker_id=worker_id,
+                        node_id=ns.node_id,
+                        proc=proc,
+                        is_actor_worker=True,
+                        actor_id=info.actor_id,
+                    )
+                    self.workers[worker_id] = h
+                    art.worker = h
+                    info.node_id = ns.node_id
+                    info.worker_id = worker_id
+                    info.state = "CREATING"
+            # dispatch actor creation + method calls to registered actor workers
+            for art in list(self.actors.values()):
+                w = art.worker
+                if w is None or w.conn is None or w.state == "dead":
+                    continue
+                if art.info.state == "CREATING":
+                    if w.state == "idle":
+                        w.state = "busy"
+                        spec = art.info.creation_spec
+                        w.current_task = spec
+                        try:
+                            w.send({
+                                "type": "execute",
+                                "spec": spec,
+                                "dep_locs": self._dep_locations(spec),
+                                "tpu_ids": art.tpu_ids,
+                            })
+                            art.info.state = "STARTING"
+                        except (OSError, ValueError):
+                            pass
+                elif art.info.state == "ALIVE" and art.running is None and art.queue:
+                    spec = art.queue.popleft()
+                    if not self._deps_ready(spec):
+                        art.queue.appendleft(spec)
+                        continue
+                    art.running = spec
+                    w.current_task = spec
+                    try:
+                        w.send({
+                            "type": "execute",
+                            "spec": spec,
+                            "dep_locs": self._dep_locations(spec),
+                            "tpu_ids": art.tpu_ids,
+                        })
+                    except (OSError, ValueError):
+                        pass
+
+    def _on_actor_started(self, spec: dict, w: WorkerHandle, failed: bool, error: Optional[str]) -> None:
+        with self.lock:
+            art = self.actors.get(spec["actor_id"])
+            if art is None:
+                return
+            if failed:
+                art.info.state = "DEAD"
+                art.info.death_cause = f"creation failed: {error}"
+            else:
+                art.info.state = "ALIVE"
+            self.cond.notify_all()
+
+    def submit_actor_task(self, spec: dict) -> None:
+        from ray_tpu.exceptions import RayActorError
+
+        with self.lock:
+            art = self.actors.get(spec["actor_id"])
+            for oid in spec["return_ids"]:
+                self.registry.create_pending(oid)
+            if art is None or art.info.state == "DEAD":
+                cause = art.info.death_cause if art else "unknown actor"
+                err = RayActorError(f"Actor is dead: {cause}")
+                threading.Thread(target=self._seal_error_returns, args=(spec, err), daemon=True).start()
+                return
+            self.gcs.tasks[spec["task_id"]] = TaskInfo(task_id=spec["task_id"], name=spec.get("name", "actor_task"))
+            art.queue.append(spec)
+            self.cond.notify_all()
+
+    def _on_actor_worker_death(self, w: WorkerHandle, reason: str) -> None:
+        from ray_tpu.exceptions import RayActorError
+
+        with self.lock:
+            art = self.actors.get(w.actor_id)
+            if art is None:
+                return
+            info = art.info
+            failed_specs = []
+            if art.running is not None:
+                failed_specs.append(art.running)
+                art.running = None
+            art.worker = None
+            # release resources
+            ns = self.nodes.get(art.node_id) if art.node_id else None
+            if ns is not None and art.held:
+                pool = art.bundle.available if getattr(art, "bundle", None) is not None else ns.available
+                _release(art.held, pool)
+                ns.tpu_free.extend(art.tpu_ids)
+                art.held = {}
+                art.tpu_ids = []
+            if info.state == "DEAD":
+                return
+            if info.num_restarts < info.max_restarts or info.max_restarts == -1:
+                info.num_restarts += 1
+                info.state = "RESTARTING"
+                logger.warning(
+                    "actor %s died (%s); restarting (%d/%s)",
+                    info.class_name, reason, info.num_restarts,
+                    "inf" if info.max_restarts == -1 else info.max_restarts,
+                )
+            else:
+                info.state = "DEAD"
+                info.death_cause = reason
+                failed_specs.extend(art.queue)
+                art.queue.clear()
+            self.cond.notify_all()
+        err = RayActorError(f"Actor {info.class_name} died: {reason}")
+        for spec in failed_specs:
+            self._seal_error_returns(spec, err)
+
+    def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
+        with self.lock:
+            art = self.actors.get(actor_id)
+            if art is None:
+                return
+            if no_restart:
+                art.info.max_restarts = art.info.num_restarts  # disable restart
+            w = art.worker
+        if w is not None and w.proc is not None:
+            try:
+                w.proc.kill()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
+    # placement groups (GcsPlacementGroupManager + bundle policies analog)
+    # ------------------------------------------------------------------
+    def create_placement_group(self, spec: dict) -> None:
+        with self.lock:
+            info = PlacementGroupInfo(
+                pg_id=spec["pg_id"],
+                bundles=spec["bundles"],
+                strategy=spec["strategy"],
+                name=spec.get("name"),
+            )
+            self.gcs.placement_groups[info.pg_id] = info
+            rt = PGRuntime(info=info, ready_oid=spec.get("ready_oid"))
+            self.pgs[info.pg_id] = rt
+            if rt.ready_oid:
+                self.registry.create_pending(rt.ready_oid)
+            self.pending_pgs.append(rt.info.pg_id)
+            self.cond.notify_all()
+
+    def _schedule_pgs(self) -> None:
+        """Bundle placement: STRICT_PACK / PACK / SPREAD / STRICT_SPREAD
+        (bundle_scheduling_policy.h:82-106)."""
+        sealed = []
+        with self.lock:
+            still = deque()
+            while self.pending_pgs:
+                pg_id = self.pending_pgs.popleft()
+                rt = self.pgs.get(pg_id)
+                if rt is None or rt.info.state != "PENDING":
+                    continue
+                placement = self._try_place_bundles(rt.info)
+                if placement is None:
+                    still.append(pg_id)
+                    continue
+                for bundle_req, ns in placement:
+                    _acquire(bundle_req, ns.available)
+                    rt.bundles.append(
+                        BundleRuntime(node_id=ns.node_id, reserved=dict(bundle_req), available=dict(bundle_req))
+                    )
+                    rt.info.bundle_nodes.append(ns.node_id)
+                rt.info.state = "CREATED"
+                if rt.ready_oid:
+                    sealed.append(rt.ready_oid)
+            self.pending_pgs = still
+        for oid in sealed:
+            from ray_tpu._private.object_store import store_value
+            from ray_tpu._private.object_ref import ObjectRef
+
+            loc, _ = store_value(ObjectRef(oid), True)
+            self.seal_object(oid, loc, [])
+
+    def _try_place_bundles(self, info: PlacementGroupInfo):
+        alive = [n for n in self.nodes.values() if n.alive]
+        scratch = {n.node_id: dict(n.available) for n in alive}
+        placement = []
+        strategy = info.strategy
+        if strategy in ("STRICT_PACK", "PACK"):
+            # STRICT_PACK: all bundles on one node. PACK: best effort pack.
+            for n in alive:
+                avail = dict(scratch[n.node_id])
+                ok = True
+                for b in info.bundles:
+                    if _fits(b, avail):
+                        _acquire(b, avail)
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    return [(b, n) for b in info.bundles]
+            if strategy == "STRICT_PACK":
+                return None
+        used_nodes = set()
+        for b in info.bundles:
+            cands = [n for n in alive if _fits(b, scratch[n.node_id])]
+            if strategy == "STRICT_SPREAD":
+                cands = [n for n in cands if n.node_id not in used_nodes]
+            if not cands:
+                return None
+            if strategy in ("SPREAD", "STRICT_SPREAD"):
+                cands.sort(key=lambda n: (n.node_id in used_nodes, len([1 for _, m in placement if m.node_id == n.node_id])))
+            n = cands[0]
+            _acquire(b, scratch[n.node_id])
+            used_nodes.add(n.node_id)
+            placement.append((b, n))
+        return placement
+
+    def remove_placement_group(self, pg_id: bytes) -> None:
+        with self.lock:
+            rt = self.pgs.pop(pg_id, None)
+            if rt is None:
+                return
+            rt.info.state = "REMOVED"
+            for b in rt.bundles:
+                ns = self.nodes.get(b.node_id)
+                if ns is not None:
+                    # return only unconsumed capacity plus consumed-by-dead tasks:
+                    # consumed capacity is returned when those tasks finish.
+                    _release(b.available, ns.available)
+            self.cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def _state_snapshot(self) -> dict:
+        snap = self.gcs.snapshot()
+        snap["object_store"] = self.registry.stats()
+        with self.lock:
+            snap["cluster_resources"] = {
+                nid: dict(ns.total) for nid, ns in self.nodes.items() if ns.alive
+            }
+            snap["available_resources"] = {
+                nid: dict(ns.available) for nid, ns in self.nodes.items() if ns.alive
+            }
+        return snap
+
+    # ------------------------------------------------------------------
+    # shutdown
+    # ------------------------------------------------------------------
+    def shutdown(self) -> None:
+        self._shutdown = True
+        with self.lock:
+            workers = list(self.workers.values())
+        for w in workers:
+            if w.conn is not None:
+                try:
+                    w.send({"type": "exit"})
+                except Exception:
+                    pass
+        deadline = time.time() + 2.0
+        for w in workers:
+            if w.proc is not None:
+                try:
+                    w.proc.wait(timeout=max(0.05, deadline - time.time()))
+                except Exception:
+                    try:
+                        w.proc.kill()
+                    except Exception:
+                        pass
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+        self.registry.shutdown()

@@ -1,0 +1,71 @@
+"""ObjectRef — the future/handle for an object in the store.
+
+Analog of the reference's binary ``ObjectID`` (``src/ray/common/id.h``) plus
+the Python ``ObjectRef`` exposed by the Cython binding
+(``python/ray/_raylet.pyx``).  IDs are 16 random bytes; task IDs embed a
+per-task counter the way the reference embeds lineage in object IDs.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class ObjectRef:
+    __slots__ = ("_id",)
+
+    def __init__(self, id_bytes: bytes):
+        assert isinstance(id_bytes, bytes) and len(id_bytes) == 16
+        self._id = id_bytes
+
+    @classmethod
+    def random(cls) -> "ObjectRef":
+        return cls(os.urandom(16))
+
+    @classmethod
+    def from_hex(cls, h: str) -> "ObjectRef":
+        return cls(bytes.fromhex(h))
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+    def future(self):
+        """concurrent.futures-style future resolving to the object's value."""
+        import concurrent.futures
+
+        import ray_tpu
+
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _wait():
+            try:
+                fut.set_result(ray_tpu.get(self))
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        import threading
+
+        threading.Thread(target=_wait, daemon=True).start()
+        return fut
+
+    def __reduce__(self):
+        # Plain pickling path (e.g. inside nested containers serialized by
+        # third-party code). The runtime's serializer also special-cases us
+        # to track borrowed refs.
+        return (ObjectRef, (self._id,))
+
+
+def new_id(n: int = 16) -> bytes:
+    return os.urandom(n)

@@ -1,0 +1,172 @@
+"""Object store: registry + producer/consumer helpers.
+
+Splits the reference's design across the same seams:
+
+- ``ObjectRegistry`` lives in the head process and plays the role of the
+  plasma store's directory + ``ObjectLifecycleManager``
+  (``src/ray/object_manager/plasma/store.h:55``,
+  ``object_lifecycle_manager.h:101``): it maps object id -> location, tracks
+  sealing, sizes, and reference counts, and unlinks segments on eviction.
+- Producers (workers/driver) serialize into a fresh shm segment themselves
+  and then *seal* it with the registry — the plasma create/seal protocol
+  without copying payloads through a socket.
+- Small objects are carried inline, the analog of the core worker's
+  in-process memory store for direct returns
+  (``src/ray/core_worker/store_provider/memory_store/memory_store.h``).
+
+Each consumer process keeps attached segments alive in ``_ATTACHED`` for the
+life of the process, like plasma clients holding their mmaps.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu._private import serialization
+from ray_tpu._private.config import get_config
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.shm import ShmSegment
+
+
+@dataclass
+class ObjectLocation:
+    """Where an object's payload lives. Exactly one of inline/shm is set."""
+
+    inline: Optional[bytes] = None
+    shm_name: Optional[str] = None
+    size: int = 0
+    # Serialized error objects raise on get (RayTaskError analog).
+    is_error: bool = False
+
+    def __post_init__(self):
+        if self.inline is not None:
+            self.size = len(self.inline)
+
+
+@dataclass
+class _Entry:
+    loc: Optional[ObjectLocation] = None
+    sealed: threading.Event = field(default_factory=threading.Event)
+    ref_count: int = 1
+
+
+class ObjectRegistry:
+    """Head-process directory of all objects in the session."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._objects: Dict[bytes, _Entry] = {}
+        self._bytes_used = 0
+
+    def create_pending(self, oid: bytes) -> None:
+        """Declare an object that a task will produce (return slot)."""
+        with self._lock:
+            self._objects.setdefault(oid, _Entry())
+
+    def seal(self, oid: bytes, loc: ObjectLocation) -> None:
+        with self._lock:
+            e = self._objects.setdefault(oid, _Entry())
+            e.loc = loc
+            self._bytes_used += loc.size
+        e.sealed.set()
+
+    def is_sealed(self, oid: bytes) -> bool:
+        with self._lock:
+            e = self._objects.get(oid)
+        return e is not None and e.sealed.is_set()
+
+    def wait_sealed(self, oid: bytes, timeout: Optional[float]) -> Optional[ObjectLocation]:
+        with self._lock:
+            e = self._objects.setdefault(oid, _Entry())
+        if not e.sealed.wait(timeout):
+            return None
+        return e.loc
+
+    def get_location(self, oid: bytes) -> Optional[ObjectLocation]:
+        with self._lock:
+            e = self._objects.get(oid)
+        if e is None or not e.sealed.is_set():
+            return None
+        return e.loc
+
+    def add_ref(self, oid: bytes, n: int = 1) -> None:
+        with self._lock:
+            e = self._objects.get(oid)
+            if e is not None:
+                e.ref_count += n
+
+    def remove_ref(self, oid: bytes, n: int = 1) -> None:
+        """Distributed-ref-counting-lite (ReferenceCounter, reference_count.h:61)."""
+        unlink = None
+        with self._lock:
+            e = self._objects.get(oid)
+            if e is None:
+                return
+            e.ref_count -= n
+            if e.ref_count <= 0 and e.sealed.is_set():
+                if e.loc and e.loc.shm_name:
+                    unlink = e.loc.shm_name
+                    self._bytes_used -= e.loc.size
+                del self._objects[oid]
+        if unlink:
+            ShmSegment.unlink(unlink)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects),
+                "bytes_used": self._bytes_used,
+            }
+
+    def all_shm_names(self) -> List[str]:
+        with self._lock:
+            return [e.loc.shm_name for e in self._objects.values() if e.loc and e.loc.shm_name]
+
+    def shutdown(self) -> None:
+        for name in self.all_shm_names():
+            ShmSegment.unlink(name)
+        with self._lock:
+            self._objects.clear()
+
+
+# ---------------------------------------------------------------------------
+# Producer / consumer helpers (run in any process)
+# ---------------------------------------------------------------------------
+
+_ATTACHED: Dict[str, ShmSegment] = {}
+_ATTACHED_LOCK = threading.Lock()
+
+
+def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[ObjectLocation, list]:
+    """Serialize ``value``; write big payloads to shm. Returns (location, contained_refs)."""
+    cfg = get_config()
+    meta, buffers, refs = serialization.serialize(value)
+    total = serialization.total_size(meta, buffers)
+    if total <= cfg.max_direct_call_object_size:
+        blob = serialization.to_bytes(meta, buffers)
+        return ObjectLocation(inline=blob, is_error=is_error), refs
+    name = f"{cfg.shm_prefix}-{ref.hex()}"
+    seg = ShmSegment.create(name, total)
+    try:
+        serialization.write_into(seg.buf, meta, buffers)
+    finally:
+        seg.close()
+    return ObjectLocation(shm_name=name, size=total, is_error=is_error), refs
+
+
+def read_value(loc: ObjectLocation) -> Any:
+    """Deserialize an object from its location (zero-copy for shm payloads)."""
+    if loc.inline is not None:
+        value = serialization.deserialize(memoryview(loc.inline))
+    else:
+        with _ATTACHED_LOCK:
+            seg = _ATTACHED.get(loc.shm_name)
+            if seg is None:
+                seg = ShmSegment.attach(loc.shm_name, loc.size)
+                _ATTACHED[loc.shm_name] = seg
+        value = serialization.deserialize(seg.buf)
+    if loc.is_error:
+        raise value
+    return value

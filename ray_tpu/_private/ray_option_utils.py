@@ -1,0 +1,52 @@
+"""Validation of ``@remote``/``.options`` arguments.
+
+Single source of truth for task/actor options, mirroring
+``python/ray/_private/ray_option_utils.py:118-184`` (num_cpus/num_tpus/
+max_retries/max_restarts/num_returns/resources/...).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+TASK_OPTIONS = {
+    "num_cpus", "num_tpus", "resources", "num_returns", "max_retries",
+    "scheduling_strategy", "name", "runtime_env", "memory",
+}
+ACTOR_OPTIONS = {
+    "num_cpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
+    "scheduling_strategy", "name", "lifetime", "runtime_env", "memory",
+    "max_concurrency",
+}
+
+
+def validate_options(opts: Dict[str, Any], for_actor: bool) -> Dict[str, Any]:
+    allowed = ACTOR_OPTIONS if for_actor else TASK_OPTIONS
+    for k in opts:
+        if k not in allowed:
+            raise ValueError(
+                f"Invalid option {k!r} for {'actor' if for_actor else 'task'}; "
+                f"allowed: {sorted(allowed)}"
+            )
+    for k in ("num_cpus", "num_tpus", "memory"):
+        v = opts.get(k)
+        if v is not None and (not isinstance(v, (int, float)) or v < 0):
+            raise ValueError(f"{k} must be a non-negative number, got {v!r}")
+    nr = opts.get("num_returns")
+    if nr is not None and (not isinstance(nr, int) or nr < 1):
+        raise ValueError(f"num_returns must be an int >= 1, got {nr!r}")
+    return opts
+
+
+def resources_from_options(opts: Dict[str, Any], default_num_cpus: float) -> Dict[str, float]:
+    res: Dict[str, float] = dict(opts.get("resources") or {})
+    if "CPU" in res or "TPU" in res:
+        raise ValueError("Use num_cpus/num_tpus instead of resources={'CPU': ...}")
+    num_cpus = opts.get("num_cpus")
+    res["CPU"] = float(default_num_cpus if num_cpus is None else num_cpus)
+    num_tpus = opts.get("num_tpus")
+    if num_tpus:
+        res["TPU"] = float(num_tpus)
+    if opts.get("memory"):
+        res["memory"] = float(opts["memory"])
+    return {k: v for k, v in res.items() if v != 0}

@@ -1,0 +1,50 @@
+"""Node resource detection, with TPU chips first-class.
+
+Analog of ``python/ray/_private/resource_spec.py`` — its
+``_autodetect_num_gpus`` (``resource_spec.py:268``) counts GPUs; here we
+autodetect **TPU chips** instead, per SURVEY §2.1's TPU-port note: probe
+``/dev/accel*`` (TPU VM PCI devices) and ``/dev/vfio``, honor the
+``TPU_VISIBLE_CHIPS`` restriction the way the reference honors
+``CUDA_VISIBLE_DEVICES``, and allow an explicit override via
+``RAY_TPU_NUM_TPUS`` (tunneled/remote-attached chips are invisible in /dev).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional, Tuple
+
+
+def autodetect_num_tpus() -> int:
+    if "RAY_TPU_NUM_TPUS" in os.environ:
+        return int(os.environ["RAY_TPU_NUM_TPUS"])
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if visible:
+        return len([c for c in visible.split(",") if c.strip()])
+    accel = glob.glob("/dev/accel*")
+    if accel:
+        return len(accel)
+    vfio = glob.glob("/dev/vfio/[0-9]*")
+    if vfio:
+        return len(vfio)
+    return 0
+
+
+def autodetect_resources(
+    num_cpus: Optional[int],
+    num_tpus: Optional[int],
+    resources: Optional[Dict[str, float]],
+) -> Tuple[Dict[str, float], List[int]]:
+    """Returns (resource totals, tpu chip ids)."""
+    total: Dict[str, float] = dict(resources or {})
+    total["CPU"] = float(num_cpus if num_cpus is not None else os.cpu_count() or 1)
+    n_tpus = num_tpus if num_tpus is not None else autodetect_num_tpus()
+    total["TPU"] = float(n_tpus)
+    try:
+        import psutil  # type: ignore
+
+        total.setdefault("memory", float(psutil.virtual_memory().available))
+    except Exception:
+        total.setdefault("memory", 8.0 * 1024**3)
+    return total, list(range(int(n_tpus)))

@@ -1,0 +1,124 @@
+"""Zero-copy serialization for objects crossing process boundaries.
+
+Analog of the reference's ``SerializationContext``
+(``python/ray/_private/serialization.py:92``) and its zero-copy numpy path
+(``python/ray/_private/arrow_serialization.py``): we use pickle protocol 5
+with out-of-band buffers so that large contiguous payloads (numpy arrays,
+jax host arrays, bytes) are written directly into a shared-memory segment
+and mapped back as zero-copy views on the consumer side.
+
+Wire layout of a serialized object (one blob, possibly inside one shm
+segment):
+
+    [u64 meta_len][meta pickle][buffer 0][pad to 64][buffer 1]...
+
+where ``meta pickle`` is the pickle-5 stream with ``PickleBuffer``s replaced
+by indices, plus a table of (offset, length) for each out-of-band buffer.
+
+ObjectRefs found inside values are serialized by id and re-hydrated on the
+other side (the reference does this through its serialization context's
+object-ref reducer so that the owner address travels with the ref).
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+import struct
+from typing import Any, Callable, List, Tuple
+
+_ALIGN = 64
+_HEADER = struct.Struct("<Q")
+
+
+def _pad(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+class _OOBPickler(pickle.Pickler):
+    """Pickler that collects out-of-band buffers and contained ObjectRefs."""
+
+    def __init__(self, file, collected_refs: list):
+        super().__init__(file, protocol=5, buffer_callback=self._buffer_cb)
+        self.buffers: List[pickle.PickleBuffer] = []
+        self._collected_refs = collected_refs
+
+    def _buffer_cb(self, buf: pickle.PickleBuffer) -> bool:
+        self.buffers.append(buf)
+        return False  # do not serialize in-band
+
+    def reducer_override(self, obj):
+        from ray_tpu._private.object_ref import ObjectRef
+
+        if isinstance(obj, ObjectRef):
+            self._collected_refs.append(obj)
+            return (_deserialize_object_ref, (obj.hex(),))
+        return NotImplemented
+
+
+def _deserialize_object_ref(hex_id: str):
+    from ray_tpu._private.object_ref import ObjectRef
+
+    return ObjectRef.from_hex(hex_id)
+
+
+def serialize(value: Any) -> Tuple[bytes, List[memoryview], list]:
+    """Serialize ``value``.
+
+    Returns (meta_blob, raw_buffers, contained_object_refs).  ``meta_blob``
+    is self-contained; ``raw_buffers`` must be written after it per the wire
+    layout above.
+    """
+    f = io.BytesIO()
+    refs: list = []
+    p = _OOBPickler(f, refs)
+    p.dump(value)
+    payload = f.getvalue()
+    views = [b.raw() for b in p.buffers]
+    # buffer table: lengths only; offsets are derived from the layout.
+    table = [len(v.tobytes()) if not v.contiguous else v.nbytes for v in views]
+    meta = pickle.dumps((payload, table), protocol=5)
+    # Non-contiguous buffers are rare (strided views); make them contiguous.
+    out_views = []
+    for v in views:
+        out_views.append(v if v.contiguous else memoryview(v.tobytes()))
+    return meta, out_views, refs
+
+
+def total_size(meta: bytes, buffers: List[memoryview]) -> int:
+    n = _HEADER.size + _pad(len(meta))
+    for b in buffers:
+        n += _pad(b.nbytes)
+    return n
+
+
+def write_into(dest: memoryview, meta: bytes, buffers: List[memoryview]) -> int:
+    """Write the wire layout into ``dest`` (e.g. an shm buffer). Returns bytes written."""
+    off = 0
+    _HEADER.pack_into(dest, off, len(meta))
+    off += _HEADER.size
+    dest[off : off + len(meta)] = meta
+    off = _HEADER.size + _pad(len(meta))
+    for b in buffers:
+        dest[off : off + b.nbytes] = b
+        off += _pad(b.nbytes)
+    return off
+
+
+def to_bytes(meta: bytes, buffers: List[memoryview]) -> bytes:
+    out = bytearray(total_size(meta, buffers))
+    write_into(memoryview(out), meta, buffers)
+    return bytes(out)
+
+
+def deserialize(src: memoryview) -> Any:
+    """Deserialize from the wire layout; buffers are zero-copy views of ``src``."""
+    (meta_len,) = _HEADER.unpack_from(src, 0)
+    meta = bytes(src[_HEADER.size : _HEADER.size + meta_len])
+    payload, table = pickle.loads(meta)
+    off = _HEADER.size + _pad(meta_len)
+    bufs = []
+    for n in table:
+        bufs.append(pickle.PickleBuffer(src[off : off + n]))
+        off += _pad(n)
+    return pickle.loads(payload, buffers=bufs)

@@ -1,0 +1,74 @@
+"""mmap'd /dev/shm segments — the plasma arena analog.
+
+The reference's plasma store serves objects out of a dlmalloc arena built on
+mmap'd /dev/shm (``src/ray/object_manager/plasma/plasma_allocator.h:41``,
+fd-passing in ``fling.cc``).  On Linux a named file in /dev/shm *is* POSIX
+shared memory, so we get the same zero-copy cross-process mapping with plain
+``open`` + ``mmap`` and none of multiprocessing.SharedMemory's
+resource-tracker lifetime hazards.  One segment per object (the reference
+allocates objects inside one arena; per-object segments are simpler and the
+kernel dedups the page-cache either way).
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+
+SHM_DIR = "/dev/shm"
+
+
+class ShmSegment:
+    """A named shared-memory segment holding one sealed object."""
+
+    def __init__(self, name: str, size: int, create: bool):
+        self.name = name
+        self.size = size
+        path = os.path.join(SHM_DIR, name)
+        if create:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            try:
+                os.ftruncate(fd, size)
+                self._mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+        else:
+            fd = os.open(path, os.O_RDWR)
+            try:
+                if size <= 0:
+                    size = os.fstat(fd).st_size
+                    self.size = size
+                self._mm = mmap.mmap(fd, size)
+            finally:
+                os.close(fd)
+
+    @classmethod
+    def create(cls, name: str, size: int) -> "ShmSegment":
+        return cls(name, size, create=True)
+
+    @classmethod
+    def attach(cls, name: str, size: int = -1) -> "ShmSegment":
+        return cls(name, size, create=False)
+
+    @property
+    def buf(self) -> memoryview:
+        return memoryview(self._mm)
+
+    def close(self) -> None:
+        try:
+            self._mm.close()
+        except (BufferError, ValueError):
+            # Exported zero-copy views still alive; mapping will be dropped
+            # at process exit (matches plasma clients holding mmaps open).
+            pass
+
+    @staticmethod
+    def unlink(name: str) -> None:
+        try:
+            os.unlink(os.path.join(SHM_DIR, name))
+        except FileNotFoundError:
+            pass
+
+    @staticmethod
+    def exists(name: str) -> bool:
+        return os.path.exists(os.path.join(SHM_DIR, name))

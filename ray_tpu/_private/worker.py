@@ -1,0 +1,333 @@
+"""Worker/driver runtime: the process-local half of the core API.
+
+Combines the roles of the reference's Python worker
+(``python/ray/_private/worker.py`` — global ``Worker`` singleton, ``init``,
+``get/put/wait``) and the Cython task-execution callback
+(``python/ray/_raylet.pyx:680`` ``execute_task``): argument resolution,
+function-table fetch on miss (``FunctionActorManager``,
+``python/ray/_private/function_manager.py:56``), running the user function,
+and storing returns.  Also builds task specs (TaskSpecBuilder analog,
+``src/ray/common/task/task_spec.h``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import queue
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ray_tpu._private import serialization
+from ray_tpu._private.client import CoreClient
+from ray_tpu._private.config import get_config
+from ray_tpu._private.object_ref import ObjectRef, new_id
+from ray_tpu._private.object_store import ObjectLocation, read_value, store_value
+
+FN_NAMESPACE = "fn"
+
+
+class _ArgPlaceholder:
+    """Marks a top-level ObjectRef argument resolved by the head before dispatch."""
+
+    __slots__ = ("oid",)
+
+    def __init__(self, oid: bytes):
+        self.oid = oid
+
+    def __reduce__(self):
+        return (_ArgPlaceholder, (self.oid,))
+
+
+class Worker:
+    """Process-global runtime state (driver or worker mode)."""
+
+    def __init__(self):
+        self.mode: Optional[str] = None  # "driver" | "worker"
+        self.client: Optional[CoreClient] = None
+        self.node: Optional["Node"] = None  # driver only: in-process head
+        self.node_id: str = ""
+        self.worker_id: bytes = b""
+        self.function_cache: Dict[bytes, Any] = {}
+        self.registered_fn_ids: set = set()
+        self.current_task_id: Optional[bytes] = None
+        self.current_actor_id: Optional[bytes] = None
+        self.actor_instance: Any = None
+        self.task_depth: int = 0
+
+    @property
+    def connected(self) -> bool:
+        return self.client is not None
+
+    # ------------------------------------------------------------------
+    # objects
+    # ------------------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        ref = ObjectRef.random()
+        loc, contained = store_value(ref, value)
+        self.client.seal(ref.binary(), loc, [r.binary() for r in contained])
+        return ref
+
+    def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        from ray_tpu.exceptions import GetTimeoutError
+
+        oids = [r.binary() for r in refs]
+        blocked = self.mode == "worker" and self.task_depth > 0
+        if blocked:
+            self.client.notify_blocked()
+        try:
+            locations = self.client.get_locations(list(set(oids)), timeout)
+        finally:
+            if blocked:
+                self.client.notify_unblocked()
+        if locations is None:
+            raise GetTimeoutError(f"Get timed out after {timeout}s for {len(oids)} objects")
+        return [read_value(locations[oid]) for oid in oids]
+
+    def wait(
+        self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float]
+    ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        oids = [r.binary() for r in refs]
+        blocked = self.mode == "worker" and self.task_depth > 0
+        if blocked:
+            self.client.notify_blocked()
+        try:
+            ready_ids, _ = self.client.wait(oids, num_returns, timeout)
+        finally:
+            if blocked:
+                self.client.notify_unblocked()
+        ready_set = set(ready_ids)
+        ready, not_ready = [], []
+        for r in refs:
+            (ready if r.binary() in ready_set and len(ready) < num_returns else not_ready).append(r)
+        return ready, not_ready
+
+    # ------------------------------------------------------------------
+    # task specs
+    # ------------------------------------------------------------------
+    def register_function(self, blob: bytes) -> bytes:
+        fn_id = hashlib.sha1(blob).digest()
+        if fn_id not in self.registered_fn_ids:
+            self.client.kv_put(FN_NAMESPACE, fn_id, blob)
+            self.registered_fn_ids.add(fn_id)
+        return fn_id
+
+    def fetch_function(self, fn_id: bytes) -> Any:
+        fn = self.function_cache.get(fn_id)
+        if fn is None:
+            blob = self.client.kv_get(FN_NAMESPACE, fn_id)
+            if blob is None:
+                raise RuntimeError(f"function {fn_id.hex()} not found in GCS KV")
+            fn = cloudpickle.loads(blob)
+            self.function_cache[fn_id] = fn
+        return fn
+
+    def build_task_spec(
+        self,
+        *,
+        name: str,
+        fn_id: Optional[bytes],
+        args: tuple,
+        kwargs: dict,
+        num_returns: int,
+        resources: Dict[str, float],
+        scheduling_strategy: Optional[dict] = None,
+        max_retries: int = 0,
+        actor_id: Optional[bytes] = None,
+        method_name: Optional[str] = None,
+        is_actor_creation: bool = False,
+        max_restarts: int = 0,
+        actor_name: Optional[str] = None,
+        runtime_env: Optional[dict] = None,
+    ) -> Tuple[dict, List[ObjectRef]]:
+        cfg = get_config()
+        dep_ids: List[bytes] = []
+
+        def _convert(v):
+            if isinstance(v, ObjectRef):
+                dep_ids.append(v.binary())
+                return _ArgPlaceholder(v.binary())
+            return v
+
+        conv_args = tuple(_convert(a) for a in args)
+        conv_kwargs = {k: _convert(v) for k, v in kwargs.items()}
+        meta, buffers, contained = serialization.serialize((conv_args, conv_kwargs))
+        if contained:
+            self.client.add_refs([r.binary() for r in contained])
+        total = serialization.total_size(meta, buffers)
+        if total <= cfg.max_direct_call_object_size:
+            args_blob = serialization.to_bytes(meta, buffers)
+            args_oid = None
+        else:
+            # big args travel via the object store, not the control socket
+            big_ref = ObjectRef.random()
+            loc, _ = store_value(big_ref, (conv_args, conv_kwargs))
+            self.client.seal(big_ref.binary(), loc, [])
+            args_blob = None
+            args_oid = big_ref.binary()
+            dep_ids.append(args_oid)
+        task_id = new_id()
+        return_ids = [new_id() for _ in range(num_returns)]
+        spec = {
+            "task_id": task_id,
+            "name": name,
+            "fn_id": fn_id,
+            "args_blob": args_blob,
+            "args_oid": args_oid,
+            "dep_ids": dep_ids,
+            "return_ids": return_ids,
+            "num_returns": num_returns,
+            "resources": dict(resources),
+            "scheduling_strategy": scheduling_strategy,
+            "retries_left": max_retries,
+            "actor_id": actor_id,
+            "method_name": method_name,
+            "is_actor_creation": is_actor_creation,
+            "max_restarts": max_restarts,
+            "actor_name": actor_name,
+            "runtime_env": runtime_env,
+        }
+        return spec, [ObjectRef(oid) for oid in return_ids]
+
+
+global_worker = Worker()
+
+
+# ---------------------------------------------------------------------------
+# Task execution (worker process)
+# ---------------------------------------------------------------------------
+
+def _resolve_args(spec: dict, dep_locs: Dict[bytes, ObjectLocation]) -> Tuple[tuple, dict]:
+    if spec.get("args_oid"):
+        conv_args, conv_kwargs = read_value(dep_locs[spec["args_oid"]])
+    else:
+        conv_args, conv_kwargs = serialization.deserialize(memoryview(spec["args_blob"]))
+
+    def _resolve(v):
+        if isinstance(v, _ArgPlaceholder):
+            return read_value(dep_locs[v.oid])
+        return v
+
+    args = tuple(_resolve(a) for a in conv_args)
+    kwargs = {k: _resolve(v) for k, v in conv_kwargs.items()}
+    return args, kwargs
+
+
+def _execute_task(msg: dict) -> None:
+    from ray_tpu.exceptions import RayTaskError
+
+    w = global_worker
+    spec = msg["spec"]
+    dep_locs = msg.get("dep_locs", {})
+    tpu_ids = msg.get("tpu_ids", [])
+    if tpu_ids and "TPU_VISIBLE_CHIPS" not in os.environ:
+        os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in tpu_ids)
+        os.environ["RAY_TPU_ASSIGNED_TPUS"] = os.environ["TPU_VISIBLE_CHIPS"]
+    w.current_task_id = spec["task_id"]
+    failed = False
+    error_str = None
+    try:
+        args, kwargs = _resolve_args(spec, dep_locs)
+        if spec.get("is_actor_creation"):
+            cls = w.fetch_function(spec["fn_id"])
+            w.task_depth += 1
+            try:
+                w.actor_instance = cls(*args, **kwargs)
+            finally:
+                w.task_depth -= 1
+            w.current_actor_id = spec["actor_id"]
+            results = [None]
+        elif spec.get("actor_id") is not None:
+            method = getattr(w.actor_instance, spec["method_name"])
+            w.task_depth += 1
+            try:
+                out = method(*args, **kwargs)
+            finally:
+                w.task_depth -= 1
+            results = _split_returns(out, spec["num_returns"])
+        else:
+            fn = w.fetch_function(spec["fn_id"])
+            w.task_depth += 1
+            try:
+                out = fn(*args, **kwargs)
+            finally:
+                w.task_depth -= 1
+            results = _split_returns(out, spec["num_returns"])
+    except BaseException as e:  # noqa: BLE001
+        failed = True
+        tb = traceback.format_exc()
+        error_str = f"{type(e).__name__}: {e}"
+        err = e if isinstance(e, RayTaskError) else RayTaskError(
+            f"Task {spec.get('name')} failed:\n{tb}", cause=e
+        )
+        results = [err] * spec["num_returns"]
+    for oid, value in zip(spec["return_ids"], results):
+        ref = ObjectRef(oid)
+        try:
+            loc, contained = store_value(ref, value, is_error=failed)
+        except BaseException as e:  # unserializable result
+            loc, contained = store_value(
+                ref, RayTaskError(f"Failed to serialize result of {spec.get('name')}: {e}"),
+                is_error=True,
+            )
+        w.client.seal(oid, loc, [r.binary() for r in contained])
+    w.client.send({
+        "type": "task_done",
+        "spec_ref": {
+            "task_id": spec["task_id"],
+            "return_ids": spec["return_ids"],
+            "is_actor_creation": spec.get("is_actor_creation"),
+            "actor_id": spec.get("actor_id"),
+            "name": spec.get("name"),
+        },
+        "failed": failed,
+        "error_str": error_str,
+    })
+    w.current_task_id = None
+
+
+def _split_returns(out: Any, num_returns: int) -> List[Any]:
+    if num_returns == 1:
+        return [out]
+    if not isinstance(out, (tuple, list)) or len(out) != num_returns:
+        raise ValueError(
+            f"Task declared num_returns={num_returns} but returned {type(out)}"
+        )
+    return list(out)
+
+
+def main() -> None:
+    """Worker process entry point (python -m ray_tpu._private.worker)."""
+    address = os.environ["RAY_TPU_ADDRESS"]
+    authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+    node_id = os.environ["RAY_TPU_NODE_ID"]
+    worker_id = bytes.fromhex(os.environ["RAY_TPU_WORKER_ID"])
+
+    w = global_worker
+    w.mode = "worker"
+    w.node_id = node_id
+    w.worker_id = worker_id
+    client = CoreClient(address, authkey, worker_id=worker_id, node_id=node_id)
+    client._exec_queue = queue.Queue()
+    w.client = client
+    client.register_worker()
+
+    while True:
+        msg = client._exec_queue.get()
+        if msg["type"] == "exit":
+            break
+        if msg["type"] == "execute":
+            _execute_task(msg)
+    client.close()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    # Delegate to the canonical module so classes defined here are not
+    # duplicated under the __main__ module name (placeholder identity).
+    from ray_tpu._private.worker import main as _canonical_main
+
+    _canonical_main()

@@ -1,0 +1,152 @@
+"""Actors: ``ActorClass``, ``ActorHandle``, ``ActorMethod``.
+
+Analog of ``python/ray/actor.py`` (``ActorClass._remote`` at ``actor.py:657``,
+``ActorMethod`` at ``:92``, ``ActorHandle`` at ``:1020``).  Creation goes
+through the head's GCS-style actor FSM; method calls are ordered per-actor
+(the reference orders per-caller via sequence numbers in
+``CoreWorkerDirectActorTaskSubmitter``; routing everything through the head
+gives a single total order, which is strictly stronger).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import cloudpickle
+
+from ray_tpu._private import ray_option_utils
+from ray_tpu._private.object_ref import ObjectRef, new_id
+from ray_tpu._private.worker import global_worker
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._submit_method(
+            self._method_name, args, kwargs, self._num_returns
+        )
+
+    def options(self, num_returns: int = 1, **_):
+        return ActorMethod(self._handle, self._method_name, num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method {self._method_name!r} must be invoked with .remote()"
+        )
+
+
+class ActorHandle:
+    def __init__(self, actor_id: bytes, class_name: str, method_num_returns: Optional[Dict[str, int]] = None):
+        self._actor_id = actor_id
+        self._class_name = class_name
+        self._method_num_returns = method_num_returns or {}
+
+    @property
+    def _id_hex(self) -> str:
+        return self._actor_id.hex()
+
+    def __getattr__(self, item: str) -> ActorMethod:
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return ActorMethod(self, item, self._method_num_returns.get(item, 1))
+
+    def _submit_method(self, method_name: str, args, kwargs, num_returns: int):
+        w = global_worker
+        spec, return_refs = w.build_task_spec(
+            name=f"{self._class_name}.{method_name}",
+            fn_id=None,
+            args=args,
+            kwargs=kwargs,
+            num_returns=num_returns,
+            resources={},
+            actor_id=self._actor_id,
+            method_name=method_name,
+        )
+        w.client.submit_actor_task(spec)
+        return return_refs[0] if num_returns == 1 else return_refs
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._actor_id, self._class_name, self._method_num_returns))
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:8]})"
+
+
+def _rebuild_handle(actor_id, class_name, mnr):
+    return ActorHandle(actor_id, class_name, mnr)
+
+
+class ActorClass:
+    def __init__(self, cls: type, default_options: Dict[str, Any]):
+        self._cls = cls
+        self._default_options = ray_option_utils.validate_options(default_options, for_actor=True)
+        self._class_blob: Optional[bytes] = None
+        self.__name__ = cls.__name__
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote(...)"
+        )
+
+    def options(self, **options) -> "_ActorClassWrapper":
+        merged = dict(self._default_options)
+        merged.update(ray_option_utils.validate_options(options, for_actor=True))
+        return _ActorClassWrapper(self, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._remote(args, kwargs, self._default_options)
+
+    def _remote(self, args, kwargs, options: Dict[str, Any]) -> ActorHandle:
+        from ray_tpu.remote_function import _strategy_to_dict
+
+        w = global_worker
+        if not w.connected:
+            import ray_tpu
+
+            ray_tpu.init()
+        if self._class_blob is None:
+            self._class_blob = cloudpickle.dumps(self._cls)
+        fn_id = w.register_function(self._class_blob)
+        actor_id = new_id()
+        # Actors default to 1 CPU for placement but hold 0 while idle in the
+        # reference; we hold what was requested for the actor's lifetime.
+        resources = ray_option_utils.resources_from_options(options, default_num_cpus=1)
+        spec, return_refs = w.build_task_spec(
+            name=f"{self._cls.__name__}.__init__",
+            fn_id=fn_id,
+            args=args,
+            kwargs=kwargs,
+            num_returns=1,
+            resources=resources,
+            scheduling_strategy=_strategy_to_dict(options.get("scheduling_strategy")),
+            actor_id=actor_id,
+            is_actor_creation=True,
+            max_restarts=options.get("max_restarts", 0),
+            actor_name=options.get("name"),
+            runtime_env=options.get("runtime_env"),
+        )
+        w.client.create_actor(spec)
+        return ActorHandle(actor_id, self._cls.__name__)
+
+
+class _ActorClassWrapper:
+    def __init__(self, ac: ActorClass, options: Dict[str, Any]):
+        self._ac = ac
+        self._options = options
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._ac._remote(args, kwargs, self._options)
+
+
+def get_actor(name: str) -> ActorHandle:
+    """Look up a named actor (``ray.get_actor`` analog)."""
+    w = global_worker
+    aid, _ = w.client.get_actor_by_name(name)
+    if aid is None:
+        raise ValueError(f"Failed to look up actor with name '{name}'")
+    return ActorHandle(aid, name)

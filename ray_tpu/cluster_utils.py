@@ -1,0 +1,48 @@
+"""Fake multi-node cluster for tests.
+
+Analog of the reference's single most load-bearing test asset
+(``python/ray/cluster_utils.py:99`` ``Cluster``, ``add_node`` at ``:165``):
+multiple raylet node-states with distinct ids/resources inside one head
+process, so scheduling spread, placement-group strategies, node affinity and
+node-death behavior are testable on one machine (SURVEY §4.2).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+import ray_tpu
+from ray_tpu._private.worker import global_worker
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True, head_node_args: Optional[dict] = None):
+        self._node_counter = itertools.count(1)
+        self.node_ids: List[str] = []
+        if initialize_head:
+            args = dict(head_node_args or {})
+            ray_tpu.init(**args)
+            self.node_ids.append(global_worker.node._head_node_id)
+
+    def add_node(
+        self,
+        num_cpus: int = 1,
+        num_tpus: int = 0,
+        resources: Optional[Dict[str, float]] = None,
+        env: Optional[Dict[str, str]] = None,
+    ) -> str:
+        node = global_worker.node
+        node_id = f"node-{next(self._node_counter)}"
+        total = dict(resources or {})
+        total["CPU"] = float(num_cpus)
+        total["TPU"] = float(num_tpus)
+        node.add_node_state(node_id, total, tpu_ids=list(range(num_tpus)), env=env)
+        self.node_ids.append(node_id)
+        return node_id
+
+    def remove_node(self, node_id: str) -> None:
+        global_worker.node.remove_node_state(node_id)
+
+    def shutdown(self) -> None:
+        ray_tpu.shutdown()

@@ -1,0 +1,36 @@
+"""Runtime context (analog of ``python/ray/runtime_context.py``)."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from ray_tpu._private.worker import global_worker
+
+
+class RuntimeContext:
+    @property
+    def node_id(self) -> str:
+        return global_worker.node_id
+
+    @property
+    def worker_id(self) -> Optional[bytes]:
+        return global_worker.worker_id or None
+
+    @property
+    def task_id(self) -> Optional[bytes]:
+        return global_worker.current_task_id
+
+    @property
+    def actor_id(self) -> Optional[bytes]:
+        return global_worker.current_actor_id
+
+    def get_tpu_ids(self) -> List[int]:
+        """Chips assigned to the current task/actor (CUDA_VISIBLE_DEVICES analog:
+        the raylet exports TPU_VISIBLE_CHIPS, see node.py actor spawn)."""
+        raw = os.environ.get("RAY_TPU_ASSIGNED_TPUS", "")
+        return [int(x) for x in raw.split(",") if x.strip()]
+
+
+def get_runtime_context() -> RuntimeContext:
+    return RuntimeContext()

@@ -1,0 +1,45 @@
+"""Test fixtures.
+
+Mirrors the reference's ``python/ray/tests/conftest.py``:
+``ray_start_regular`` (reference ``conftest.py:245``) boots a real
+single-node runtime in-process; ``ray_start_cluster`` (``conftest.py:326``)
+gives the fake multi-node Cluster.  JAX tests run on a virtual 8-device CPU
+mesh (``xla_force_host_platform_device_count``) per SURVEY §4's TPU note.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture
+def ray_start_regular():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_2_tpus():
+    """Single node with 2 fake TPU chips (chips are only env-assigned)."""
+    ray_tpu.init(num_cpus=4, num_tpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True, head_node_args={"num_cpus": 2, "num_tpus": 0})
+    yield cluster
+    cluster.shutdown()

@@ -1,0 +1,119 @@
+"""Actor API tests (reference: python/ray/tests/test_actor.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RayActorError, RayTaskError
+
+
+@ray_tpu.remote
+class Counter:
+    def __init__(self, start=0):
+        self.x = start
+
+    def inc(self, by=1):
+        self.x += by
+        return self.x
+
+    def value(self):
+        return self.x
+
+    def crash(self):
+        import os
+
+        os._exit(1)
+
+
+def test_actor_basic(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    assert ray_tpu.get(c.inc.remote(5)) == 6
+    assert ray_tpu.get(c.value.remote()) == 6
+
+
+def test_actor_constructor_args(ray_start_regular):
+    c = Counter.remote(start=100)
+    assert ray_tpu.get(c.value.remote()) == 100
+
+
+def test_actor_method_ordering(ray_start_regular):
+    c = Counter.remote()
+    refs = [c.inc.remote() for _ in range(20)]
+    assert ray_tpu.get(refs) == list(range(1, 21))
+
+
+def test_two_actors_isolated(ray_start_regular):
+    a, b = Counter.remote(), Counter.remote(start=10)
+    ray_tpu.get([a.inc.remote(), b.inc.remote()])
+    assert ray_tpu.get(a.value.remote()) == 1
+    assert ray_tpu.get(b.value.remote()) == 11
+
+
+def test_actor_method_error(ray_start_regular):
+    @ray_tpu.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor boom")
+
+    b = Bad.remote()
+    with pytest.raises(RayTaskError, match="actor boom"):
+        ray_tpu.get(b.boom.remote())
+    # actor still alive after an application error (raises again, not dead)
+    with pytest.raises(RayTaskError, match="actor boom"):
+        ray_tpu.get(b.boom.remote(), timeout=60)
+
+
+def test_actor_death_raises(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    ref = c.crash.remote()
+    with pytest.raises((RayActorError,)):
+        ray_tpu.get(ref, timeout=60)
+    with pytest.raises(RayActorError):
+        ray_tpu.get(c.inc.remote(), timeout=60)
+
+
+def test_actor_restart(ray_start_regular):
+    c = Counter.options(max_restarts=1).remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    try:
+        ray_tpu.get(c.crash.remote(), timeout=60)
+    except RayActorError:
+        pass
+    # after restart, state is fresh (reconstructed from the creation spec)
+    import time
+
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        try:
+            assert ray_tpu.get(c.inc.remote(), timeout=30) == 1
+            break
+        except RayActorError:
+            time.sleep(0.5)
+    else:
+        raise AssertionError("actor never came back")
+
+
+def test_named_actor(ray_start_regular):
+    Counter.options(name="global_counter").remote(start=7)
+    h = ray_tpu.get_actor("global_counter")
+    assert ray_tpu.get(h.value.remote()) == 7
+
+
+def test_pass_actor_handle(ray_start_regular):
+    c = Counter.remote()
+
+    @ray_tpu.remote
+    def use(handle):
+        return ray_tpu.get(handle.inc.remote(10))
+
+    assert ray_tpu.get(use.remote(c)) == 10
+    assert ray_tpu.get(c.value.remote()) == 10
+
+
+def test_kill_actor(ray_start_regular):
+    c = Counter.remote()
+    assert ray_tpu.get(c.inc.remote()) == 1
+    ray_tpu.kill(c)
+    with pytest.raises(RayActorError):
+        ray_tpu.get(c.inc.remote(), timeout=60)

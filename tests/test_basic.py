@@ -1,0 +1,155 @@
+"""Core task API tests (reference: python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, RayTaskError
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+    ref = ray_tpu.put({"a": [1, 2, 3], "b": "hello"})
+    assert ray_tpu.get(ref) == {"a": [1, 2, 3], "b": "hello"}
+
+
+def test_put_get_numpy_zero_copy(ray_start_regular):
+    arr = np.random.rand(1024, 1024)  # 8 MB -> shm path
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref)
+    np.testing.assert_array_equal(arr, out)
+    # zero-copy: shared-memory-backed, read-only view
+    assert not out.flags.writeable or out.base is not None
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1)) == 2
+
+
+def test_task_with_object_arg(ray_start_regular):
+    @ray_tpu.remote
+    def f(x, y):
+        return x + y
+
+    a = ray_tpu.put(10)
+    b = f.remote(a, 5)
+    assert ray_tpu.get(b) == 15
+
+
+def test_task_chain(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(9):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 10
+
+
+def test_many_parallel_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(50)]
+    assert ray_tpu.get(refs) == [i * i for i in range(50)]
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom")
+
+    with pytest.raises(RayTaskError, match="boom"):
+        ray_tpu.get(boom.remote())
+
+
+def test_error_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("boom")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises((RayTaskError, ValueError)):
+        ray_tpu.get(consume.remote(boom.remote()))
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(20)
+        return "slow"
+
+    ray_tpu.get(fast.remote())  # warm the worker pool first
+    f, s = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([f, s], num_returns=1, timeout=15)
+    assert ready == [f]
+    assert not_ready == [s]
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(30)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=1.0)
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray_tpu.get(outer.remote(10)) == 21
+
+
+def test_large_arg_roundtrip(ray_start_regular):
+    arr = np.arange(1_000_000, dtype=np.float64)
+
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    assert ray_tpu.get(total.remote(arr)) == float(arr.sum())
+
+
+def test_options_name_and_resources(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(name="custom", num_cpus=2).remote()) == 1
+
+
+def test_cluster_resources(ray_start_regular):
+    res = ray_tpu.cluster_resources()
+    assert res["CPU"] == 4.0

@@ -1,0 +1,90 @@
+"""Placement-group + multi-node scheduling tests
+(reference: python/ray/tests/test_placement_group.py, test_scheduling.py)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+
+def test_pg_create_ready(ray_start_regular):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(30)
+
+
+def test_pg_infeasible_pending(ray_start_regular):
+    pg = placement_group([{"CPU": 100}], strategy="STRICT_PACK")
+    assert not pg.wait(1.0)
+
+
+def test_pg_task_scheduling(ray_start_regular):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(30)
+
+    @ray_tpu.remote
+    def f():
+        return ray_tpu.get_runtime_context().node_id
+
+    strat = PlacementGroupSchedulingStrategy(placement_group=pg, placement_group_bundle_index=0)
+    nid = ray_tpu.get(f.options(scheduling_strategy=strat).remote())
+    assert nid == "node-head"
+    remove_placement_group(pg)
+
+
+def test_strict_spread_needs_nodes(ray_start_cluster):
+    cluster = ray_start_cluster
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    assert not pg.wait(1.0)  # only one node alive
+    cluster.add_node(num_cpus=2)
+    assert pg.wait(30)
+    table_nodes = pg.bundle_count
+    assert table_nodes == 2
+
+
+def test_node_affinity(ray_start_cluster):
+    cluster = ray_start_cluster
+    nid = cluster.add_node(num_cpus=2)
+
+    @ray_tpu.remote
+    def where():
+        return ray_tpu.get_runtime_context().node_id
+
+    strat = NodeAffinitySchedulingStrategy(node_id=nid)
+    assert ray_tpu.get(where.options(scheduling_strategy=strat).remote(), timeout=90) == nid
+
+
+def test_tpu_resource_scheduling(ray_start_2_tpus):
+    @ray_tpu.remote(num_tpus=1)
+    def which_chips():
+        return ray_tpu.get_runtime_context().get_tpu_ids()
+
+    chips = ray_tpu.get([which_chips.remote(), which_chips.remote()])
+    # each invocation gets exactly one distinct chip id (isolation by env)
+    assert all(len(c) == 1 for c in chips)
+    res = ray_tpu.cluster_resources()
+    assert res["TPU"] == 2.0
+
+
+def test_tpu_actor_env_isolation(ray_start_2_tpus):
+    @ray_tpu.remote(num_tpus=1)
+    class TpuActor:
+        def chips(self):
+            import os
+
+            return os.environ.get("TPU_VISIBLE_CHIPS")
+
+    a, b = TpuActor.remote(), TpuActor.remote()
+    ca, cb = ray_tpu.get([a.chips.remote(), b.chips.remote()])
+    assert ca is not None and cb is not None and ca != cb
+
+
+def test_tpu_oversubscription_queues(ray_start_2_tpus):
+    @ray_tpu.remote(num_tpus=2)
+    def both():
+        return sorted(ray_tpu.get_runtime_context().get_tpu_ids())
+
+    assert ray_tpu.get(both.remote(), timeout=120) == [0, 1]

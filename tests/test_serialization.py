@@ -1,0 +1,90 @@
+"""Serialization + shm unit tests (no processes; reference: plasma tests +
+python/ray/tests/test_serialization.py)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private import serialization
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.shm import ShmSegment
+
+
+def _roundtrip(value):
+    meta, bufs, refs = serialization.serialize(value)
+    blob = serialization.to_bytes(meta, bufs)
+    return serialization.deserialize(memoryview(blob)), refs
+
+
+def test_roundtrip_primitives():
+    for v in [None, 1, 1.5, "s", b"bytes", [1, 2], {"a": (1, 2)}, {1, 2}]:
+        out, _ = _roundtrip(v)
+        assert out == v
+
+
+def test_roundtrip_numpy_zero_copy():
+    arr = np.random.rand(256, 256)
+    out, _ = _roundtrip(arr)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_roundtrip_numpy_dtypes():
+    for dt in [np.float32, np.int8, np.uint16, np.bool_]:
+        arr = np.ones((33, 7), dtype=dt)
+        out, _ = _roundtrip(arr)
+        assert out.dtype == dt
+        np.testing.assert_array_equal(arr, out)
+
+
+def test_noncontiguous_array():
+    arr = np.arange(100).reshape(10, 10)[:, ::2]
+    out, _ = _roundtrip(arr)
+    np.testing.assert_array_equal(arr, out)
+
+
+def test_object_refs_collected():
+    r1, r2 = ObjectRef.random(), ObjectRef.random()
+    out, refs = _roundtrip({"refs": [r1, r2]})
+    assert out["refs"] == [r1, r2]
+    assert set(refs) == {r1, r2}
+
+
+def test_shm_segment_roundtrip():
+    name = f"rtpu-test-{ObjectRef.random().hex()}"
+    seg = ShmSegment.create(name, 4096)
+    try:
+        seg.buf[:5] = b"hello"
+        seg2 = ShmSegment.attach(name)
+        assert bytes(seg2.buf[:5]) == b"hello"
+        seg2.close()
+    finally:
+        seg.close()
+        ShmSegment.unlink(name)
+    assert not ShmSegment.exists(name)
+
+
+def test_store_value_inline_vs_shm():
+    from ray_tpu._private.object_store import read_value, store_value
+    from ray_tpu._private.shm import ShmSegment
+
+    small_ref = ObjectRef.random()
+    loc, _ = store_value(small_ref, [1, 2, 3])
+    assert loc.inline is not None
+    assert read_value(loc) == [1, 2, 3]
+
+    big_ref = ObjectRef.random()
+    arr = np.random.rand(512, 512)  # 2 MB
+    loc, _ = store_value(big_ref, arr)
+    assert loc.shm_name is not None
+    try:
+        np.testing.assert_array_equal(read_value(loc), arr)
+    finally:
+        ShmSegment.unlink(loc.shm_name)
+
+
+def test_error_objects_raise():
+    from ray_tpu._private.object_store import read_value, store_value
+
+    ref = ObjectRef.random()
+    loc, _ = store_value(ref, ValueError("stored error"), is_error=True)
+    with pytest.raises(ValueError, match="stored error"):
+        read_value(loc)
