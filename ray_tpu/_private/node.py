@@ -128,6 +128,9 @@ class BundleRuntime:
     node_id: str
     reserved: Dict[str, float]
     available: Dict[str, float]
+    # Set when the owning placement group is removed: releases of resources
+    # still held by in-flight tasks then go back to the node, not the bundle.
+    detached: bool = False
 
 
 @dataclass
@@ -380,7 +383,10 @@ class Node:
             ns = self.nodes.get(h.node_id)
             if ns is not None:
                 ns.starting = max(0, ns.starting - 1)
-                ns.idle.append(h)
+                # Dedicated actor workers never join the general idle pool —
+                # they only ever run their actor's tasks.
+                if not h.is_actor_worker:
+                    ns.idle.append(h)
             self.cond.notify_all()
         return h
 
@@ -563,6 +569,11 @@ class Node:
             if pgrt is None or pgrt.info.state != "CREATED":
                 return None
             idx = strategy.get("bundle_index", -1)
+            if idx >= len(pgrt.bundles):
+                raise ValueError(
+                    f"placement group bundle index {idx} out of range "
+                    f"({len(pgrt.bundles)} bundles)"
+                )
             candidates = pgrt.bundles if idx < 0 else [pgrt.bundles[idx]]
             for b in candidates:
                 ns = self.nodes.get(b.node_id)
@@ -605,12 +616,20 @@ class Node:
         # phase 1: move pending tasks to a node's ready queue (resources held)
         with self.lock:
             still_pending = deque()
+            failed_specs = []
             while self.pending_tasks:
                 spec = self.pending_tasks.popleft()
                 if not self._deps_ready(spec):
                     still_pending.append(spec)
                     continue
-                sel = self._select_node(spec)
+                try:
+                    sel = self._select_node(spec)
+                except Exception as e:
+                    # A bad scheduling strategy (e.g. bundle index out of
+                    # range) fails only this task — the error is sealed into
+                    # its returns so the caller sees it on get().
+                    failed_specs.append((spec, e))
+                    continue
                 if sel is None:
                     still_pending.append(spec)
                     continue
@@ -624,6 +643,9 @@ class Node:
                     tpu_ids = [ns.tpu_free.pop() for _ in range(min(n_tpu, len(ns.tpu_free)))]
                 ns.ready_queue.append((spec, tpu_ids, bundle))
             self.pending_tasks = still_pending
+        for spec, e in failed_specs:
+            self._seal_error_returns(spec, e)
+        with self.lock:
             # phase 2: dispatch ready tasks to idle workers; spawn if needed
             for ns in self.nodes.values():
                 if not ns.alive:
@@ -681,7 +703,8 @@ class Node:
             if rt["worker"].blocked:
                 held[CPU] = held.get(CPU, 0.0) - held.get(CPU, 0.0)  # CPUs already released
                 rt["worker"].blocked = False
-            pool = rt["bundle"].available if rt.get("bundle") is not None else ns.available
+            bundle = rt.get("bundle")
+            pool = bundle.available if bundle is not None and not bundle.detached else ns.available
             _release(held, pool)
             ns.tpu_free.extend(rt.get("tpu_ids", []))
             self.cond.notify_all()
@@ -862,7 +885,8 @@ class Node:
             # release resources
             ns = self.nodes.get(art.node_id) if art.node_id else None
             if ns is not None and art.held:
-                pool = art.bundle.available if getattr(art, "bundle", None) is not None else ns.available
+                bundle = getattr(art, "bundle", None)
+                pool = bundle.available if bundle is not None and not bundle.detached else ns.available
                 _release(art.held, pool)
                 ns.tpu_free.extend(art.tpu_ids)
                 art.held = {}
@@ -888,6 +912,8 @@ class Node:
             self._seal_error_returns(spec, err)
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
+        from ray_tpu.exceptions import RayActorError
+
         with self.lock:
             art = self.actors.get(actor_id)
             if art is None:
@@ -895,6 +921,30 @@ class Node:
             if no_restart:
                 art.info.max_restarts = art.info.num_restarts  # disable restart
             w = art.worker
+            failed_specs = []
+            if w is None and no_restart and art.info.state != "DEAD":
+                # Killed before its worker ever spawned: fail it in place so
+                # it doesn't get scheduled later and run forever.
+                art.info.state = "DEAD"
+                art.info.death_cause = "killed before creation"
+                failed_specs = list(art.queue)
+                art.queue.clear()
+                ns = self.nodes.get(art.node_id) if art.node_id else None
+                if ns is not None and art.held:
+                    bundle = getattr(art, "bundle", None)
+                    pool = (
+                        bundle.available
+                        if bundle is not None and not bundle.detached
+                        else ns.available
+                    )
+                    _release(art.held, pool)
+                    ns.tpu_free.extend(art.tpu_ids)
+                    art.held = {}
+                    art.tpu_ids = []
+                self.cond.notify_all()
+        err = RayActorError(f"Actor {art.info.class_name} was killed before creation")
+        for spec in failed_specs:
+            self._seal_error_returns(spec, err)
         if w is not None and w.proc is not None:
             try:
                 w.proc.kill()
@@ -994,11 +1044,14 @@ class Node:
                 return
             rt.info.state = "REMOVED"
             for b in rt.bundles:
+                b.detached = True
                 ns = self.nodes.get(b.node_id)
                 if ns is not None:
-                    # return only unconsumed capacity plus consumed-by-dead tasks:
-                    # consumed capacity is returned when those tasks finish.
+                    # return unconsumed capacity now; capacity consumed by
+                    # still-running tasks flows back to the node when they
+                    # finish (the detached flag reroutes their release).
                     _release(b.available, ns.available)
+                    b.available = {}
             self.cond.notify_all()
 
     # ------------------------------------------------------------------

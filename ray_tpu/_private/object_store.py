@@ -66,11 +66,20 @@ class ObjectRegistry:
             self._objects.setdefault(oid, _Entry())
 
     def seal(self, oid: bytes, loc: ObjectLocation) -> None:
+        unlink = None
         with self._lock:
             e = self._objects.setdefault(oid, _Entry())
-            e.loc = loc
-            self._bytes_used += loc.size
+            if e.sealed.is_set():
+                # First seal wins (objects are immutable).  A re-seal happens
+                # when a task retried after its worker sealed a return and
+                # then crashed — drop the duplicate payload.
+                unlink = loc.shm_name
+            else:
+                e.loc = loc
+                self._bytes_used += loc.size
         e.sealed.set()
+        if unlink:
+            ShmSegment.unlink(unlink)
 
     def is_sealed(self, oid: bytes) -> bool:
         with self._lock:

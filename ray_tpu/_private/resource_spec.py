@@ -47,4 +47,11 @@ def autodetect_resources(
         total.setdefault("memory", float(psutil.virtual_memory().available))
     except Exception:
         total.setdefault("memory", 8.0 * 1024**3)
-    return total, list(range(int(n_tpus)))
+    # Use the real chip ids this process can see, not synthetic ones —
+    # workers are later isolated via TPU_VISIBLE_CHIPS=<these ids>.
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if num_tpus is None and visible:
+        ids = [int(c) for c in visible.split(",") if c.strip()]
+    else:
+        ids = list(range(int(n_tpus)))
+    return total, ids

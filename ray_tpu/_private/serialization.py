@@ -74,14 +74,17 @@ def serialize(value: Any) -> Tuple[bytes, List[memoryview], list]:
     p = _OOBPickler(f, refs)
     p.dump(value)
     payload = f.getvalue()
-    views = [b.raw() for b in p.buffers]
-    # buffer table: lengths only; offsets are derived from the layout.
-    table = [len(v.tobytes()) if not v.contiguous else v.nbytes for v in views]
-    meta = pickle.dumps((payload, table), protocol=5)
-    # Non-contiguous buffers are rare (strided views); make them contiguous.
+    # Non-contiguous buffers are rare (strided views); make them contiguous
+    # once, then the table is just nbytes of each final buffer.
     out_views = []
-    for v in views:
-        out_views.append(v if v.contiguous else memoryview(v.tobytes()))
+    for b in p.buffers:
+        try:
+            v = b.raw()  # flat contiguous view; raises if non-contiguous
+        except BufferError:
+            v = memoryview(memoryview(b).tobytes())
+        out_views.append(v)
+    table = [v.nbytes for v in out_views]
+    meta = pickle.dumps((payload, table), protocol=5)
     return meta, out_views, refs
 
 

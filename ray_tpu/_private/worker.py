@@ -223,9 +223,16 @@ def _execute_task(msg: dict) -> None:
     spec = msg["spec"]
     dep_locs = msg.get("dep_locs", {})
     tpu_ids = msg.get("tpu_ids", [])
-    if tpu_ids and "TPU_VISIBLE_CHIPS" not in os.environ:
+    # Overwrite (not setdefault): a pooled worker may be reused for a task
+    # holding different chips than its previous one.  (jax/libtpu read the
+    # env at first init, so chip isolation is only airtight for dedicated
+    # actor workers — same caveat as CUDA_VISIBLE_DEVICES in the reference.)
+    if tpu_ids:
         os.environ["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in tpu_ids)
         os.environ["RAY_TPU_ASSIGNED_TPUS"] = os.environ["TPU_VISIBLE_CHIPS"]
+    elif "RAY_TPU_ASSIGNED_TPUS" in os.environ and spec.get("actor_id") is None:
+        os.environ.pop("TPU_VISIBLE_CHIPS", None)
+        os.environ.pop("RAY_TPU_ASSIGNED_TPUS", None)
     w.current_task_id = spec["task_id"]
     failed = False
     error_str = None

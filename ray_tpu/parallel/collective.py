@@ -1,0 +1,100 @@
+"""XLA collective primitives over mesh axes.
+
+The tensor plane of the framework (SURVEY §5.8): where the reference calls
+NCCL (``util/collective/collective_group/nccl_collective_group.py:127``),
+TPU code expresses the same collectives *inside* jit/shard_map and XLA
+lowers them onto ICI.  These are thin, named wrappers so library code
+(train backends, ring attention, MoE dispatch) reads like the reference's
+collective API while remaining fully traceable.
+
+All functions must be called inside ``shard_map`` (or a ``pjit`` body with
+manual axes) where ``axis`` is a bound mesh axis name.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Axis = Union[str, Sequence[str]]
+
+
+def allreduce(x: jax.Array, axis: Axis, op: str = "sum") -> jax.Array:
+    """All-reduce over a mesh axis (NCCL allreduce analog)."""
+    if op == "sum":
+        return lax.psum(x, axis)
+    if op == "mean":
+        return lax.pmean(x, axis)
+    if op == "max":
+        return lax.pmax(x, axis)
+    if op == "min":
+        return lax.pmin(x, axis)
+    raise ValueError(f"unsupported reduce op {op!r}")
+
+
+def allgather(x: jax.Array, axis: str, *, tiled: bool = True, gather_axis: int = 0) -> jax.Array:
+    """All-gather shards over a mesh axis (concatenates along gather_axis)."""
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reducescatter(x: jax.Array, axis: str, *, scatter_axis: int = 0, op: str = "sum") -> jax.Array:
+    """Reduce-scatter over a mesh axis (psum_scatter)."""
+    if op not in ("sum", "mean"):
+        raise ValueError(f"unsupported reduce op {op!r}")
+    out = lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+    if op == "mean":
+        out = out / lax.psum(1, axis)
+    return out
+
+
+def broadcast(x: jax.Array, axis: str, root: int = 0) -> jax.Array:
+    """Broadcast the root shard to every member of the axis."""
+    idx = lax.axis_index(axis)
+    masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+    return lax.psum(masked, axis)
+
+
+def all_to_all(
+    x: jax.Array, axis: str, *, split_axis: int, concat_axis: int, tiled: bool = True
+) -> jax.Array:
+    """All-to-all — the Ulysses / MoE-dispatch primitive."""
+    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=tiled)
+
+
+def ppermute_next(x: jax.Array, axis: str, *, shift: int = 1) -> jax.Array:
+    """Rotate shards around the axis ring (ring-attention step).
+
+    Device ``i`` receives the shard of device ``(i - shift) % n``; a ring
+    send/recv pair over ICI neighbours.
+    """
+    n = lax.psum(1, axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def send_recv(x: jax.Array, axis: str, pairs: Sequence[tuple]) -> jax.Array:
+    """Explicit point-to-point permutation (collective send/recv analog)."""
+    return lax.ppermute(x, axis, list(pairs))
+
+
+def axis_index(axis: str) -> jax.Array:
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: Axis) -> int:
+    return lax.psum(1, axis)
+
+
+def barrier(axis: Axis) -> None:
+    """Synchronization point: an all-reduce of a scalar (XLA orders it)."""
+    lax.psum(jnp.zeros((), jnp.int32), axis)
+
+
+def grad_sync(grads, axis: Axis, *, mean: bool = True):
+    """Synchronize a gradient pytree across the data axes (DDP allreduce)."""
+    op = partial(lax.pmean if mean else lax.psum, axis_name=axis)
+    return jax.tree.map(op, grads)

@@ -1,0 +1,129 @@
+"""Device-mesh construction with named parallelism axes.
+
+The TPU-native replacement for the reference's process-group bootstrap
+(``python/ray/train/torch/config.py:69`` ``_setup_torch_process_group`` /
+``dist.init_process_group``): instead of a rank rendezvous, every process
+builds the same ``jax.sharding.Mesh`` over the slice's devices and XLA
+inserts the collectives.  Axis vocabulary follows the scaling-book recipe:
+
+- ``dp``   — pure data parallelism (params replicated)
+- ``fsdp`` — data parallelism with ZeRO-style parameter sharding
+- ``tp``   — tensor (model) parallelism, Megatron-style
+- ``sp``   — sequence/context parallelism (ring attention axis)
+- ``ep``   — expert parallelism for MoE
+- ``pp``   — pipeline stages
+
+On real hardware the mesh should be built so that ``tp``/``sp`` ride ICI
+(innermost, contiguous devices) and ``dp`` can span DCN across slices —
+`create_mesh` orders axes accordingly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: outermost (slowest, OK on DCN) to innermost
+# (fastest, must be ICI).  dp/fsdp across slices is fine; tp/sp never is.
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape: axis name -> size.
+
+    ``-1`` for at most one axis means "all remaining devices".
+
+    Example::
+
+        MeshSpec(dp=-1, tp=4).build()   # 2D mesh over all devices
+    """
+
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {a: getattr(self, a) for a in AXIS_ORDER}
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = self.axis_sizes()
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one axis may be -1, got {wild}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wild:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh spec {sizes} needs {fixed} devices, have {n_devices}"
+            )
+        return sizes
+
+    def build(
+        self,
+        devices: Optional[Sequence[jax.Device]] = None,
+        *,
+        keep_unit_axes: bool = False,
+    ) -> Mesh:
+        return create_mesh(self, devices, keep_unit_axes=keep_unit_axes)
+
+
+def create_mesh(
+    spec: MeshSpec | Dict[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+    *,
+    keep_unit_axes: bool = False,
+) -> Mesh:
+    """Build a ``Mesh`` from a spec over ``devices`` (default: all).
+
+    Axes are laid out in ``AXIS_ORDER`` so the innermost (``tp``, then
+    ``sp``) map to physically adjacent devices — on a TPU slice that means
+    ICI neighbours; ``dp``/``pp`` get the outermost stride and may cross
+    DCN.  Unit axes are dropped unless ``keep_unit_axes``.
+    """
+    if isinstance(spec, dict):
+        spec = MeshSpec(**spec)
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    sizes = spec.resolve(len(devices))
+    names = [a for a in AXIS_ORDER if keep_unit_axes or sizes[a] > 1]
+    if not names:  # single-device mesh still needs one axis for pjit
+        names = ["dp"]
+    shape = tuple(sizes[a] for a in names)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, tuple(names))
+
+
+def local_mesh(axis: str = "dp") -> Mesh:
+    """1-D mesh over this process's addressable devices."""
+    devs = jax.local_devices()
+    return Mesh(np.asarray(devs), (axis,))
+
+
+def get_abstract_mesh(mesh: Mesh) -> Dict[str, int]:
+    """axis name -> size view of a mesh (for logging / bundle policies)."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def ici_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Axes that must stay inside one slice (collectives ride ICI)."""
+    return tuple(a for a in mesh.axis_names if a in ("tp", "sp", "ep"))
+
+
+def dcn_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Axes that may span slices over DCN (gradient-sync only)."""
+    return tuple(a for a in mesh.axis_names if a in ("pp", "dp", "fsdp"))

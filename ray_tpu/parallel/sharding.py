@@ -1,0 +1,123 @@
+"""Logical-axis sharding rules: the jax analog of ``prepare_model``.
+
+The reference wraps a torch module in DDP/FSDP for the user
+(``python/ray/train/torch/train_loop_utils.py:51,71-74`` ``prepare_model``).
+The TPU-native equivalent is declarative: parameters carry *logical* axis
+names (e.g. ``("embed", "mlp")``) and a rule table maps logical axes to
+mesh axes, producing ``NamedSharding``s that pjit consumes.  This is the
+GSPMD recipe — annotate, let XLA insert collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MeshAxis = Union[None, str, Tuple[str, ...]]
+
+
+class ShardingRules:
+    """Maps logical axis names to mesh axes (or None = replicate).
+
+    Example::
+
+        rules = ShardingRules(
+            batch=("dp", "fsdp"), seq="sp",
+            embed="fsdp", mlp="tp", heads="tp", vocab="tp",
+        )
+        sharding = rules.spec(("embed", "mlp"))   # P("fsdp", "tp")
+    """
+
+    def __init__(self, **rules: MeshAxis):
+        self.rules: Dict[str, MeshAxis] = dict(rules)
+
+    def update(self, **rules: MeshAxis) -> "ShardingRules":
+        new = dict(self.rules)
+        new.update(rules)
+        return ShardingRules(**new)
+
+    def spec(self, logical_axes: Sequence[Optional[str]]) -> P:
+        return P(*(self.rules.get(a) if a is not None else None for a in logical_axes))
+
+    def sharding(self, mesh: Mesh, logical_axes: Sequence[Optional[str]]) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical_axes))
+
+
+# Default rule tables for the canonical modes.  ``None`` replicates.
+DP_RULES = ShardingRules(batch="dp", seq=None, embed=None, mlp=None, heads=None,
+                         kv=None, vocab=None, expert=None)
+FSDP_RULES = ShardingRules(batch=("dp", "fsdp"), seq=None, embed="fsdp", mlp=None,
+                           heads=None, kv=None, vocab=None, expert=None)
+TP_RULES = ShardingRules(batch="dp", seq=None, embed=None, mlp="tp", heads="tp",
+                         kv="tp", vocab="tp", expert=None)
+FSDP_TP_RULES = ShardingRules(batch=("dp", "fsdp"), seq=None, embed="fsdp",
+                              mlp="tp", heads="tp", kv="tp", vocab="tp", expert=None)
+# Long-context: sequence axis sharded over sp (ring attention), params fsdp+tp.
+SP_RULES = ShardingRules(batch=("dp", "fsdp"), seq="sp", embed="fsdp", mlp="tp",
+                         heads="tp", kv="tp", vocab="tp", expert=None)
+# MoE: experts sharded over ep.
+EP_RULES = ShardingRules(batch=("dp", "fsdp"), seq=None, embed="fsdp", mlp="tp",
+                         heads="tp", kv="tp", vocab="tp", expert="ep")
+
+
+def rules_for_mesh(mesh: Mesh) -> ShardingRules:
+    """Pick a sensible default rule table from the mesh's axes."""
+    axes = set(mesh.axis_names)
+    batch = tuple(a for a in ("dp", "fsdp") if a in axes) or None
+    if batch is not None and len(batch) == 1:
+        batch = batch[0]
+    return ShardingRules(
+        batch=batch,
+        seq="sp" if "sp" in axes else None,
+        embed="fsdp" if "fsdp" in axes else None,
+        mlp="tp" if "tp" in axes else None,
+        heads="tp" if "tp" in axes else None,
+        kv="tp" if "tp" in axes else None,
+        vocab="tp" if "tp" in axes else None,
+        expert="ep" if "ep" in axes else None,
+    )
+
+
+def logical_to_sharding(
+    logical_tree: Any, mesh: Mesh, rules: ShardingRules
+) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: rules.sharding(mesh, axes),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def infer_sharding(params: Any, mesh: Mesh, rules: ShardingRules) -> Any:
+    """Heuristic sharding for an unannotated param pytree.
+
+    FSDP-style: shard the largest divisible axis of each array over the
+    param axes (``fsdp`` then ``tp`` if present), replicate small arrays.
+    Good enough when a model doesn't carry logical axis metadata.
+    """
+    axes = [a for a in ("fsdp", "tp") if a in mesh.axis_names]
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+
+    def _spec(x) -> NamedSharding:
+        if not hasattr(x, "shape") or not axes or x.ndim == 0 or x.size < 1024:
+            return NamedSharding(mesh, P())
+        ax = axes[0]
+        n = sizes[ax]
+        # shard the largest dim divisible by the axis size
+        order = sorted(range(x.ndim), key=lambda i: -x.shape[i])
+        for i in order:
+            if x.shape[i] % n == 0:
+                parts: list = [None] * x.ndim
+                parts[i] = ax
+                return NamedSharding(mesh, P(*parts))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(_spec, params)
+
+
+def with_sharding_constraint(x: Any, mesh: Mesh, spec: P) -> Any:
+    """``lax.with_sharding_constraint`` under an explicit mesh."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

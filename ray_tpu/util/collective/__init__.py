@@ -1,0 +1,29 @@
+from ray_tpu.util.collective.collective import (
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    create_collective_group,
+    destroy_collective_group,
+    get_rank,
+    get_collective_group_size,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)
+
+__all__ = [
+    "init_collective_group",
+    "create_collective_group",
+    "destroy_collective_group",
+    "get_rank",
+    "get_collective_group_size",
+    "allreduce",
+    "allgather",
+    "reducescatter",
+    "broadcast",
+    "send",
+    "recv",
+    "barrier",
+]

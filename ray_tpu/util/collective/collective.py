@@ -1,0 +1,230 @@
+"""Host-side collective communication between tasks/actors.
+
+API surface of the reference's ``ray.util.collective``
+(``python/ray/util/collective/collective.py:120-615`` —
+``init_collective_group / allreduce / allgather / reducescatter /
+broadcast / send / recv``), re-based for TPU clusters:
+
+- **Device tensors never travel this path.**  On-TPU reductions belong in
+  jit via :mod:`ray_tpu.parallel.collective` (XLA lowers them onto ICI).
+- This module moves *host* arrays between workers — the role gloo plays in
+  the reference (``gloo_collective_group.py:184``) — through the
+  shared-memory object store, rendezvoused by a named coordinator actor.
+
+Each group op is a barriered round: every rank contributes its array,
+rank 0's coordinator computes the reduction once, and all ranks fetch the
+result as a zero-copy object-store read.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+_local = threading.local()
+
+
+def _groups() -> Dict[str, "_GroupHandle"]:
+    if not hasattr(_local, "groups"):
+        _local.groups = {}
+    return _local.groups
+
+
+class _Coordinator:
+    """Named actor performing the gather/reduce/scatter rendezvous.
+
+    One instance per group; lives on the head node.  Analog of the NCCL
+    communicator bootstrap store (``nccl_collective_group.py:127``), but it
+    also executes the host-side reduction itself.
+    """
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.rounds: Dict[int, dict] = {}
+        self.seq: Dict[str, int] = {}
+
+    def contribute(self, round_id: int, rank: int, value, op: str):
+        """Blocks (by repeated polling from caller) until all ranks arrive."""
+        r = self.rounds.setdefault(round_id, {"parts": {}, "op": op, "result": None})
+        r["parts"][rank] = value
+        if len(r["parts"]) == self.world_size:
+            r["result"] = self._finish(r)
+        return r["result"] is not None
+
+    def fetch(self, round_id: int, rank: int):
+        r = self.rounds.get(round_id)
+        if r is None or r["result"] is None:
+            return False, None
+        out = r["result"]
+        r.setdefault("fetched", set()).add(rank)
+        if len(r["fetched"]) == self.world_size:
+            del self.rounds[round_id]
+        if isinstance(out, dict):  # per-rank outputs (reducescatter / recv)
+            return True, out[rank]
+        return True, out
+
+    def _finish(self, r: dict):
+        op = r["op"]
+        parts = [r["parts"][i] for i in sorted(r["parts"])]
+        if op == "barrier":
+            return True
+        if op in ("sum", "mean", "max", "min", "product"):
+            acc = np.stack([np.asarray(p) for p in parts])
+            fn = {"sum": np.sum, "mean": np.mean, "max": np.max,
+                  "min": np.min, "product": np.prod}[op]
+            return fn(acc, axis=0)
+        if op == "allgather":
+            return [np.asarray(p) for p in parts]
+        if op == "broadcast":
+            root, vals = parts[0][0], {i: v for i, (_, v) in enumerate(parts)}
+            return vals[root]
+        if op == "reducescatter":
+            acc = np.sum(np.stack([np.asarray(p) for p in parts]), axis=0)
+            chunks = np.array_split(acc, self.world_size, axis=0)
+            return {i: chunks[i] for i in range(self.world_size)}
+        if op == "sendrecv":
+            # parts[i] = (dst_rank, value or None); route values to dst
+            out: Dict[int, Optional[np.ndarray]] = {i: None for i in range(self.world_size)}
+            for src, (dst, val) in r["parts"].items():
+                if val is not None and dst is not None:
+                    out[dst] = val
+            return out
+        raise ValueError(f"unknown op {op}")
+
+
+class _GroupHandle:
+    def __init__(self, name: str, world_size: int, rank: int, coordinator):
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self.coordinator = coordinator
+        self.round_id = 0
+
+    def _run(self, value, op: str, timeout: float = 120.0):
+        import time
+
+        rid = self.round_id
+        self.round_id += 1
+        self.coordinator.contribute.remote(rid, self.rank, value, op)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            done, out = ray_tpu.get(self.coordinator.fetch.remote(rid, self.rank))
+            if done:
+                return out
+            time.sleep(0.005)
+        raise TimeoutError(f"collective {op} round {rid} timed out in group {self.name}")
+
+
+def init_collective_group(
+    world_size: int, rank: int, backend: str = "shm", group_name: str = "default"
+) -> None:
+    """Join a collective group from inside a task/actor (collective.py:120).
+
+    Rank 0 creates the coordinator; other ranks poll for it — a
+    deterministic rendezvous with no named-actor creation race.
+    """
+    if rank == 0:
+        coord = _get_or_create_coordinator(group_name, world_size)
+    else:
+        import time
+
+        deadline = time.monotonic() + 30.0
+        while True:
+            try:
+                coord = ray_tpu.get_actor(f"__collective_{group_name}")
+                break
+            except ValueError:
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"rank {rank} timed out waiting for collective group "
+                        f"{group_name!r} to be created by rank 0"
+                    )
+                time.sleep(0.01)
+    _groups()[group_name] = _GroupHandle(group_name, world_size, rank, coord)
+
+
+def create_collective_group(
+    actors: List, world_size: int, ranks: List[int],
+    backend: str = "shm", group_name: str = "default",
+) -> None:
+    """Driver-side declarative setup (collective.py:151): tells each actor
+    to join the group with its rank.  The actor class must expose a
+    ``join_collective_group(world_size, rank, group_name)`` method that
+    calls :func:`init_collective_group`."""
+    _get_or_create_coordinator(group_name, world_size)
+    ray_tpu.get([
+        a.join_collective_group.remote(world_size, rank, group_name)
+        for a, rank in zip(actors, ranks)
+    ])
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    _groups().pop(group_name, None)
+
+
+def get_rank(group_name: str = "default") -> int:
+    g = _groups().get(group_name)
+    return g.rank if g else -1
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    g = _groups().get(group_name)
+    return g.world_size if g else -1
+
+
+def _group(group_name: str) -> _GroupHandle:
+    g = _groups().get(group_name)
+    if g is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this worker; "
+            "call init_collective_group() first"
+        )
+    return g
+
+
+def _get_or_create_coordinator(group_name: str, world_size: int):
+    name = f"__collective_{group_name}"
+    try:
+        return ray_tpu.get_actor(name)
+    except ValueError:
+        pass
+    Coord = ray_tpu.remote(num_cpus=0)(_Coordinator)
+    try:
+        return Coord.options(name=name).remote(world_size)
+    except Exception:
+        return ray_tpu.get_actor(name)
+
+
+def allreduce(tensor: np.ndarray, group_name: str = "default", op: str = "sum") -> np.ndarray:
+    return _group(group_name)._run(np.asarray(tensor), op)
+
+
+def allgather(tensor: np.ndarray, group_name: str = "default") -> List[np.ndarray]:
+    return _group(group_name)._run(np.asarray(tensor), "allgather")
+
+
+def reducescatter(tensor: np.ndarray, group_name: str = "default") -> np.ndarray:
+    return _group(group_name)._run(np.asarray(tensor), "reducescatter")
+
+
+def broadcast(tensor: np.ndarray, src_rank: int = 0, group_name: str = "default") -> np.ndarray:
+    return _group(group_name)._run((src_rank, np.asarray(tensor)), "broadcast")
+
+
+def send(tensor: np.ndarray, dst_rank: int, group_name: str = "default") -> None:
+    _group(group_name)._run((dst_rank, np.asarray(tensor)), "sendrecv")
+
+
+def recv(shape, dtype, src_rank: int, group_name: str = "default") -> np.ndarray:
+    out = _group(group_name)._run((None, None), "sendrecv")
+    if out is None:
+        raise RuntimeError(f"no tensor was sent to rank {get_rank(group_name)}")
+    return np.asarray(out, dtype=dtype).reshape(shape)
+
+
+def barrier(group_name: str = "default") -> None:
+    _group(group_name)._run(None, "barrier")

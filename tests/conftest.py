@@ -9,12 +9,22 @@ mesh (``xla_force_host_platform_device_count``) per SURVEY §4's TPU note.
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before the first jax backend is initialized.  XLA_FLAGS is read
+# at backend-init time; the platform itself must be forced through
+# jax.config because this image's sitecustomize registers a TPU PJRT plugin
+# whose JAX_PLATFORMS=axon would otherwise win over our env var.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Worker subprocesses inherit os.environ; without this the TPU plugin's
+# sitecustomize registration would override JAX_PLATFORMS in them too.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

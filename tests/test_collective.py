@@ -1,0 +1,69 @@
+"""Host-side collective group tests (ray.util.collective surface)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+
+
+@ray_tpu.remote
+class Rank:
+    def __init__(self, world_size, rank, group="g"):
+        col.init_collective_group(world_size, rank, group_name=group)
+        self.rank = rank
+        self.group = group
+
+    def do_allreduce(self):
+        return col.allreduce(np.full((4,), float(self.rank + 1)), self.group)
+
+    def do_allgather(self):
+        return col.allgather(np.array([self.rank]), self.group)
+
+    def do_broadcast(self):
+        return col.broadcast(np.array([self.rank * 10.0]), src_rank=1, group_name=self.group)
+
+    def do_reducescatter(self):
+        return col.reducescatter(np.arange(4.0), self.group)
+
+    def do_barrier(self):
+        col.barrier(self.group)
+        return self.rank
+
+
+@pytest.fixture
+def four_ranks(ray_start_regular):
+    # rank 0 first so it creates the coordinator before the rest poll
+    r0 = Rank.remote(4, 0)
+    rest = [Rank.remote(4, i) for i in range(1, 4)]
+    return [r0] + rest
+
+
+def test_allreduce(four_ranks):
+    outs = ray_tpu.get([a.do_allreduce.remote() for a in four_ranks])
+    for o in outs:
+        np.testing.assert_allclose(o, np.full((4,), 10.0))
+
+
+def test_allgather(four_ranks):
+    outs = ray_tpu.get([a.do_allgather.remote() for a in four_ranks])
+    for o in outs:
+        assert [int(x[0]) for x in o] == [0, 1, 2, 3]
+
+
+def test_broadcast(four_ranks):
+    outs = ray_tpu.get([a.do_broadcast.remote() for a in four_ranks])
+    for o in outs:
+        np.testing.assert_allclose(o, np.array([10.0]))
+
+
+def test_reducescatter(four_ranks):
+    outs = ray_tpu.get([a.do_reducescatter.remote() for a in four_ranks])
+    # sum over 4 ranks of arange(4) = [0,4,8,12], scattered 1 element each
+    got = sorted(float(o[0]) for o in outs)
+    assert got == [0.0, 4.0, 8.0, 12.0]
+
+
+def test_barrier(four_ranks):
+    outs = ray_tpu.get([a.do_barrier.remote() for a in four_ranks])
+    assert sorted(outs) == [0, 1, 2, 3]

@@ -1,0 +1,124 @@
+"""Mesh / sharding / in-jit collective tests on the virtual 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ray_tpu.parallel import MeshSpec, create_mesh
+from ray_tpu.parallel import collective as col
+from ray_tpu.parallel.sharding import (
+    FSDP_TP_RULES,
+    ShardingRules,
+    infer_sharding,
+    rules_for_mesh,
+)
+
+
+def test_mesh_spec_resolve():
+    assert MeshSpec(dp=-1, tp=4).resolve(8) == {
+        "pp": 1, "dp": 2, "fsdp": 1, "ep": 1, "sp": 1, "tp": 4
+    }
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3, tp=4).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=-1).resolve(8)
+
+
+def test_create_mesh_axis_order():
+    mesh = create_mesh(MeshSpec(dp=2, tp=4))
+    assert mesh.axis_names == ("dp", "tp")
+    assert mesh.devices.shape == (2, 4)
+    # tp is innermost: adjacent devices share a dp row
+    flat = mesh.devices.reshape(-1)
+    assert flat[0] is mesh.devices[0, 0] and flat[1] is mesh.devices[0, 1]
+
+
+def test_create_mesh_single_axis_fallback():
+    mesh = create_mesh(MeshSpec(), devices=jax.devices()[:1])
+    assert mesh.axis_names == ("dp",)
+
+
+def test_sharding_rules_spec():
+    rules = ShardingRules(batch=("dp", "fsdp"), embed="fsdp", mlp="tp")
+    assert rules.spec(("batch", None)) == P(("dp", "fsdp"), None)
+    assert rules.spec(("embed", "mlp")) == P("fsdp", "tp")
+    updated = rules.update(mlp=None)
+    assert updated.spec(("embed", "mlp")) == P("fsdp", None)
+
+
+def test_rules_for_mesh():
+    mesh = create_mesh(MeshSpec(fsdp=2, tp=4))
+    rules = rules_for_mesh(mesh)
+    assert rules.rules["batch"] == "fsdp"
+    assert rules.rules["mlp"] == "tp"
+    assert rules.rules["seq"] is None
+
+
+def test_infer_sharding_shards_largest_divisible_dim():
+    mesh = create_mesh(MeshSpec(fsdp=8))
+    params = {"w": jnp.zeros((16, 128)), "b": jnp.zeros((4,))}
+    shardings = infer_sharding(params, mesh, FSDP_TP_RULES)
+    assert shardings["w"].spec == P(None, "fsdp")
+    assert shardings["b"].spec == P()  # too small -> replicated
+
+
+def test_collectives_in_shard_map():
+    mesh = create_mesh(MeshSpec(dp=8))
+    x = jnp.arange(8.0)
+
+    def body(x):
+        s = col.allreduce(x, "dp")
+        g = col.allgather(x, "dp")
+        b = col.broadcast(x, "dp", root=3)
+        r = col.ppermute_next(x, "dp", shift=1)
+        return s, g, b, r
+
+    f = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=P("dp"),
+            out_specs=(P("dp"), P(None), P("dp"), P("dp")),
+            check_vma=False,
+        )
+    )
+    s, g, b, r = f(x)
+    np.testing.assert_allclose(s, np.full(8, 28.0))
+    np.testing.assert_allclose(g, np.arange(8.0))
+    np.testing.assert_allclose(b, np.full(8, 3.0))
+    # ring shift by 1: device i's value moves to device i+1
+    np.testing.assert_allclose(r, np.roll(np.arange(8.0), 1))
+
+
+def test_reducescatter_in_shard_map():
+    mesh = create_mesh(MeshSpec(dp=8))
+    x = jnp.ones((8, 8))
+
+    # the DDP-gradient shape: every device holds the full tensor, each ends
+    # up owning the reduced shard of its slice
+    f = jax.jit(
+        jax.shard_map(
+            lambda x: col.reducescatter(x, "dp", scatter_axis=0),
+            mesh=mesh, in_specs=P(None, None), out_specs=P("dp", None),
+            check_vma=False,
+        )
+    )
+    out = f(x)
+    assert out.shape == (8, 8)
+    np.testing.assert_allclose(out, np.full((8, 8), 8.0))
+
+
+def test_grad_sync_pmean():
+    mesh = create_mesh(MeshSpec(dp=8))
+    grads = {"w": jnp.arange(8.0), "b": jnp.ones(8)}
+
+    f = jax.jit(
+        jax.shard_map(
+            lambda g: col.grad_sync(g, "dp"),
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+        )
+    )
+    out = f(grads)
+    np.testing.assert_allclose(out["w"], np.full(8, 3.5))
+    np.testing.assert_allclose(out["b"], np.ones(8))
