@@ -69,15 +69,16 @@ class ObjectRegistry:
         unlink = None
         with self._lock:
             e = self._objects.setdefault(oid, _Entry())
-            if e.sealed.is_set():
+            if e.loc is not None:
                 # First seal wins (objects are immutable).  A re-seal happens
                 # when a task retried after its worker sealed a return and
-                # then crashed — drop the duplicate payload.
+                # then crashed — drop the duplicate payload.  Checked and
+                # set under the lock so two concurrent seals can't both win.
                 unlink = loc.shm_name
             else:
                 e.loc = loc
                 self._bytes_used += loc.size
-        e.sealed.set()
+            e.sealed.set()
         if unlink:
             ShmSegment.unlink(unlink)
 

@@ -16,19 +16,27 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 
-def autodetect_num_tpus() -> int:
+def autodetect_tpus() -> Tuple[int, List[int]]:
+    """(chip count, chip ids) from one consistent source — the count and the
+    id list must never disagree (the ids become TPU_VISIBLE_CHIPS grants)."""
     if "RAY_TPU_NUM_TPUS" in os.environ:
-        return int(os.environ["RAY_TPU_NUM_TPUS"])
+        n = int(os.environ["RAY_TPU_NUM_TPUS"])
+        return n, list(range(n))
     visible = os.environ.get("TPU_VISIBLE_CHIPS")
     if visible:
-        return len([c for c in visible.split(",") if c.strip()])
+        ids = [int(c) for c in visible.split(",") if c.strip()]
+        return len(ids), ids
     accel = glob.glob("/dev/accel*")
     if accel:
-        return len(accel)
+        return len(accel), list(range(len(accel)))
     vfio = glob.glob("/dev/vfio/[0-9]*")
     if vfio:
-        return len(vfio)
-    return 0
+        return len(vfio), list(range(len(vfio)))
+    return 0, []
+
+
+def autodetect_num_tpus() -> int:
+    return autodetect_tpus()[0]
 
 
 def autodetect_resources(
@@ -39,7 +47,10 @@ def autodetect_resources(
     """Returns (resource totals, tpu chip ids)."""
     total: Dict[str, float] = dict(resources or {})
     total["CPU"] = float(num_cpus if num_cpus is not None else os.cpu_count() or 1)
-    n_tpus = num_tpus if num_tpus is not None else autodetect_num_tpus()
+    if num_tpus is not None:
+        n_tpus, ids = num_tpus, list(range(num_tpus))
+    else:
+        n_tpus, ids = autodetect_tpus()
     total["TPU"] = float(n_tpus)
     try:
         import psutil  # type: ignore
@@ -47,11 +58,4 @@ def autodetect_resources(
         total.setdefault("memory", float(psutil.virtual_memory().available))
     except Exception:
         total.setdefault("memory", 8.0 * 1024**3)
-    # Use the real chip ids this process can see, not synthetic ones —
-    # workers are later isolated via TPU_VISIBLE_CHIPS=<these ids>.
-    visible = os.environ.get("TPU_VISIBLE_CHIPS")
-    if num_tpus is None and visible:
-        ids = [int(c) for c in visible.split(",") if c.strip()]
-    else:
-        ids = list(range(int(n_tpus)))
     return total, ids

@@ -1,0 +1,19 @@
+"""Model zoo: pure-jax pytree models with logical sharding axes.
+
+Models are (init, apply) pairs over plain dict pytrees — no framework
+classes on the hot path, so pjit sees exactly the arrays and the sharding
+rules in :mod:`ray_tpu.parallel.sharding` apply mechanically.  Families:
+
+- :mod:`ray_tpu.models.gpt2` — the flagship decoder LM (BASELINE config 3:
+  GPT-2 125M, FSDP/TP/SP-shardable, ring attention for long context).
+- :mod:`ray_tpu.models.bert` — bidirectional encoder classifier
+  (BASELINE config 5: the Serve replica model).
+- :mod:`ray_tpu.models.mlp` — MNIST-class MLP (BASELINE config 2).
+"""
+
+from ray_tpu.models import bert, gpt2, mlp  # noqa: F401
+from ray_tpu.models.gpt2 import GPT2Config
+from ray_tpu.models.bert import BertConfig
+from ray_tpu.models.mlp import MLPConfig
+
+__all__ = ["gpt2", "bert", "mlp", "GPT2Config", "BertConfig", "MLPConfig"]

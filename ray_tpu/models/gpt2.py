@@ -1,0 +1,142 @@
+"""GPT-2: the flagship decoder LM (BASELINE config 3 — GPT-2 125M).
+
+Pure-jax (init, apply, loss, train_step) over dict pytrees with logical
+sharding axes; trains data/fsdp/tensor/sequence-parallel purely through
+sharding annotations — the reference delegates all of this to torch
+(``python/ray/train/torch/train_loop_utils.py:51`` prepare_model wraps
+DDP/FSDP); here the sharding *is* the model's parallelism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from ray_tpu.models.transformer import (
+    TransformerConfig,
+    apply_stack,
+    block_logical_axes,
+    init_block_params,
+)
+from ray_tpu.ops.layers import cross_entropy_loss, layernorm
+from ray_tpu.parallel.sharding import ShardingRules, logical_to_sharding
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config(TransformerConfig):
+    causal: bool = True
+
+    @staticmethod
+    def gpt2_small(**kw) -> "GPT2Config":
+        """The 124M-parameter headline model."""
+        return GPT2Config(
+            vocab_size=50304, n_layers=12, n_heads=12, d_model=768,
+            d_ff=3072, max_seq_len=1024, **kw,
+        )
+
+    @staticmethod
+    def tiny(**kw) -> "GPT2Config":
+        """Test/dry-run sized."""
+        return GPT2Config(
+            vocab_size=512, n_layers=2, n_heads=4, d_model=64,
+            d_ff=256, max_seq_len=128, remat=False, **kw,
+        )
+
+
+def init(cfg: GPT2Config, key: jax.Array) -> Dict[str, Any]:
+    k_emb, k_pos, k_blocks = jax.random.split(key, 3)
+    return {
+        "wte": jax.random.normal(k_emb, (cfg.vocab_size, cfg.d_model)) * 0.02,
+        "wpe": jax.random.normal(k_pos, (cfg.max_seq_len, cfg.d_model)) * 0.01,
+        "blocks": init_block_params(cfg, k_blocks),
+        "lnf_w": jnp.ones(cfg.d_model),
+        "lnf_b": jnp.zeros(cfg.d_model),
+    }
+
+
+def logical_axes() -> Dict[str, Any]:
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "blocks": block_logical_axes(),
+        "lnf_w": ("embed",),
+        "lnf_b": ("embed",),
+    }
+
+
+def param_shardings(mesh: Mesh, rules: ShardingRules):
+    return logical_to_sharding(logical_axes(), mesh, rules)
+
+
+def apply(
+    params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, V] (f32)."""
+    B, T = tokens.shape
+    x = params["wte"][tokens] + params["wpe"][:T]
+    x = x.astype(cfg.dtype)
+    x = apply_stack(x, params["blocks"], cfg, mesh)
+    x = layernorm(x, params["lnf_w"].astype(cfg.dtype), params["lnf_b"].astype(cfg.dtype))
+    # tied embeddings for the LM head
+    logits = x @ params["wte"].T.astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(
+    params: Dict[str, Any], batch: Dict[str, jax.Array], cfg: GPT2Config,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Next-token cross entropy. batch: {"tokens": [B, T+1]} or
+    {"inputs": [B,T], "targets": [B,T]}."""
+    if "tokens" in batch:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    logits = apply(params, inputs, cfg, mesh)
+    return cross_entropy_loss(logits, targets)
+
+
+def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
+                   warmup: int = 100, total_steps: int = 10000):
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup, max(total_steps, warmup + 1), lr * 0.1
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=weight_decay,
+                    mask=lambda p: jax.tree.map(lambda x: x.ndim >= 2, p)),
+    )
+
+
+def make_train_step(cfg: GPT2Config, optimizer, mesh: Optional[Mesh] = None):
+    """Returns train_step(state, batch) -> (state, metrics); jit/pjit-able,
+    donate state for in-place updates."""
+
+    def train_step(state, batch):
+        params, opt_state, step = state["params"], state["opt_state"], state["step"]
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        new_state = {"params": params, "opt_state": opt_state, "step": step + 1}
+        return new_state, {"loss": loss, "step": step + 1}
+
+    return train_step
+
+
+def init_state(cfg: GPT2Config, key: jax.Array, optimizer) -> Dict[str, Any]:
+    params = init(cfg, key)
+    return {
+        "params": params,
+        "opt_state": optimizer.init(params),
+        "step": jnp.zeros((), jnp.int32),
+    }
+
+
+def num_params(params: Dict[str, Any]) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))

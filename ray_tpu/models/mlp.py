@@ -1,0 +1,52 @@
+"""MLP classifier (BASELINE config 2: MNIST MLP, data-parallel psum).
+
+Small enough that its whole train step is one fused XLA program; used by
+the Train tests as the canonical DP workload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 784
+    hidden: Tuple[int, ...] = (512, 256)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+def init(cfg: MLPConfig, key: jax.Array) -> Dict[str, Any]:
+    dims = (cfg.in_dim, *cfg.hidden, cfg.num_classes)
+    params = {}
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        key, sub = jax.random.split(key)
+        params[f"w{i}"] = jax.random.normal(sub, (d_in, d_out)) * (2.0 / d_in) ** 0.5
+        params[f"b{i}"] = jnp.zeros(d_out)
+    return params
+
+
+def apply(params: Dict[str, Any], x: jax.Array, cfg: MLPConfig) -> jax.Array:
+    n_layers = len(cfg.hidden) + 1
+    h = x.astype(cfg.dtype)
+    for i in range(n_layers):
+        h = h @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n_layers - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def loss_fn(params, batch, cfg: MLPConfig) -> jax.Array:
+    logits = apply(params, batch["x"], cfg)
+    labels = jax.nn.one_hot(batch["y"], cfg.num_classes)
+    return optax.softmax_cross_entropy(logits, labels).mean()
+
+
+def accuracy(params, batch, cfg: MLPConfig) -> jax.Array:
+    return (apply(params, batch["x"], cfg).argmax(-1) == batch["y"]).mean()

@@ -1,0 +1,151 @@
+"""Shared transformer core: stacked-layer params, scan-over-layers forward.
+
+Design choices, all TPU-motivated:
+
+- **Layer stacking**: every block parameter carries a leading ``[L, ...]``
+  layer axis and the forward is one ``lax.scan`` over it — one compiled
+  block body regardless of depth (fast compiles, friendly to pipeline
+  sharding later).
+- **Remat**: the scanned body is wrapped in ``jax.checkpoint`` so
+  activations are recomputed in the backward pass — HBM for FLOPs.
+- **bf16 compute, f32 master weights**: params live in f32; matmuls run in
+  ``config.dtype`` (bfloat16 by default) with f32 accumulation inside the
+  attention/softmax path.
+- **Logical axes**: a parallel pytree of axis-name tuples feeds
+  :mod:`ray_tpu.parallel.sharding` — ``embed``→fsdp, ``heads``/``mlp``→tp,
+  sequence→sp (ring attention when the mesh has an ``sp`` axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.ops.attention import attention
+from ray_tpu.ops.layers import layernorm
+from ray_tpu.ops.ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 50304  # GPT-2's 50257 padded up to a multiple of 128
+    n_layers: int = 12
+    n_heads: int = 12
+    d_model: int = 768
+    d_ff: int = 3072
+    max_seq_len: int = 1024
+    causal: bool = True
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # pre-LN (GPT-2 style) by default; post-LN matches original BERT so
+    # HF checkpoints load faithfully.
+    post_ln: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def init_block_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, jax.Array]:
+    """Stacked block params, GPT-2 init (normal 0.02, residual projections
+    scaled by 1/sqrt(2L))."""
+    L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
+    ks = jax.random.split(key, 4)
+    std, res_std = 0.02, 0.02 / (2 * L) ** 0.5
+    return {
+        "ln1_w": jnp.ones((L, D)), "ln1_b": jnp.zeros((L, D)),
+        "wqkv": jax.random.normal(ks[0], (L, D, 3 * D)) * std,
+        "bqkv": jnp.zeros((L, 3 * D)),
+        "wo": jax.random.normal(ks[1], (L, D, D)) * res_std,
+        "bo": jnp.zeros((L, D)),
+        "ln2_w": jnp.ones((L, D)), "ln2_b": jnp.zeros((L, D)),
+        "w1": jax.random.normal(ks[2], (L, D, F)) * std,
+        "b1": jnp.zeros((L, F)),
+        "w2": jax.random.normal(ks[3], (L, F, D)) * res_std,
+        "b2": jnp.zeros((L, D)),
+    }
+
+
+def block_logical_axes() -> Dict[str, Tuple]:
+    """Logical axis names for the stacked block params (leading layer axis
+    is never sharded across tp/fsdp — it is the scan axis)."""
+    return {
+        "ln1_w": ("layers", "embed"), "ln1_b": ("layers", "embed"),
+        "wqkv": ("layers", "embed", "heads"),
+        "bqkv": ("layers", "heads"),
+        "wo": ("layers", "heads", "embed"),
+        "bo": ("layers", "embed"),
+        "ln2_w": ("layers", "embed"), "ln2_b": ("layers", "embed"),
+        "w1": ("layers", "embed", "mlp"),
+        "b1": ("layers", "mlp"),
+        "w2": ("layers", "mlp", "embed"),
+        "b2": ("layers", "embed"),
+    }
+
+
+def _attend(q, k, v, *, causal: bool, mesh: Optional[Mesh]) -> jax.Array:
+    """Pick the sequence-parallel path when the mesh has an sp axis."""
+    if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        batch = tuple(a for a in ("dp", "fsdp") if a in mesh.axis_names) or None
+        heads = "tp" if "tp" in mesh.axis_names else None
+        spec = P(batch, heads, "sp", None)
+        sm = jax.shard_map(
+            partial(ring_attention, axis_name="sp", causal=causal),
+            mesh=mesh,
+            in_specs=(spec,) * 3,
+            out_specs=spec,
+            check_vma=False,
+        )
+        return sm(q, k, v)
+    return attention(q, k, v, causal=causal)
+
+
+def apply_block(
+    x: jax.Array, p: Dict[str, jax.Array], cfg: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """One transformer block, pre-LN or post-LN.  x: [B, T, D] in cfg.dtype."""
+    B, T, D = x.shape
+    H, dh = cfg.n_heads, cfg.head_dim
+    c = lambda w: w.astype(cfg.dtype)
+
+    def attn(h):
+        qkv = h @ c(p["wqkv"]) + c(p["bqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        to_heads = lambda t: t.reshape(B, T, H, dh).transpose(0, 2, 1, 3)
+        out = _attend(to_heads(q), to_heads(k), to_heads(v), causal=cfg.causal, mesh=mesh)
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
+        return out @ c(p["wo"]) + c(p["bo"])
+
+    def ffn(h):
+        h = jax.nn.gelu(h @ c(p["w1"]) + c(p["b1"]), approximate=True)
+        return h @ c(p["w2"]) + c(p["b2"])
+
+    if cfg.post_ln:  # original-BERT residual->norm order
+        x = layernorm(x + attn(x), c(p["ln1_w"]), c(p["ln1_b"]))
+        x = layernorm(x + ffn(x), c(p["ln2_w"]), c(p["ln2_b"]))
+    else:  # GPT-2 pre-LN
+        x = x + attn(layernorm(x, c(p["ln1_w"]), c(p["ln1_b"])))
+        x = x + ffn(layernorm(x, c(p["ln2_w"]), c(p["ln2_b"])))
+    return x
+
+
+def apply_stack(
+    x: jax.Array, blocks: Dict[str, jax.Array], cfg: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """scan over the stacked layer axis; each step optionally remat'd."""
+
+    def body(x, layer_params):
+        return apply_block(x, layer_params, cfg, mesh), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = lax.scan(body, x, blocks)
+    return x

@@ -1,0 +1,35 @@
+"""TPU-native operator library (pallas kernels + jax ops).
+
+The hot ops of the framework's compute path.  The reference has no kernel
+library (it orchestrates torch/CUDA code it doesn't own); here the kernels
+are first-class: flash attention (pallas, MXU-tiled), blockwise attention
+(pure-jax online softmax, differentiable and rematerializable), ring
+attention over the ``sp`` mesh axis for long-context (SURVEY §5.7), and
+fused normalization/loss layers.
+"""
+
+from ray_tpu.ops.attention import (
+    attention,
+    blockwise_attention,
+    flash_attention_tpu,
+    mha_reference,
+)
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.layers import (
+    cross_entropy_loss,
+    layernorm,
+    rmsnorm,
+    rope,
+)
+
+__all__ = [
+    "attention",
+    "blockwise_attention",
+    "flash_attention_tpu",
+    "mha_reference",
+    "ring_attention",
+    "rmsnorm",
+    "layernorm",
+    "rope",
+    "cross_entropy_loss",
+]

@@ -1,0 +1,248 @@
+"""Attention: reference, blockwise (flash-style jax), and pallas TPU kernel.
+
+Three implementations with one contract — ``[B, H, T, D]`` q/k/v, causal or
+full — picked by :func:`attention`:
+
+- :func:`mha_reference` — naive O(T²) softmax attention; ground truth.
+- :func:`blockwise_attention` — online-softmax over k/v blocks via
+  ``lax.scan``; O(T) memory, differentiable through the scan, and the
+  inner block the ring-attention layer reuses.
+- :func:`flash_attention_tpu` — pallas kernel tiled for the MXU
+  (128-aligned blocks, f32 accumulators in VMEM scratch, bf16 matmuls),
+  wrapped in ``jax.custom_vjp`` with a blockwise-recompute backward so it
+  trains.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def mha_reference(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Naive O(T²) attention, the numerical ground truth."""
+    *_, t_q, d = q.shape
+    t_k = k.shape[-2]
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((t_q, t_k), dtype=bool), t_k - t_q)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
+
+
+def _block_update(carry, s, v_blk):
+    """One online-softmax step: fold scores ``s`` (f32, [..., q, kb]) and
+    values ``v_blk`` into the running (out, max, denom)."""
+    o, m, l = carry
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p, v_blk.astype(jnp.float32)
+    )
+    return o_new, m_new, l_new
+
+
+def blockwise_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
+    scale: Optional[float] = None, block_k: int = 512,
+) -> jax.Array:
+    """Flash-style attention as a ``lax.scan`` over k/v blocks.
+
+    O(T_k / block_k) sequential steps, O(block) memory per step; jax AD
+    differentiates through the scan, and ``jax.checkpoint`` around the
+    caller gives full rematerialization.  Also correct when ``t_k != t_q``
+    (used by ring attention, where k/v rotate around the ``sp`` ring).
+    """
+    *_, t_q, d = q.shape
+    t_k = k.shape[-2]
+    scale = scale if scale is not None else d ** -0.5
+    block_k = min(block_k, t_k)
+    if t_k % block_k:
+        raise ValueError(f"t_k={t_k} not divisible by block_k={block_k}")
+    n_blocks = t_k // block_k
+
+    qf = q.astype(jnp.float32) * scale
+    k_blocks = k.reshape(*k.shape[:-2], n_blocks, block_k, d)
+    v_blocks = v.reshape(*v.shape[:-2], n_blocks, block_k, d)
+    # scan over the block axis: move it to front
+    k_blocks = jnp.moveaxis(k_blocks, -3, 0)
+    v_blocks = jnp.moveaxis(v_blocks, -3, 0)
+
+    q_pos = jnp.arange(t_q) + (t_k - t_q)  # align causal diagonal
+
+    def step(carry, blk):
+        idx, k_blk, v_blk = blk
+        s = jnp.einsum("...qd,...kd->...qk", qf, k_blk.astype(jnp.float32))
+        if causal:
+            k_pos = idx * block_k + jnp.arange(block_k)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, NEG_INF)
+        return _block_update(carry, s, v_blk), None
+
+    o0 = jnp.zeros((*q.shape[:-1], d), jnp.float32)
+    m0 = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    (o, m, l), _ = lax.scan(
+        step, (o0, m0, l0), (jnp.arange(n_blocks), k_blocks, v_blocks)
+    )
+    return (o / l[..., None]).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+try:  # pallas import is deferred-safe: CPU-only envs may lack the TPU bits
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, causal: bool, block_q: int, block_k: int):
+    """Grid = (batch*heads, n_q_blocks, n_k_blocks); the k axis is the
+    innermost (sequential) dimension, so the f32 scratch (acc, m, l)
+    carries the online softmax across k steps of one q block."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]  # [block_q, d]
+    k = k_ref[0]  # [block_k, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+
+    if causal:
+        qi = pl.program_id(1)
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+    m_prev = m_ref[:, 0]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    l_ref[:, 0] = l_ref[:, 0] * alpha + p.sum(axis=-1)
+    m_ref[:, 0] = m_new
+    acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ki == nk - 1)
+    def _():
+        o_ref[0] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool, scale: float,
+    block_q: int, block_k: int, interpret: bool,
+) -> jax.Array:
+    b, h, t_q, d = q.shape
+    t_k = k.shape[-2]
+    bq, bk = min(block_q, t_q), min(block_k, t_k)
+    if t_q % bq or t_k % bk:
+        raise ValueError(f"seq lens ({t_q},{t_k}) not divisible by blocks ({bq},{bk})")
+    qr = q.reshape(b * h, t_q, d)
+    kr = k.reshape(b * h, t_k, d)
+    vr = v.reshape(b * h, t_k, d)
+    grid = (b * h, t_q // bq, t_k // bk)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),   # running max
+            pltpu.VMEM((bq, 1), jnp.float32),   # running denom
+            pltpu.VMEM((bq, d), jnp.float32),   # output accumulator
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, t_q, d)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def flash_attention_tpu(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = False, scale: Optional[float] = None,
+    block_q: int = 128, block_k: int = 128, interpret: bool = False,
+) -> jax.Array:
+    """Pallas flash attention.  Forward runs the MXU-tiled kernel; backward
+    recomputes with :func:`blockwise_attention` (flash-style memory) and
+    differentiates that."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash_forward(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention_tpu(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(
+            q, k, v, causal=causal, scale=scale, block_k=block_k
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention_tpu.defvjp(_flash_fwd, _flash_bwd)
+
+
+def attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
+    scale: Optional[float] = None, block_q: int = 128, block_k: int = 128,
+) -> jax.Array:
+    """Dispatch: pallas kernel on TPU with aligned shapes, blockwise jax
+    otherwise.  Single entry point used by the model zoo."""
+    t_q, t_k, d = q.shape[-2], k.shape[-2], q.shape[-1]
+    on_tpu = _HAS_PALLAS and jax.default_backend() == "tpu"
+    aligned = (
+        t_q % min(block_q, t_q) == 0 and t_k % min(block_k, t_k) == 0
+        and t_q >= 128 and t_k >= 128 and d % 128 == 0
+    )
+    if on_tpu and aligned:
+        return flash_attention_tpu(q, k, v, causal, scale, block_q, block_k)
+    return blockwise_attention(
+        q, k, v, causal=causal, scale=scale, block_k=min(block_k, t_k)
+    )

@@ -1,0 +1,70 @@
+"""Fused layer ops: norms, rotary embeddings, losses.
+
+Plain jnp compositions written so XLA fuses them into neighbouring matmuls
+(f32 accumulation, bf16 storage) — per the guide, hand-scheduling what the
+compiler already fuses is an anti-pattern, so pallas is reserved for the
+attention inner loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rmsnorm(x: jax.Array, weight: jax.Array, *, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def layernorm(
+    x: jax.Array, weight: jax.Array, bias: Optional[jax.Array] = None,
+    *, eps: float = 1e-5,
+) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    out = out.astype(x.dtype) * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def rope(
+    x: jax.Array, positions: jax.Array, *, base: float = 10000.0,
+) -> jax.Array:
+    """Rotary position embedding. x: [..., T, D] with D even; positions: [T]."""
+    d = x.shape[-1]
+    inv_freq = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [T, D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    out1 = x1 * cos - x2 * sin
+    out2 = x2 * cos + x1 * sin
+    out = jnp.stack([out1, out2], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)
+
+
+def cross_entropy_loss(
+    logits: jax.Array, labels: jax.Array, *, ignore_index: int = -100,
+    z_loss: float = 0.0,
+) -> jax.Array:
+    """Token-level cross entropy with optional z-loss (logit drift control).
+
+    logits: [..., V] (any dtype; reduced in f32), labels: [...] int.
+    """
+    logits = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    label_logit = jnp.take_along_axis(
+        logits, jnp.maximum(labels, 0)[..., None], axis=-1
+    )[..., 0]
+    nll = lse - label_logit
+    if z_loss:
+        nll = nll + z_loss * lse**2
+    valid = labels != ignore_index
+    nll = jnp.where(valid, nll, 0.0)
+    return nll.sum() / jnp.maximum(valid.sum(), 1)

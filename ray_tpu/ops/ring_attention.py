@@ -1,0 +1,97 @@
+"""Ring attention: sequence-parallel exact attention over the ``sp`` axis.
+
+The long-context capability the reference lacks entirely (SURVEY §5.7 —
+"Absent ... implement as a first-class capability"): each device holds a
+sequence chunk of q/k/v; k/v rotate around the mesh-axis ring with
+``lax.ppermute`` (ICI neighbour hops on TPU) while every device folds each
+visiting chunk into its online-softmax accumulator.  Peak memory is
+O(T/n_sp), compute overlaps communication across ring steps, and the
+result is bitwise-equivalent math to full attention.
+
+Must run inside ``shard_map`` with the sequence dimension sharded over
+``axis_name``; :func:`ring_attention` is the per-device program.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.ops.attention import NEG_INF, _block_update
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str = "sp",
+    causal: bool = False, scale: Optional[float] = None,
+) -> jax.Array:
+    """Per-device exact attention over a ring.  q/k/v: local ``[B,H,t,D]``
+    chunks of the globally sharded ``[B,H,T,D]`` arrays (t = T / n_sp)."""
+    *_, t, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32) * scale
+    q_pos = my * t + jnp.arange(t)
+
+    def step(carry, i):
+        o, m, l, k_cur, v_cur = carry
+        # the chunk visiting us at step i originated on device (my - i) % n
+        src = (my - i) % n
+        s = jnp.einsum("...qd,...kd->...qk", qf, k_cur.astype(jnp.float32))
+        if causal:
+            k_pos = src * t + jnp.arange(t)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask, s, NEG_INF)
+        o, m, l = _block_update((o, m, l), s, v_cur)
+        # rotate k/v to the next device (receive from the previous) — on a
+        # TPU slice this is a neighbour hop on the ICI ring
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o, m, l, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((*q.shape[:-1], d), jnp.float32)
+    m0 = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    (o, m, l, _, _), _ = lax.scan(step, (o0, m0, l0, k, v), jnp.arange(n))
+    # fully-masked rows (causal, first chunk) have l == 0
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, axis_name: str = "sp",
+    causal: bool = False, scale: Optional[float] = None,
+) -> jax.Array:
+    """Ulysses-style sequence parallelism: all-to-all head<->sequence
+    re-sharding so each device computes full-sequence attention for a
+    subset of heads, then the inverse all-to-all.  Cheaper than the ring
+    when heads % n_sp == 0 and the sequence fits after gathering.
+
+    Local shapes: ``[B, H, t, D]`` in, same out.
+    """
+    b, h, t, d = q.shape
+    n = lax.psum(1, axis_name)
+    if h % n:
+        raise ValueError(f"heads={h} not divisible by sp axis size {n}")
+
+    def scatter_heads(x):
+        # [B, H, t, D] -> [B, H/n, T, D]: shard heads, gather sequence
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def gather_heads(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    from ray_tpu.ops.attention import blockwise_attention
+
+    ql, kl, vl = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    # largest divisor of the gathered length <= 512 (blockwise requires
+    # block_k | T)
+    T = t * n
+    block = next(bk for bk in range(min(512, T), 0, -1) if T % bk == 0)
+    out = blockwise_attention(ql, kl, vl, causal=causal, scale=scale, block_k=block)
+    return gather_heads(out)

@@ -89,9 +89,12 @@ def axis_size(axis: Axis) -> int:
     return lax.psum(1, axis)
 
 
-def barrier(axis: Axis) -> None:
-    """Synchronization point: an all-reduce of a scalar (XLA orders it)."""
-    lax.psum(jnp.zeros((), jnp.int32), axis)
+def barrier(x: jax.Array, axis: Axis) -> jax.Array:
+    """Order ``x`` after a cross-device sync point.  Returns ``x`` fused
+    with an all-reduced token — the caller MUST use the return value, or
+    XLA dead-code-eliminates the collective."""
+    token = lax.psum(jnp.zeros((), x.dtype), axis)
+    return x + token
 
 
 def grad_sync(grads, axis: Axis, *, mean: bool = True):

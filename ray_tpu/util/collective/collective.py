@@ -45,7 +45,17 @@ class _Coordinator:
     def __init__(self, world_size: int):
         self.world_size = world_size
         self.rounds: Dict[int, dict] = {}
-        self.seq: Dict[str, int] = {}
+        # (src, dst) -> fifo of in-flight point-to-point tensors
+        self.mailbox: Dict[tuple, list] = {}
+
+    def p2p_put(self, src: int, dst: int, value) -> None:
+        self.mailbox.setdefault((src, dst), []).append(value)
+
+    def p2p_take(self, src: int, dst: int):
+        q = self.mailbox.get((src, dst))
+        if not q:
+            return False, None
+        return True, q.pop(0)
 
     def contribute(self, round_id: int, rank: int, value, op: str):
         """Blocks (by repeated polling from caller) until all ranks arrive."""
@@ -86,13 +96,6 @@ class _Coordinator:
             acc = np.sum(np.stack([np.asarray(p) for p in parts]), axis=0)
             chunks = np.array_split(acc, self.world_size, axis=0)
             return {i: chunks[i] for i in range(self.world_size)}
-        if op == "sendrecv":
-            # parts[i] = (dst_rank, value or None); route values to dst
-            out: Dict[int, Optional[np.ndarray]] = {i: None for i in range(self.world_size)}
-            for src, (dst, val) in r["parts"].items():
-                if val is not None and dst is not None:
-                    out[dst] = val
-            return out
         raise ValueError(f"unknown op {op}")
 
 
@@ -216,14 +219,25 @@ def broadcast(tensor: np.ndarray, src_rank: int = 0, group_name: str = "default"
 
 
 def send(tensor: np.ndarray, dst_rank: int, group_name: str = "default") -> None:
-    _group(group_name)._run((dst_rank, np.asarray(tensor)), "sendrecv")
+    """Point-to-point send via the coordinator mailbox — NOT a group round,
+    so only the (src, dst) pair participates (collective.py:531)."""
+    g = _group(group_name)
+    ray_tpu.get(g.coordinator.p2p_put.remote(g.rank, dst_rank, np.asarray(tensor)))
 
 
-def recv(shape, dtype, src_rank: int, group_name: str = "default") -> np.ndarray:
-    out = _group(group_name)._run((None, None), "sendrecv")
-    if out is None:
-        raise RuntimeError(f"no tensor was sent to rank {get_rank(group_name)}")
-    return np.asarray(out, dtype=dtype).reshape(shape)
+def recv(shape, dtype, src_rank: int, group_name: str = "default",
+         timeout: float = 120.0) -> np.ndarray:
+    """Blocking point-to-point receive from ``src_rank`` (collective.py:594)."""
+    import time
+
+    g = _group(group_name)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ok, val = ray_tpu.get(g.coordinator.p2p_take.remote(src_rank, g.rank))
+        if ok:
+            return np.asarray(val, dtype=dtype).reshape(shape)
+        time.sleep(0.005)
+    raise TimeoutError(f"recv from rank {src_rank} timed out after {timeout}s")
 
 
 def barrier(group_name: str = "default") -> None:

@@ -30,6 +30,13 @@ class Rank:
         col.barrier(self.group)
         return self.rank
 
+    def do_send(self, dst):
+        col.send(np.full((3,), float(self.rank)), dst, self.group)
+        return True
+
+    def do_recv(self, src):
+        return col.recv((3,), np.float64, src, self.group)
+
 
 @pytest.fixture
 def four_ranks(ray_start_regular):
@@ -67,3 +74,12 @@ def test_reducescatter(four_ranks):
 def test_barrier(four_ranks):
     outs = ray_tpu.get([a.do_barrier.remote() for a in four_ranks])
     assert sorted(outs) == [0, 1, 2, 3]
+
+
+def test_send_recv_point_to_point(four_ranks):
+    """p2p must involve only the (src, dst) pair — ranks 0,1 transfer while
+    2,3 do nothing."""
+    recv_ref = four_ranks[1].do_recv.remote(0)
+    send_ref = four_ranks[0].do_send.remote(1)
+    assert ray_tpu.get(send_ref) is True
+    np.testing.assert_allclose(ray_tpu.get(recv_ref), np.zeros(3))

@@ -1,0 +1,89 @@
+"""Model zoo tests: shapes, training progress, sharded end-to-end step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.models import bert, gpt2, mlp
+
+
+def test_gpt2_tiny_forward_shapes():
+    cfg = gpt2.GPT2Config.tiny()
+    params = gpt2.init(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = gpt2.apply(params, tokens, cfg)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_gpt2_tiny_loss_decreases():
+    cfg = gpt2.GPT2Config.tiny()
+    optimizer = gpt2.make_optimizer(lr=1e-3, warmup=1, total_steps=50)
+    state = gpt2.init_state(cfg, jax.random.PRNGKey(0), optimizer)
+    step = jax.jit(gpt2.make_train_step(cfg, optimizer))
+    rng = np.random.default_rng(0)
+    # one repeated batch: loss must fall when memorizing it
+    batch = {"tokens": jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 33), np.int32))}
+    first = None
+    for _ in range(10):
+        state, metrics = step(state, batch)
+        if first is None:
+            first = float(metrics["loss"])
+    assert float(metrics["loss"]) < first - 0.5
+
+
+def test_gpt2_causality():
+    """Changing a future token must not change past logits."""
+    cfg = gpt2.GPT2Config.tiny()
+    params = gpt2.init(cfg, jax.random.PRNGKey(1))
+    t1 = jnp.zeros((1, 16), jnp.int32)
+    t2 = t1.at[0, 10].set(5)
+    l1 = gpt2.apply(params, t1, cfg)
+    l2 = gpt2.apply(params, t2, cfg)
+    np.testing.assert_allclose(l1[0, :10], l2[0, :10], atol=1e-5)
+    assert not np.allclose(l1[0, 10:], l2[0, 10:], atol=1e-5)
+
+
+def test_bert_forward_and_bidirectional():
+    cfg = bert.BertConfig.tiny()
+    params = bert.init(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    logits = bert.apply(params, tokens, cfg)
+    assert logits.shape == (2, cfg.num_classes)
+    # bidirectional: changing a late token changes the [CLS] features
+    t2 = tokens.at[0, 12].set(7)
+    l2 = bert.apply(params, t2, cfg)
+    assert not np.allclose(logits[0], l2[0], atol=1e-6)
+
+
+def test_mlp_trains():
+    cfg = mlp.MLPConfig(in_dim=16, hidden=(32,), num_classes=4)
+    params = mlp.init(cfg, jax.random.PRNGKey(0))
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(64, 16)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, 64))
+
+    @jax.jit
+    def step(params, opt_state):
+        loss, g = jax.value_and_grad(mlp.loss_fn)(params, {"x": x, "y": y}, cfg)
+        updates, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    first = None
+    for _ in range(30):
+        params, opt_state, loss = step(params, opt_state)
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.5
+    assert float(mlp.accuracy(params, {"x": x, "y": y}, cfg)) > 0.7
+
+
+def test_dryrun_multichip_8():
+    """The driver's multi-chip validation path: full sharded train step
+    (fsdp/sp/tp axes + ring attention) on the 8-device CPU mesh."""
+    import __graft_entry__ as g
+
+    g.dryrun_multichip(8)
