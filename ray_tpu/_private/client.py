@@ -20,7 +20,18 @@ from ray_tpu._private.object_store import ObjectLocation
 
 class CoreClient:
     def __init__(self, address: str, authkey: bytes, worker_id: Optional[bytes] = None, node_id: str = ""):
-        self.conn = MPClient(address, family="AF_UNIX", authkey=authkey)
+        from multiprocessing import AuthenticationError
+
+        # The handshake occasionally loses a challenge race when several
+        # processes connect at once — retry, it is not a credentials problem.
+        for attempt in range(5):
+            try:
+                self.conn = MPClient(address, family="AF_UNIX", authkey=authkey)
+                break
+            except (AuthenticationError, OSError, EOFError):
+                if attempt == 4:
+                    raise
+                time.sleep(0.05 * (attempt + 1))
         self.send_lock = threading.Lock()
         self._req_ids = itertools.count(1)
         self._pending: Dict[int, dict] = {}

@@ -59,6 +59,23 @@ TPU = "TPU"
 MEMORY = "memory"
 
 
+def _worker_pythonpath(existing: str) -> str:
+    """Workers see the driver's import universe: the package root plus every
+    directory on the driver's sys.path (the reference achieves this through
+    runtime-env/working-dir propagation) — functions pickled by reference
+    then resolve on the worker side."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    parts = [pkg_root]
+    for p in sys.path:
+        if p == "":  # interactive/-c drivers resolve imports from cwd
+            p = os.getcwd()
+        if p not in parts and os.path.exists(p):  # dirs and zip/egg entries
+            parts.append(p)
+    if existing:
+        parts.append(existing)
+    return os.pathsep.join(parts)
+
+
 def _fits(req: Dict[str, float], avail: Dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
 
@@ -254,11 +271,24 @@ class Node:
     # connection handling
     # ------------------------------------------------------------------
     def _accept_loop(self) -> None:
+        from multiprocessing import AuthenticationError
+
+        failures = 0
         while not self._shutdown:
             try:
                 conn = self._listener.accept()
-            except (OSError, EOFError):
-                break
+                failures = 0
+            except (AuthenticationError, OSError, EOFError):
+                # one peer dying mid-handshake (EOF/reset) or failing auth
+                # must not kill the listener; only stop when we're shutting
+                # down or the listener socket itself is persistently broken
+                if self._shutdown:
+                    break
+                failures += 1
+                if failures > 100:
+                    logger.error("accept loop: listener persistently failing; exiting")
+                    break
+                continue
             t = threading.Thread(target=self._reader_loop, args=(conn,), daemon=True)
             t.start()
 
@@ -360,8 +390,7 @@ class Node:
         env["RAY_TPU_NODE_ID"] = ns.node_id
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker"],
             env=env,
@@ -786,8 +815,7 @@ class Node:
                     env["RAY_TPU_NODE_ID"] = ns.node_id
                     env["RAY_TPU_WORKER_ID"] = worker_id.hex()
                     env["RAY_TPU_SESSION_DIR"] = self.session_dir
-                    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-                    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+                    env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
                     if art.tpu_ids:
                         env["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in art.tpu_ids)
                         env["RAY_TPU_ASSIGNED_TPUS"] = env["TPU_VISIBLE_CHIPS"]

@@ -1,0 +1,26 @@
+"""AIR-style shared layer: checkpoints, configs, session, results.
+
+The common currency among Train, Tune, Serve and RLlib — the analog of
+``python/ray/air`` (``Checkpoint`` ``air/checkpoint.py:60``, configs
+``air/config.py``, ``session.report`` ``air/session.py:41``).
+"""
+
+from ray_tpu.air.checkpoint import Checkpoint
+from ray_tpu.air.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.air.result import Result
+from ray_tpu.air import session
+
+__all__ = [
+    "Checkpoint",
+    "ScalingConfig",
+    "RunConfig",
+    "FailureConfig",
+    "CheckpointConfig",
+    "Result",
+    "session",
+]

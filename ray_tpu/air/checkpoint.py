@@ -1,0 +1,130 @@
+"""Checkpoint: the tagged-union checkpoint currency.
+
+Analog of ``python/ray/air/checkpoint.py:60``: one object losslessly
+interconvertible among a dict, a local directory, a URI (local-path or
+``file://`` in this build), and an object-store ref — the single currency
+Train/Tune/Serve/RLlib pass around (SURVEY §5.4).
+
+Sharded jax arrays go through orbax (:meth:`save_jax` / :meth:`load_jax`)
+so multi-host checkpointing is an async tensorstore write per shard rather
+than a driver-side gather.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import uuid
+from typing import Any, Dict, Optional
+
+_DICT_FILE = "checkpoint.pkl"
+_JAX_DIR = "jax_state"
+
+
+class Checkpoint:
+    """Exactly one of ``_data`` (dict), ``_local_path``, ``_obj_ref`` is set."""
+
+    def __init__(self, data: Optional[Dict] = None, local_path: Optional[str] = None,
+                 obj_ref=None):
+        if sum(x is not None for x in (data, local_path, obj_ref)) != 1:
+            raise ValueError("Checkpoint takes exactly one of data/local_path/obj_ref")
+        self._data = data
+        self._local_path = local_path
+        self._obj_ref = obj_ref
+        self._uuid = uuid.uuid4().hex
+
+    # -- constructors -------------------------------------------------
+    @classmethod
+    def from_dict(cls, data: Dict) -> "Checkpoint":
+        return cls(data=dict(data))
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        if not os.path.isdir(path):
+            raise ValueError(f"not a directory: {path}")
+        return cls(local_path=os.path.abspath(path))
+
+    @classmethod
+    def from_uri(cls, uri: str) -> "Checkpoint":
+        path = uri[len("file://"):] if uri.startswith("file://") else uri
+        return cls.from_directory(path)
+
+    @classmethod
+    def from_object_ref(cls, ref) -> "Checkpoint":
+        return cls(obj_ref=ref)
+
+    # -- conversions --------------------------------------------------
+    def to_dict(self) -> Dict:
+        if self._data is not None:
+            return dict(self._data)
+        if self._obj_ref is not None:
+            import ray_tpu
+
+            return Checkpoint.from_dict(ray_tpu.get(self._obj_ref)).to_dict()
+        fp = os.path.join(self._local_path, _DICT_FILE)
+        if os.path.exists(fp):
+            with open(fp, "rb") as f:
+                return pickle.load(f)
+        # directory checkpoint without a dict payload: expose the files
+        out: Dict[str, Any] = {}
+        for name in os.listdir(self._local_path):
+            p = os.path.join(self._local_path, name)
+            if os.path.isfile(p):
+                with open(p, "rb") as f:
+                    out[name] = f.read()
+        return out
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        path = path or os.path.join(tempfile.gettempdir(), f"ckpt_{self._uuid}")
+        os.makedirs(path, exist_ok=True)
+        if self._local_path is not None:
+            if os.path.abspath(path) != self._local_path:
+                shutil.copytree(self._local_path, path, dirs_exist_ok=True)
+        else:
+            with open(os.path.join(path, _DICT_FILE), "wb") as f:
+                pickle.dump(self.to_dict(), f, protocol=5)
+        return path
+
+    def to_uri(self, uri: str) -> str:
+        path = uri[len("file://"):] if uri.startswith("file://") else uri
+        self.to_directory(path)
+        return uri
+
+    def to_object_ref(self):
+        import ray_tpu
+
+        if self._obj_ref is not None:
+            return self._obj_ref
+        return ray_tpu.put(self.to_dict())
+
+    # -- jax state ----------------------------------------------------
+    @classmethod
+    def save_jax(cls, state: Any, path: str) -> "Checkpoint":
+        """Write a pytree of (possibly sharded) jax arrays with orbax."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        os.makedirs(path, exist_ok=True)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(path, _JAX_DIR), state, force=True)
+        ckptr.wait_until_finished()
+        return cls.from_directory(path)
+
+    def load_jax(self, abstract_state: Any = None) -> Any:
+        """Restore the orbax pytree (optionally resharded to match
+        ``abstract_state``'s shardings)."""
+        import orbax.checkpoint as ocp
+
+        if self._local_path is None:
+            raise ValueError("load_jax requires a directory checkpoint")
+        ckptr = ocp.StandardCheckpointer()
+        return ckptr.restore(
+            os.path.join(self._local_path, _JAX_DIR), abstract_state
+        )
+
+    def __repr__(self):
+        kind = ("dict" if self._data is not None
+                else "dir" if self._local_path else "objref")
+        return f"Checkpoint({kind})"

@@ -1,0 +1,51 @@
+"""Run/scaling configuration dataclasses (``python/ray/air/config.py``).
+
+``ScalingConfig`` speaks TPU natively: ``use_tpu`` + ``topology`` describe
+a pod slice, and ``placement_strategy`` defaults to the gang semantics a
+slice needs (all workers or none).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    num_workers: int = 1
+    use_tpu: bool = False
+    tpus_per_worker: int = 0  # chips each worker owns (0 with use_tpu=False)
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+    # TPU slice topology hint, e.g. "v5e-16" — informs mesh construction
+    topology: Optional[str] = None
+
+    @property
+    def worker_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1.0)
+        if self.use_tpu:
+            res.setdefault("TPU", float(self.tpus_per_worker or 1))
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: Optional[FailureConfig] = None
+    checkpoint_config: Optional[CheckpointConfig] = None
+    verbose: int = 1

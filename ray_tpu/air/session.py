@@ -1,0 +1,95 @@
+"""Training session: the worker-side API inside train loops.
+
+Analog of ``python/ray/air/session.py:41`` (``session.report``) and the
+``_TrainSession`` it fronts (``python/ray/train/_internal/session.py:61``):
+the user's ``train_loop_per_worker`` calls ``report(metrics, checkpoint=)``
+and reads rank/world info; the hosting worker wires the queue back to the
+driver.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from ray_tpu.air.checkpoint import Checkpoint
+
+_session_lock = threading.Lock()
+_session: Optional["_Session"] = None
+
+
+class _Session:
+    def __init__(
+        self, *, world_size: int = 1, world_rank: int = 0, local_rank: int = 0,
+        trial_name: str = "", trial_id: str = "", checkpoint: Optional[Checkpoint] = None,
+        dataset_shards: Optional[Dict[str, Any]] = None, report_fn=None,
+    ):
+        self.world_size = world_size
+        self.world_rank = world_rank
+        self.local_rank = local_rank
+        self.trial_name = trial_name
+        self.trial_id = trial_id
+        self.loaded_checkpoint = checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self._report_fn = report_fn  # callable(metrics, checkpoint)
+
+    def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        if self._report_fn is not None:
+            self._report_fn(metrics, checkpoint)
+
+
+def _set_session(s: Optional[_Session]) -> None:
+    global _session
+    with _session_lock:
+        _session = s
+
+
+def _get_session() -> Optional[_Session]:
+    return _session
+
+
+def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None) -> None:
+    """Send metrics (and optionally a checkpoint) back to the driver."""
+    s = _get_session()
+    if s is None:
+        raise RuntimeError("session.report() called outside a train session")
+    s.report(metrics, checkpoint)
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    s = _get_session()
+    return s.loaded_checkpoint if s else None
+
+
+def get_world_size() -> int:
+    s = _get_session()
+    return s.world_size if s else 1
+
+
+def get_world_rank() -> int:
+    s = _get_session()
+    return s.world_rank if s else 0
+
+
+def get_local_rank() -> int:
+    s = _get_session()
+    return s.local_rank if s else 0
+
+
+def get_trial_name() -> str:
+    s = _get_session()
+    return s.trial_name if s else ""
+
+
+def get_trial_id() -> str:
+    s = _get_session()
+    return s.trial_id if s else ""
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's shard of the dataset passed to the Trainer
+    (``air/session.py:345``)."""
+    s = _get_session()
+    if s is None:
+        return None
+    return s.dataset_shards.get(name)

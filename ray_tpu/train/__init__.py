@@ -1,0 +1,29 @@
+"""Ray-Train-style distributed training orchestration, JAX/TPU-native.
+
+Actor ``WorkerGroup`` + pluggable ``Backend`` per SURVEY §3.5, with the
+torch/NCCL rendezvous (``python/ray/train/torch/config.py:69``) replaced by
+:class:`JaxConfig`: worker ranks join a collective group, and on real pods
+``jax.distributed.initialize`` over ICI makes every worker a process of one
+global SPMD program.
+"""
+
+from ray_tpu.air import Checkpoint, Result, RunConfig, ScalingConfig
+from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
+from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
+from ray_tpu.train.worker_group import WorkerGroup
+from ray_tpu.train import jax_utils
+
+__all__ = [
+    "Backend",
+    "BackendConfig",
+    "JaxConfig",
+    "BaseTrainer",
+    "DataParallelTrainer",
+    "JaxTrainer",
+    "WorkerGroup",
+    "jax_utils",
+    "Checkpoint",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+]

@@ -1,0 +1,112 @@
+"""BackendExecutor: drives the worker gang through a training run.
+
+Analog of ``python/ray/train/_internal/backend_executor.py:42`` (``start``
+``:93``, ``_create_placement_group`` ``:137``, ``start_training`` ``:314``,
+``get_next_results`` ``:411``) — placement-group creation lives inside
+WorkerGroup here; this class owns backend setup, launching the train fn,
+and draining per-worker result queues in lockstep.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.air import Checkpoint, ScalingConfig
+from ray_tpu.train.backend import Backend, BackendConfig
+from ray_tpu.train.worker_group import WorkerGroup
+
+
+class TrainingFailedError(RuntimeError):
+    pass
+
+
+class BackendExecutor:
+    def __init__(
+        self,
+        backend_config: BackendConfig,
+        scaling_config: ScalingConfig,
+    ):
+        self.backend_config = backend_config
+        self.scaling_config = scaling_config
+        self.backend: Backend = backend_config.backend_cls()
+        self.worker_group: Optional[WorkerGroup] = None
+        self._finished: List[bool] = []
+
+    def start(self) -> None:
+        sc = self.scaling_config
+        self.worker_group = WorkerGroup(
+            sc.num_workers, sc.worker_resources, sc.placement_strategy
+        )
+        self.backend.on_start(self.worker_group, self.backend_config)
+
+    def start_training(
+        self,
+        train_fn: Callable,
+        config: Optional[Dict] = None,
+        checkpoint: Optional[Checkpoint] = None,
+        dataset_shards: Optional[List[Dict[str, Any]]] = None,
+        trial_info: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.backend.on_training_start(self.worker_group, self.backend_config)
+        blob = cloudpickle.dumps(train_fn)
+        futures = []
+        for i, w in enumerate(self.worker_group.workers):
+            session_kwargs: Dict[str, Any] = {
+                "checkpoint": checkpoint,
+                "trial_name": (trial_info or {}).get("name", ""),
+                "trial_id": (trial_info or {}).get("id", ""),
+            }
+            if dataset_shards is not None:
+                session_kwargs["dataset_shards"] = dataset_shards[i]
+            futures.append(w.run_train_fn.remote(blob, config, session_kwargs))
+        ray_tpu.get(futures, timeout=300)
+        self._finished = [False] * self.worker_group.num_workers
+
+    def get_next_results(self, timeout: float = 600.0) -> Optional[List[tuple]]:
+        """One (kind, payload, checkpoint) per still-running worker; None
+        when every worker has finished.  A worker error raises — gang
+        training is all-or-nothing (a straggler is a distributed deadlock,
+        so failures surface immediately)."""
+        import time
+
+        if self.worker_group is None:
+            return None
+        if all(self._finished):
+            return None
+        deadline = time.monotonic() + timeout
+        results: Dict[int, tuple] = {}
+        while time.monotonic() < deadline:
+            pending = [
+                i for i in range(self.worker_group.num_workers)
+                if not self._finished[i] and i not in results
+            ]
+            if not pending:
+                break
+            futs = {
+                i: self.worker_group.workers[i].next_result.remote(timeout=5.0)
+                for i in pending
+            }
+            for i, f in futs.items():
+                kind, payload, ckpt = ray_tpu.get(f, timeout=60)
+                if kind == "pending":
+                    continue
+                if kind == "error":
+                    raise TrainingFailedError(
+                        f"worker {i} failed:\n{payload}"
+                    )
+                if kind == "finished":
+                    self._finished[i] = True
+                    continue
+                results[i] = (kind, payload, ckpt)
+        if all(self._finished) and not results:
+            return None
+        return [results[i] for i in sorted(results)] if results else []
+
+    def shutdown(self) -> None:
+        if self.worker_group is not None:
+            self.backend.on_shutdown(self.worker_group, self.backend_config)
+            self.worker_group.shutdown()
+            self.worker_group = None

@@ -1,0 +1,60 @@
+"""JAX training helpers for the data-parallel gang.
+
+The ``prepare_model``-shaped conveniences of the torch backend
+(``python/ray/train/torch/train_loop_utils.py:51,106``), re-thought for
+jax: gradient sync is one fused host all-reduce of the raveled pytree
+(one collective round per step, not one per leaf), and batch sharding is a
+pure function of rank.
+
+On a real multi-host pod with ``use_jax_distributed=True`` none of this is
+needed — the mesh spans hosts and ``psum`` inside pjit rides ICI; these
+helpers are the portable path (CPU dev boxes, single-host multi-process).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.air import session
+
+
+def allreduce_grads(grads: Any, group_name: Optional[str] = None) -> Any:
+    """Mean-all-reduce a grad pytree across the training gang (one round)."""
+    import jax
+    from jax.flatten_util import ravel_pytree
+
+    from ray_tpu.util import collective
+
+    import os
+
+    group = group_name or os.environ.get("RAY_TRAIN_COLLECTIVE_GROUP", "default")
+    flat, unravel = ravel_pytree(grads)
+    summed = collective.allreduce(np.asarray(flat), group_name=group, op="mean")
+    return unravel(jax.numpy.asarray(summed))
+
+
+def shard_batch(batch: Any, *, rank: Optional[int] = None, world_size: Optional[int] = None) -> Any:
+    """This rank's slice of a global batch (leading axis split)."""
+    import jax
+
+    rank = rank if rank is not None else session.get_world_rank()
+    world_size = world_size if world_size is not None else session.get_world_size()
+
+    def _slice(x):
+        n = x.shape[0]
+        per = n // world_size
+        return x[rank * per:(rank + 1) * per]
+
+    return jax.tree.map(_slice, batch)
+
+
+def global_mesh(axis_name: str = "dp"):
+    """1-D mesh over all addressable devices (after jax.distributed this is
+    the multi-host mesh)."""
+    import jax
+    import numpy as np_
+    from jax.sharding import Mesh
+
+    return Mesh(np_.asarray(jax.devices()), (axis_name,))

@@ -1,0 +1,196 @@
+"""Trainers: BaseTrainer / DataParallelTrainer / JaxTrainer.
+
+``BaseTrainer.fit`` (reference ``train/base_trainer.py:339``) returns a
+``Result``; ``DataParallelTrainer`` (``data_parallel_trainer.py:56``)
+drives a BackendExecutor gang through ``train_loop_per_worker``, collecting
+``session.report`` streams and keeping ranked checkpoints.  Fault
+tolerance: on worker failure the whole gang restarts from the latest
+checkpoint up to ``FailureConfig.max_failures`` times (gang = failure
+domain, the TPU-slice semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ray_tpu.air import (
+    Checkpoint,
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.backend import BackendConfig, JaxConfig
+from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
+
+
+class BaseTrainer:
+    def __init__(
+        self,
+        *,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        self.scaling_config = scaling_config or ScalingConfig()
+        self.run_config = run_config or RunConfig()
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.datasets = datasets or {}
+
+    def fit(self) -> Result:
+        raise NotImplementedError
+
+    def as_trainable(self):
+        """Wrap into a Tune trainable (base_trainer.py:500 analog)."""
+        from ray_tpu.tune.trainable import wrap_trainer
+
+        return wrap_trainer(self)
+
+
+class DataParallelTrainer(BaseTrainer):
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[Dict] = None,
+        backend_config: Optional[BackendConfig] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        resume_from_checkpoint: Optional[Checkpoint] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+    ):
+        super().__init__(
+            scaling_config=scaling_config, run_config=run_config,
+            resume_from_checkpoint=resume_from_checkpoint, datasets=datasets,
+        )
+        self.train_loop_per_worker = train_loop_per_worker
+        self.train_loop_config = train_loop_config
+        self.backend_config = backend_config or BackendConfig()
+
+    # ------------------------------------------------------------------
+    def _dataset_shards(self) -> Optional[List[Dict[str, Any]]]:
+        if not self.datasets:
+            return None
+        n = self.scaling_config.num_workers
+        shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "split"):
+                parts = ds.split(n)
+            else:  # plain sequence: even slices
+                per = len(ds) // n
+                parts = [ds[i * per:(i + 1) * per] for i in range(n)]
+            for i in range(n):
+                shards[i][name] = parts[i]
+        return shards
+
+    def _storage_dir(self) -> str:
+        base = self.run_config.storage_path or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_results"
+        )
+        name = self.run_config.name or f"train_{int(time.time())}"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        return path
+
+    def fit(self) -> Result:
+        failure_cfg = self.run_config.failure_config or FailureConfig()
+        ckpt_cfg = self.run_config.checkpoint_config or CheckpointConfig()
+        storage = self._storage_dir()
+        latest_ckpt = self.resume_from_checkpoint
+        failures = 0
+
+        while True:
+            executor = BackendExecutor(self.backend_config, self.scaling_config)
+            try:
+                executor.start()
+                executor.start_training(
+                    self.train_loop_per_worker,
+                    config=self.train_loop_config,
+                    checkpoint=latest_ckpt,
+                    dataset_shards=self._dataset_shards(),
+                    trial_info={"name": self.run_config.name or "train", "id": "0"},
+                )
+                manager = _CheckpointBook(storage, ckpt_cfg)
+                last_metrics: Optional[Dict] = None
+                while True:
+                    results = executor.get_next_results()
+                    if results is None:
+                        break
+                    for kind, metrics, ckpt in results:
+                        if kind != "report":
+                            continue
+                        # rank-0's stream defines the run's metrics
+                        last_metrics = metrics
+                        if ckpt is not None:
+                            manager.add(ckpt, metrics)
+                            latest_ckpt = ckpt
+                return Result(
+                    metrics=last_metrics,
+                    checkpoint=manager.best() or latest_ckpt,
+                    path=storage,
+                    best_checkpoints=manager.ranked(),
+                )
+            except TrainingFailedError as e:
+                failures += 1
+                if failures > failure_cfg.max_failures:
+                    return Result(metrics=None, checkpoint=latest_ckpt,
+                                  error=e, path=storage)
+                # whole-gang restart from the last checkpoint
+            finally:
+                executor.shutdown()
+
+
+class JaxTrainer(DataParallelTrainer):
+    """DataParallelTrainer defaulting to the JAX backend (the reference's
+    TorchTrainer seat, BASELINE configs 2-3)."""
+
+    def __init__(self, train_loop_per_worker, *, jax_config: Optional[JaxConfig] = None,
+                 **kwargs):
+        kwargs.setdefault("backend_config", jax_config or JaxConfig())
+        super().__init__(train_loop_per_worker, **kwargs)
+
+
+class _CheckpointBook:
+    """Rank + persist reported checkpoints (air CheckpointManager analog)."""
+
+    def __init__(self, storage: str, cfg: CheckpointConfig):
+        self.storage = storage
+        self.cfg = cfg
+        self.entries: List[tuple] = []  # (score, idx, Checkpoint)
+        self._idx = 0
+
+    def add(self, ckpt: Checkpoint, metrics: Optional[Dict]) -> None:
+        attr = self.cfg.checkpoint_score_attribute
+        score = (metrics or {}).get(attr) if attr else self._idx
+        if score is None:
+            score = self._idx
+        if self.cfg.checkpoint_score_order == "min":
+            score = -score
+        path = os.path.join(self.storage, f"checkpoint_{self._idx:06d}")
+        ckpt.to_directory(path)
+        self.entries.append((score, self._idx, Checkpoint.from_directory(path)))
+        self._idx += 1
+        keep = self.cfg.num_to_keep
+        if keep is not None and len(self.entries) > keep:
+            self.entries.sort(key=lambda e: (-e[0], -e[1]))
+            for _, idx, stale in self.entries[keep:]:
+                import shutil
+
+                shutil.rmtree(
+                    os.path.join(self.storage, f"checkpoint_{idx:06d}"),
+                    ignore_errors=True,
+                )
+            self.entries = self.entries[:keep]
+
+    def best(self) -> Optional[Checkpoint]:
+        if not self.entries:
+            return None
+        return max(self.entries, key=lambda e: (e[0], e[1]))[2]
+
+    def ranked(self) -> List[Checkpoint]:
+        return [e[2] for e in sorted(self.entries, key=lambda e: (-e[0], -e[1]))]

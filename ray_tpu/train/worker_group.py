@@ -1,0 +1,148 @@
+"""WorkerGroup: the gang of training actors.
+
+Analog of ``python/ray/train/_internal/worker_group.py:92``: N actors
+created inside a placement group (gang semantics — a TPU slice's hosts
+lease together and die together, SURVEY §7 hard-part 3), with broadcast
+execution and per-worker result queues.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.air import session as air_session
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+class TrainWorker:
+    """Actor hosting one rank of the training gang.
+
+    The user's train fn runs on a dedicated thread so the actor stays
+    responsive to ``next_result`` polls (the reference gets this from its
+    async result queue in ``_TrainSession``).
+    """
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+        self.queue: "queue.Queue" = queue.Queue()
+        self.thread: Optional[threading.Thread] = None
+        self.env: Dict[str, str] = {}
+
+    def setup_env(self, env: Dict[str, str]) -> bool:
+        import os
+
+        self.env = env
+        os.environ.update(env)
+        return True
+
+    def join_collective_group(self, world_size: int, rank: int, group_name: str) -> bool:
+        from ray_tpu.util import collective
+
+        collective.init_collective_group(world_size, rank, group_name=group_name)
+        return True
+
+    def execute(self, fn_blob: bytes, *args, **kwargs):
+        """Run a pickled callable synchronously and return its result."""
+        fn = cloudpickle.loads(fn_blob)
+        return fn(*args, **kwargs)
+
+    def run_train_fn(
+        self, fn_blob: bytes, config: Optional[dict],
+        session_kwargs: Dict[str, Any],
+    ) -> bool:
+        fn = cloudpickle.loads(fn_blob)
+        ckpt = session_kwargs.pop("checkpoint", None)
+
+        def report_fn(metrics, checkpoint):
+            self.queue.put(("report", metrics, checkpoint))
+
+        sess = air_session._Session(
+            world_size=self.world_size, world_rank=self.rank,
+            local_rank=self.rank, checkpoint=ckpt,
+            report_fn=report_fn, **session_kwargs,
+        )
+
+        def runner():
+            air_session._set_session(sess)
+            try:
+                if config is not None:
+                    fn(config)
+                else:
+                    fn()
+                self.queue.put(("finished", None, None))
+            except BaseException:  # noqa: BLE001
+                self.queue.put(("error", traceback.format_exc(), None))
+            finally:
+                air_session._set_session(None)
+
+        self.thread = threading.Thread(target=runner, daemon=True)
+        self.thread.start()
+        return True
+
+    def next_result(self, timeout: float = 30.0):
+        """One queued event, or ("pending", None, None) on timeout."""
+        try:
+            return self.queue.get(timeout=timeout)
+        except queue.Empty:
+            return ("pending", None, None)
+
+
+class WorkerGroup:
+    def __init__(
+        self,
+        num_workers: int,
+        resources_per_worker: Dict[str, float],
+        placement_strategy: str = "PACK",
+    ):
+        self.num_workers = num_workers
+        bundles = [dict(resources_per_worker) for _ in range(num_workers)]
+        self.pg = placement_group(bundles, strategy=placement_strategy)
+        ray_tpu.get(self.pg.ready(), timeout=60)
+        Worker = ray_tpu.remote(TrainWorker)
+        opts: Dict[str, Any] = {}
+        if "CPU" in resources_per_worker:
+            opts["num_cpus"] = resources_per_worker["CPU"]
+        if "TPU" in resources_per_worker:
+            opts["num_tpus"] = resources_per_worker["TPU"]
+        self.workers = [
+            Worker.options(
+                **opts,
+                scheduling_strategy=PlacementGroupSchedulingStrategy(
+                    placement_group=self.pg, placement_group_bundle_index=i
+                ),
+            ).remote(i, num_workers)
+            for i in range(num_workers)
+        ]
+
+    def execute(self, fn: Callable, *args, **kwargs) -> List[Any]:
+        """Run ``fn`` on every worker, gathered (worker_group.py:92 analog)."""
+        blob = cloudpickle.dumps(fn)
+        return ray_tpu.get(
+            [w.execute.remote(blob, *args, **kwargs) for w in self.workers],
+            timeout=300,
+        )
+
+    def execute_single(self, rank: int, fn: Callable, *args, **kwargs) -> Any:
+        blob = cloudpickle.dumps(fn)
+        return ray_tpu.get(
+            self.workers[rank].execute.remote(blob, *args, **kwargs), timeout=300
+        )
+
+    def shutdown(self) -> None:
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        try:
+            remove_placement_group(self.pg)
+        except Exception:
+            pass

@@ -1,0 +1,43 @@
+"""Tune: experiment runner — trial scheduling, search, checkpointing.
+
+Analog of ``python/ray/tune`` (``Tuner`` ``tune/tuner.py:44``, ``tune.run``
+``tune/tune.py:131``, ``TrialRunner`` ``execution/trial_runner.py:320``):
+trials run as actors, schedulers (ASHA/PBT/median-stopping) make
+continue/stop decisions on reported results, and Train runs on Tune via
+``BaseTrainer.as_trainable``.
+"""
+
+from ray_tpu.tune.trainable import Trainable, wrap_function
+from ray_tpu.tune.search.sample import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.tuner import TuneConfig, Tuner, run
+from ray_tpu.tune.result_grid import ResultGrid
+
+__all__ = [
+    "Trainable",
+    "wrap_function",
+    "uniform",
+    "loguniform",
+    "choice",
+    "randint",
+    "grid_search",
+    "FIFOScheduler",
+    "ASHAScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "Tuner",
+    "TuneConfig",
+    "run",
+    "ResultGrid",
+]

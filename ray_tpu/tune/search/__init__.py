@@ -1,0 +1,25 @@
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.sample import (
+    Categorical,
+    Domain,
+    Float,
+    Integer,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    uniform,
+)
+
+__all__ = [
+    "BasicVariantGenerator",
+    "Domain",
+    "Float",
+    "Integer",
+    "Categorical",
+    "uniform",
+    "loguniform",
+    "choice",
+    "randint",
+    "grid_search",
+]

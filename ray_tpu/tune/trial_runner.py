@@ -1,0 +1,178 @@
+"""TrialRunner: the experiment event loop.
+
+Analog of ``python/ray/tune/execution/trial_runner.py:320`` +
+``ray_trial_executor.py:213``: each trial is a dedicated actor hosting its
+Trainable; the loop starts trials up to the concurrency cap, waits on
+in-flight ``train()`` futures, routes results through the scheduler, and
+checkpoints/stops per its decisions.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu.tune import experiment as T
+from ray_tpu.tune.schedulers import CONTINUE, FIFOScheduler, STOP
+from ray_tpu.tune.trainable import DONE
+
+logger = logging.getLogger(__name__)
+
+
+class _TrialHost:
+    """Actor hosting one trial's Trainable instance."""
+
+    def __init__(self, trainable_blob: bytes, config: Dict[str, Any]):
+        cls = cloudpickle.loads(trainable_blob)
+        self.trainable = cls(config)
+
+    def train(self) -> Dict[str, Any]:
+        return self.trainable.train()
+
+    def save(self):
+        return self.trainable.save()
+
+    def restore(self, ckpt) -> bool:
+        self.trainable.restore(ckpt)
+        return True
+
+    def reset(self, trainable_blob: bytes, config: Dict[str, Any], ckpt) -> bool:
+        """PBT exploit: new config (+ donor checkpoint) in place."""
+        if not self.trainable.reset_config(config):
+            self.trainable.stop()
+            cls = cloudpickle.loads(trainable_blob)
+            self.trainable = cls(config)
+        if ckpt is not None:
+            self.trainable.restore(ckpt)
+        return True
+
+    def stop(self) -> bool:
+        self.trainable.stop()
+        return True
+
+
+class TrialRunner:
+    def __init__(
+        self,
+        trainable_cls: type,
+        trials: List[T.Trial],
+        scheduler: Optional[FIFOScheduler] = None,
+        max_concurrent: int = 4,
+        resources_per_trial: Optional[Dict[str, float]] = None,
+        max_failures: int = 0,
+        stop: Optional[Dict[str, Any]] = None,
+    ):
+        self.trainable_blob = cloudpickle.dumps(trainable_cls)
+        self.trials = trials
+        self.scheduler = scheduler or FIFOScheduler()
+        self.max_concurrent = max_concurrent
+        self.resources = resources_per_trial or {"CPU": 1.0}
+        self.max_failures = max_failures
+        self.stop_criteria = stop or {}
+
+    # -- scheduler support services -----------------------------------
+    def get_trial(self, trial_id: str) -> Optional[T.Trial]:
+        for t in self.trials:
+            if t.trial_id == trial_id:
+                return t
+        return None
+
+    def exploit_trial(self, trial: T.Trial, donor: T.Trial, new_config: Dict) -> None:
+        """Clone donor's weights into ``trial`` with an explored config."""
+        if donor.actor is None or trial.actor is None:
+            return
+        ckpt = ray_tpu.get(donor.actor.save.remote(), timeout=120)
+        trial.config = new_config
+        ray_tpu.get(
+            trial.actor.reset.remote(self.trainable_blob, new_config, ckpt),
+            timeout=300,
+        )
+
+    # -- lifecycle -----------------------------------------------------
+    def _start_trial(self, trial: T.Trial) -> None:
+        Host = ray_tpu.remote(_TrialHost)
+        opts = {}
+        if "CPU" in self.resources:
+            opts["num_cpus"] = self.resources["CPU"]
+        if "TPU" in self.resources:
+            opts["num_tpus"] = self.resources["TPU"]
+        trial.actor = Host.options(**opts).remote(self.trainable_blob, trial.config)
+        if trial.checkpoint is not None:
+            ray_tpu.get(trial.actor.restore.remote(trial.checkpoint), timeout=300)
+        trial.future = trial.actor.train.remote()
+        trial.status = T.RUNNING
+
+    def _stop_trial(self, trial: T.Trial, status: str, save: bool = True) -> None:
+        if trial.actor is not None:
+            try:
+                if save:
+                    ckpt = ray_tpu.get(trial.actor.save.remote(), timeout=120)
+                    if ckpt is not None:
+                        trial.checkpoint = ckpt
+                ray_tpu.get(trial.actor.stop.remote(), timeout=60)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(trial.actor)
+            except Exception:
+                pass
+        trial.actor = None
+        trial.future = None
+        trial.status = status
+
+    def _should_stop(self, result: Dict[str, Any]) -> bool:
+        if result.get(DONE):
+            return True
+        for key, bound in self.stop_criteria.items():
+            v = result.get(key)
+            if v is not None and v >= bound:
+                return True
+        return False
+
+    def step(self) -> bool:
+        """One event-loop turn; returns False when the experiment is done."""
+        running = [t for t in self.trials if t.status == T.RUNNING]
+        pending = [t for t in self.trials if t.status == T.PENDING]
+        if not running and not pending:
+            return False
+        for t in pending[: max(0, self.max_concurrent - len(running))]:
+            self._start_trial(t)
+            running.append(t)
+        if not running:
+            return False
+
+        futures = {t.future: t for t in running if t.future is not None}
+        ready, _ = ray_tpu.wait(list(futures), num_returns=1, timeout=120.0)
+        for fut in ready:
+            trial = futures[fut]
+            try:
+                result = ray_tpu.get(fut)
+            except Exception as e:  # noqa: BLE001
+                trial.num_failures += 1
+                if trial.num_failures > self.max_failures:
+                    trial.error = str(e)
+                    self._stop_trial(trial, T.ERROR, save=False)
+                else:
+                    self._stop_trial(trial, T.PENDING, save=False)
+                continue
+            # merge: the synthetic terminal {done: True} must not clobber the
+            # last real metrics
+            trial.last_result = {**(trial.last_result or {}), **result}
+            if self._should_stop(result):
+                self.scheduler.on_trial_complete(self, trial, result)
+                self._stop_trial(trial, T.TERMINATED)
+                continue
+            decision = self.scheduler.on_trial_result(self, trial, result)
+            if decision == STOP:
+                self._stop_trial(trial, T.TERMINATED)
+            else:
+                trial.future = trial.actor.train.remote()
+        return True
+
+    def run(self) -> List[T.Trial]:
+        while self.step():
+            pass
+        return self.trials

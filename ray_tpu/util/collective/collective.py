@@ -18,20 +18,19 @@ result as a zero-copy object-store read.
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional
 
 import numpy as np
 
 import ray_tpu
 
-_local = threading.local()
+# Process-global: a worker joins a group once and may drive it from any
+# thread (train loops run on their own thread inside the hosting actor).
+_GROUPS: Dict[str, "_GroupHandle"] = {}
 
 
 def _groups() -> Dict[str, "_GroupHandle"]:
-    if not hasattr(_local, "groups"):
-        _local.groups = {}
-    return _local.groups
+    return _GROUPS
 
 
 class _Coordinator:
@@ -101,17 +100,21 @@ class _Coordinator:
 
 class _GroupHandle:
     def __init__(self, name: str, world_size: int, rank: int, coordinator):
+        import threading
+
         self.name = name
         self.world_size = world_size
         self.rank = rank
         self.coordinator = coordinator
         self.round_id = 0
+        self._round_lock = threading.Lock()
 
     def _run(self, value, op: str, timeout: float = 120.0):
         import time
 
-        rid = self.round_id
-        self.round_id += 1
+        with self._round_lock:
+            rid = self.round_id
+            self.round_id += 1
         self.coordinator.contribute.remote(rid, self.rank, value, op)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:

@@ -1,0 +1,113 @@
+"""Tune experiment tests: variants, schedulers, Tuner, restore."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.air import RunConfig, session
+from ray_tpu.tune import ASHAScheduler, TuneConfig, Tuner
+from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+
+
+def test_variant_generation():
+    gen = BasicVariantGenerator(seed=0)
+    space = {
+        "lr": tune.grid_search([0.1, 0.01]),
+        "wd": tune.uniform(0.0, 1.0),
+        "nested": {"units": tune.choice([32, 64])},
+        "fixed": 7,
+    }
+    variants = list(gen.variants(space, num_samples=2))
+    assert len(variants) == 4  # 2 grid x 2 samples
+    assert {v["lr"] for v in variants} == {0.1, 0.01}
+    for v in variants:
+        assert 0.0 <= v["wd"] <= 1.0
+        assert v["nested"]["units"] in (32, 64)
+        assert v["fixed"] == 7
+
+
+def _objective(config):
+    # quadratic bowl: best at x = 3
+    for step in range(8):
+        loss = (config["x"] - 3.0) ** 2 + 0.1 * step
+        session.report({"loss": loss, "training_iteration": step + 1})
+
+
+def test_tuner_grid(ray_start_regular, tmp_path):
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0.0, 3.0, 5.0])},
+        tune_config=TuneConfig(metric="loss", mode="min", max_concurrent_trials=2),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 3
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["config"]["x"] == 3.0
+
+
+def test_asha_stops_bad_trials(ray_start_regular, tmp_path):
+    sched = ASHAScheduler(metric="loss", mode="min", max_t=8,
+                          grace_period=2, reduction_factor=2)
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([0.0, 1.0, 3.0, 10.0])},
+        tune_config=TuneConfig(metric="loss", mode="min", scheduler=sched,
+                               max_concurrent_trials=2),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.metrics["config"]["x"] == 3.0
+    # at least one bad trial stopped before max_t
+    iters = [grid[i].metrics.get("training_iteration", 0) for i in range(len(grid))]
+    assert min(iters) < 8
+
+
+class _Counter(tune.Trainable):
+    def setup(self, config):
+        self.count = config.get("start", 0)
+
+    def step(self):
+        self.count += 1
+        return {"count": self.count, "done": self.count >= 5}
+
+    def save_checkpoint(self):
+        return {"count": self.count}
+
+    def load_checkpoint(self, state):
+        self.count = state["count"]
+
+
+def test_class_trainable_and_checkpoint(ray_start_regular, tmp_path):
+    tuner = Tuner(
+        _Counter,
+        param_space={"start": tune.grid_search([0, 10])},
+        tune_config=TuneConfig(metric="count", mode="max"),
+        run_config=RunConfig(name="cls", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.metrics["count"] >= 10
+    assert best.checkpoint is not None
+    assert best.checkpoint.to_dict()["count"] == best.metrics["count"]
+
+
+def test_tuner_restore(ray_start_regular, tmp_path):
+    tuner = Tuner(
+        _Counter,
+        param_space={"start": tune.grid_search([0])},
+        tune_config=TuneConfig(metric="count", mode="max"),
+        run_config=RunConfig(name="resume", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert grid[0].metrics["count"] == 5
+    restored = Tuner.restore(
+        str(tmp_path / "resume"), _Counter,
+        tune_config=TuneConfig(metric="count", mode="max"),
+    )
+    grid2 = restored.fit()  # everything terminated: results survive
+    assert grid2[0].metrics["count"] == 5
