@@ -35,8 +35,12 @@ def _pad(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
 
 
-class _OOBPickler(pickle.Pickler):
-    """Pickler that collects out-of-band buffers and contained ObjectRefs."""
+import cloudpickle as _cloudpickle
+
+
+class _OOBPickler(_cloudpickle.Pickler):
+    """cloudpickle-based pickler (lambdas/closures work) that additionally
+    collects out-of-band buffers and contained ObjectRefs."""
 
     def __init__(self, file, collected_refs: list):
         super().__init__(file, protocol=5, buffer_callback=self._buffer_cb)
@@ -53,7 +57,8 @@ class _OOBPickler(pickle.Pickler):
         if isinstance(obj, ObjectRef):
             self._collected_refs.append(obj)
             return (_deserialize_object_ref, (obj.hex(),))
-        return NotImplemented
+        # cloudpickle's own reducer_override handles functions/classes
+        return super().reducer_override(obj)
 
 
 def _deserialize_object_ref(hex_id: str):

@@ -1,0 +1,42 @@
+"""ray_tpu.data — distributed datasets over object-store blocks.
+
+Analog of ``python/ray/data`` (``Dataset`` ``data/dataset.py:139``): read
+connectors fan out one task per file, transforms run as tasks or actor
+pools over blocks, and ``iter_batches``/``split`` feed training workers.
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.dataset import ActorPoolStrategy, Dataset
+from ray_tpu.data.dataset_pipeline import DatasetPipeline
+from ray_tpu.data.read_api import (
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Dataset",
+    "DatasetPipeline",
+    "ActorPoolStrategy",
+    "Block",
+    "BlockAccessor",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_csv",
+    "read_json",
+    "read_parquet",
+    "read_numpy",
+    "read_text",
+    "read_binary_files",
+]

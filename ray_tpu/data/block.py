@@ -1,0 +1,88 @@
+"""Blocks: the unit of distributed data.
+
+Analog of ``python/ray/data/block.py``: a block is an object-store value
+holding a batch of rows — here either a list of rows or a dict-of-numpy
+column table.  ``BlockAccessor`` normalizes the two layouts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Union
+
+import numpy as np
+
+Block = Union[List[Any], Dict[str, np.ndarray]]
+
+
+class BlockAccessor:
+    def __init__(self, block: Block):
+        self.block = block
+        self.is_table = isinstance(block, dict)
+
+    @staticmethod
+    def for_block(block: Block) -> "BlockAccessor":
+        return BlockAccessor(block)
+
+    def num_rows(self) -> int:
+        if self.is_table:
+            return len(next(iter(self.block.values()))) if self.block else 0
+        return len(self.block)
+
+    def iter_rows(self) -> Iterator[Any]:
+        if self.is_table:
+            keys = list(self.block)
+            if keys == ["value"]:  # simple block: rows are the plain values
+                yield from self.block["value"]
+                return
+            for i in range(self.num_rows()):
+                yield {k: self.block[k][i] for k in keys}
+        else:
+            yield from self.block
+
+    def to_rows(self) -> List[Any]:
+        return list(self.iter_rows())
+
+    def to_batch(self) -> Dict[str, np.ndarray]:
+        """Columnar view (dict of numpy arrays)."""
+        if self.is_table:
+            return dict(self.block)
+        if not self.block:
+            return {}
+        first = self.block[0]
+        if isinstance(first, dict):
+            return {
+                k: np.asarray([r[k] for r in self.block]) for k in first
+            }
+        return {"value": np.asarray(self.block)}
+
+    def slice(self, start: int, end: int) -> Block:
+        if self.is_table:
+            return {k: v[start:end] for k, v in self.block.items()}
+        return self.block[start:end]
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        if self.num_rows() == 0:
+            return None
+        batch = self.to_batch()
+        return {k: str(v.dtype) for k, v in batch.items()}
+
+    @staticmethod
+    def concat(blocks: List[Block]) -> Block:
+        blocks = [b for b in blocks if BlockAccessor(b).num_rows() > 0]
+        if not blocks:
+            return []
+        if isinstance(blocks[0], dict):
+            keys = list(blocks[0])
+            return {k: np.concatenate([b[k] for b in blocks]) for k in keys}
+        out: List[Any] = []
+        for b in blocks:
+            out.extend(b)
+        return out
+
+    @staticmethod
+    def from_batch(batch: Union[Dict[str, np.ndarray], np.ndarray, List]) -> Block:
+        if isinstance(batch, dict):
+            return {k: np.asarray(v) for k, v in batch.items()}
+        if isinstance(batch, np.ndarray):
+            return {"value": batch}
+        return list(batch)

@@ -1,0 +1,335 @@
+"""Dataset: distributed data over object-store blocks.
+
+Analog of ``python/ray/data/dataset.py:139``: a Dataset is a list of
+object refs to blocks; transforms run as parallel tasks over blocks
+(``TaskPoolStrategy``, ``_internal/compute.py:58``) or through a pool of
+reusable actors (``ActorPoolStrategy``, ``:176``) for stateful/expensive
+setup (e.g. a jax model for batch inference).  Eager execution per stage —
+the reference's lazy ExecutionPlan optimizations (stage fusion) are
+deferred; on TPU the heavy compute belongs in jitted batch fns, so the
+per-stage overhead is the small part.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import Block, BlockAccessor
+
+
+def _apply_batches(block: Block, fn: Callable, batch_size: Optional[int],
+                   batch_format: str) -> Block:
+    acc = BlockAccessor(block)
+    n = acc.num_rows()
+    if n == 0:
+        return block
+    size = batch_size or n
+    outs = []
+    for start in range(0, n, size):
+        sub = BlockAccessor(acc.slice(start, min(start + size, n)))
+        if batch_format == "numpy":
+            batch = sub.to_batch()
+            if set(batch) == {"value"}:
+                batch = batch["value"]
+        elif batch_format == "rows":
+            batch = sub.to_rows()
+        else:
+            raise ValueError(f"unknown batch_format {batch_format!r}")
+        outs.append(BlockAccessor.from_batch(fn(batch)))
+    return BlockAccessor.concat(outs)
+
+
+def _map_rows(block: Block, fn: Callable) -> Block:
+    return [fn(r) for r in BlockAccessor(block).iter_rows()]
+
+
+def _flat_map(block: Block, fn: Callable) -> Block:
+    out: List[Any] = []
+    for r in BlockAccessor(block).iter_rows():
+        out.extend(fn(r))
+    return out
+
+
+def _filter(block: Block, fn: Callable) -> Block:
+    return [r for r in BlockAccessor(block).iter_rows() if fn(r)]
+
+
+class _BatchWorker:
+    """ActorPoolStrategy worker: holds a callable-class instance."""
+
+    def __init__(self, fn_cls_blob: bytes, args: tuple, kwargs: dict):
+        import cloudpickle
+
+        cls = cloudpickle.loads(fn_cls_blob)
+        self.fn = cls(*args, **kwargs)
+
+    def apply(self, block: Block, batch_size: Optional[int], batch_format: str) -> Block:
+        return _apply_batches(block, self.fn, batch_size, batch_format)
+
+
+class ActorPoolStrategy:
+    def __init__(self, size: int = 2, min_size: Optional[int] = None,
+                 max_size: Optional[int] = None):
+        self.size = max_size or size
+
+
+class Dataset:
+    def __init__(self, block_refs: List[Any], num_rows: Optional[List[int]] = None):
+        self._blocks = list(block_refs)
+        self._num_rows = num_rows
+
+    # -- basics --------------------------------------------------------
+    def num_blocks(self) -> int:
+        return len(self._blocks)
+
+    def count(self) -> int:
+        if self._num_rows is None:
+            self._num_rows = [
+                BlockAccessor(b).num_rows() for b in ray_tpu.get(self._blocks)
+            ]
+        return sum(self._num_rows)
+
+    def schema(self) -> Optional[Dict[str, str]]:
+        for b in self._blocks:
+            s = BlockAccessor(ray_tpu.get(b)).schema()
+            if s:
+                return s
+        return None
+
+    def take(self, limit: int = 20) -> List[Any]:
+        out: List[Any] = []
+        for ref in self._blocks:
+            out.extend(BlockAccessor(ray_tpu.get(ref)).to_rows())
+            if len(out) >= limit:
+                break
+        return out[:limit]
+
+    def take_all(self) -> List[Any]:
+        out: List[Any] = []
+        for ref in self._blocks:
+            out.extend(BlockAccessor(ray_tpu.get(ref)).to_rows())
+        return out
+
+    def show(self, limit: int = 20) -> None:
+        for row in self.take(limit):
+            print(row)
+
+    # -- transforms (TaskPool by default) ------------------------------
+    def _transform(self, remote_fn: Callable, *args) -> "Dataset":
+        task = ray_tpu.remote(num_cpus=1)(remote_fn)
+        new_refs = [task.remote(ref, *args) for ref in self._blocks]
+        return Dataset(new_refs)
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._transform(_map_rows, fn)
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._transform(_flat_map, fn)
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._transform(_filter, fn)
+
+    def map_batches(
+        self,
+        fn: Union[Callable, type],
+        *,
+        batch_size: Optional[int] = None,
+        batch_format: str = "numpy",
+        compute: Optional[ActorPoolStrategy] = None,
+        fn_constructor_args: tuple = (),
+        fn_constructor_kwargs: Optional[dict] = None,
+        num_tpus: float = 0,
+    ) -> "Dataset":
+        """Batch transform (dataset.py:323).  Pass a class + ActorPoolStrategy
+        for stateful fns (model inference on num_tpus=1 actors)."""
+        if isinstance(fn, type):
+            if compute is None:
+                compute = ActorPoolStrategy()
+            import cloudpickle
+
+            blob = cloudpickle.dumps(fn)
+            opts = {"num_cpus": 1}
+            if num_tpus:
+                opts["num_tpus"] = num_tpus
+            Worker = ray_tpu.remote(**opts)(_BatchWorker)
+            pool = [
+                Worker.remote(blob, fn_constructor_args, fn_constructor_kwargs or {})
+                for _ in range(min(compute.size, len(self._blocks) or 1))
+            ]
+            refs = [
+                pool[i % len(pool)].apply.remote(ref, batch_size, batch_format)
+                for i, ref in enumerate(self._blocks)
+            ]
+            return Dataset(refs)
+        return self._transform(_apply_batches, fn, batch_size, batch_format)
+
+    # -- reorg ---------------------------------------------------------
+    def repartition(self, num_blocks: int) -> "Dataset":
+        rows = self.take_all()
+        per = math.ceil(len(rows) / num_blocks) if rows else 0
+        blocks = [rows[i * per:(i + 1) * per] for i in range(num_blocks)]
+        return Dataset([ray_tpu.put(b) for b in blocks],
+                       [len(b) for b in blocks])
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        """All-to-all shuffle (the reference's push-based shuffle collapses
+        to a local pass on the fake cluster)."""
+        rows = self.take_all()
+        random.Random(seed).shuffle(rows)
+        n = max(1, self.num_blocks())
+        per = math.ceil(len(rows) / n)
+        blocks = [rows[i * per:(i + 1) * per] for i in range(n)]
+        return Dataset([ray_tpu.put(b) for b in blocks], [len(b) for b in blocks])
+
+    def sort(self, key: Optional[Union[str, Callable]] = None, descending: bool = False) -> "Dataset":
+        rows = self.take_all()
+        if isinstance(key, str):
+            keyfn = lambda r: r[key]
+        else:
+            keyfn = key
+        rows.sort(key=keyfn, reverse=descending)
+        n = max(1, self.num_blocks())
+        per = math.ceil(len(rows) / n)
+        blocks = [rows[i * per:(i + 1) * per] for i in range(n)]
+        return Dataset([ray_tpu.put(b) for b in blocks], [len(b) for b in blocks])
+
+    def split(self, n: int, *, equal: bool = True) -> List["Dataset"]:
+        """n shards for n training workers (dataset.py:1017)."""
+        rows = self.take_all()
+        per = len(rows) // n
+        shards = []
+        for i in range(n):
+            end = (i + 1) * per if (equal or i < n - 1) else len(rows)
+            shard_rows = rows[i * per:end]
+            shards.append(Dataset([ray_tpu.put(shard_rows)], [len(shard_rows)]))
+        return shards
+
+    def split_at_indices(self, indices: Sequence[int]) -> List["Dataset"]:
+        rows = self.take_all()
+        out, prev = [], 0
+        for idx in list(indices) + [len(rows)]:
+            chunk = rows[prev:idx]
+            out.append(Dataset([ray_tpu.put(chunk)], [len(chunk)]))
+            prev = idx
+        return out
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        refs = list(self._blocks)
+        for o in others:
+            refs.extend(o._blocks)
+        return Dataset(refs)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        a, b = self.take_all(), other.take_all()
+        rows = [
+            {**(x if isinstance(x, dict) else {"left": x}),
+             **({f"right_{k}" if k in (x if isinstance(x, dict) else {}) else k: v
+                 for k, v in (y if isinstance(y, dict) else {"right": y}).items()})}
+            for x, y in zip(a, b)
+        ]
+        return Dataset([ray_tpu.put(rows)], [len(rows)])
+
+    # -- aggregates ----------------------------------------------------
+    def _column(self, on: Optional[str]) -> np.ndarray:
+        vals: List[Any] = []
+        for ref in self._blocks:
+            batch = BlockAccessor(ray_tpu.get(ref)).to_batch()
+            if not batch:
+                continue
+            col = on or ("value" if "value" in batch else next(iter(batch)))
+            vals.append(np.asarray(batch[col]))
+        return np.concatenate(vals) if vals else np.asarray([])
+
+    def sum(self, on: Optional[str] = None):
+        col = self._column(on)
+        return col.sum().item() if col.size else 0
+
+    def min(self, on: Optional[str] = None):
+        return self._column(on).min().item()
+
+    def max(self, on: Optional[str] = None):
+        return self._column(on).max().item()
+
+    def mean(self, on: Optional[str] = None):
+        return self._column(on).mean().item()
+
+    def std(self, on: Optional[str] = None):
+        return self._column(on).std().item()
+
+    # -- consumption ---------------------------------------------------
+    def iter_rows(self) -> Iterator[Any]:
+        for ref in self._blocks:
+            yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
+
+    def iter_batches(
+        self, *, batch_size: int = 256, batch_format: str = "numpy",
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        """Stream batches (dataset.py:2624); block fetches overlap consumption
+        by prefetching the next block ref."""
+        carry: List[Any] = []
+        for ref in self._blocks:
+            rows = BlockAccessor(ray_tpu.get(ref)).to_rows()
+            carry.extend(rows)
+            while len(carry) >= batch_size:
+                chunk, carry = carry[:batch_size], carry[batch_size:]
+                yield self._format_batch(chunk, batch_format)
+        if carry and not drop_last:
+            yield self._format_batch(carry, batch_format)
+
+    @staticmethod
+    def _format_batch(rows: List[Any], batch_format: str):
+        if batch_format == "rows":
+            return rows
+        batch = BlockAccessor(rows).to_batch()
+        if batch_format == "numpy":
+            if set(batch) == {"value"}:
+                return batch["value"]
+            return batch
+        if batch_format == "pandas":
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        raise ValueError(f"unknown batch_format {batch_format!r}")
+
+    def to_numpy(self, column: Optional[str] = None) -> np.ndarray:
+        return self._column(column)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.DataFrame(self.take_all())
+
+    def materialize(self) -> "Dataset":
+        ray_tpu.get(self._blocks)
+        return self
+
+    # -- pipeline ------------------------------------------------------
+    def window(self, *, blocks_per_window: int = 1) -> "DatasetPipeline":
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        # one pass over the data; .repeat() is the API for more epochs
+        return DatasetPipeline.from_dataset(self, blocks_per_window, repeat=1)
+
+    def repeat(self, times: Optional[int] = None) -> "DatasetPipeline":
+        from ray_tpu.data.dataset_pipeline import DatasetPipeline
+
+        return DatasetPipeline.from_dataset(self, self.num_blocks() or 1, repeat=times)
+
+    # -- io ------------------------------------------------------------
+    def write_csv(self, path: str) -> None:
+        self.to_pandas().to_csv(path, index=False)
+
+    def write_json(self, path: str) -> None:
+        self.to_pandas().to_json(path, orient="records", lines=True)
+
+    def write_parquet(self, path: str) -> None:
+        self.to_pandas().to_parquet(path)
+
+    def __repr__(self):
+        return f"Dataset(num_blocks={self.num_blocks()}, num_rows={self.count()})"

@@ -1,0 +1,32 @@
+"""ray_tpu.serve — model serving on TPU-backed replicas.
+
+Analog of ``python/ray/serve`` (SURVEY §3.6): a controller actor reconciles
+declarative deployment state into replica actors (``num_tpus=1`` replicas
+for BASELINE config 5), handles route through a round-robin router under a
+max-concurrent-queries cap, and an HTTP proxy actor exposes deployments
+over REST.
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_deployment_handle,
+    run,
+    shutdown,
+    status,
+)
+from ray_tpu.serve.handle import DeploymentHandle
+
+__all__ = [
+    "deployment",
+    "Deployment",
+    "Application",
+    "run",
+    "delete",
+    "status",
+    "shutdown",
+    "get_deployment_handle",
+    "DeploymentHandle",
+]

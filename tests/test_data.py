@@ -1,0 +1,126 @@
+"""Dataset tests (transforms, shuffles, splits, io, pipeline)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+
+
+def test_range_and_aggregates(ray_start_regular):
+    ds = rd.range(100, parallelism=4)
+    assert ds.count() == 100
+    assert ds.num_blocks() == 4
+    assert ds.sum() == 4950
+    assert ds.min() == 0 and ds.max() == 99
+    assert ds.mean() == 49.5
+
+
+def test_from_items_map_filter(ray_start_regular):
+    ds = rd.from_items([{"x": i} for i in range(20)], parallelism=2)
+    out = (
+        ds.map(lambda r: {"x": r["x"] * 2})
+          .filter(lambda r: r["x"] % 4 == 0)
+          .take_all()
+    )
+    assert [r["x"] for r in out] == [0, 4, 8, 12, 16, 20, 24, 28, 32, 36]
+
+
+def test_map_batches_numpy(ray_start_regular):
+    ds = rd.range(16, parallelism=2)
+    out = ds.map_batches(lambda batch: batch * 10, batch_size=4)
+    np.testing.assert_array_equal(out.to_numpy(), np.arange(16) * 10)
+
+
+def test_map_batches_actor_pool(ray_start_regular):
+    class AddConst:
+        def __init__(self, c):
+            self.c = c
+
+        def __call__(self, batch):
+            return batch + self.c
+
+    ds = rd.range(12, parallelism=3)
+    out = ds.map_batches(
+        AddConst, compute=rd.ActorPoolStrategy(size=2),
+        fn_constructor_args=(100,),
+    )
+    np.testing.assert_array_equal(np.sort(out.to_numpy()), np.arange(12) + 100)
+
+
+def test_flat_map_and_zip(ray_start_regular):
+    ds = rd.from_items([1, 2, 3], parallelism=1)
+    out = ds.flat_map(lambda x: [x, x]).take_all()
+    assert out == [1, 1, 2, 2, 3, 3]
+
+
+def test_split_and_union(ray_start_regular):
+    ds = rd.range(12, parallelism=2)
+    shards = ds.split(3)
+    assert [s.count() for s in shards] == [4, 4, 4]
+    joined = shards[0].union(shards[1], shards[2])
+    assert joined.count() == 12
+
+
+def test_shuffle_sort(ray_start_regular):
+    ds = rd.from_items(list(range(50)), parallelism=2)
+    shuffled = ds.random_shuffle(seed=0)
+    assert shuffled.take_all() != list(range(50))
+    assert sorted(shuffled.take_all()) == list(range(50))
+    s = rd.from_items([{"k": v} for v in [3, 1, 2]], parallelism=1).sort(key="k")
+    assert [r["k"] for r in s.take_all()] == [1, 2, 3]
+
+
+def test_iter_batches(ray_start_regular):
+    ds = rd.range(10, parallelism=3)
+    batches = list(ds.iter_batches(batch_size=4))
+    assert [len(b) for b in batches] == [4, 4, 2]
+    np.testing.assert_array_equal(np.concatenate(batches), np.arange(10))
+    dropped = list(ds.iter_batches(batch_size=4, drop_last=True))
+    assert [len(b) for b in dropped] == [4, 4]
+
+
+def test_csv_roundtrip(ray_start_regular, tmp_path):
+    import pandas as pd
+
+    df = pd.DataFrame({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+    p = str(tmp_path / "t.csv")
+    df.to_csv(p, index=False)
+    ds = rd.read_csv(p)
+    assert ds.count() == 3
+    assert set(ds.schema()) == {"a", "b"}
+    out = str(tmp_path / "out.csv")
+    ds.write_csv(out)
+    pd.testing.assert_frame_equal(pd.read_csv(out), df)
+
+
+def test_pipeline_window_repeat(ray_start_regular):
+    ds = rd.range(8, parallelism=4)
+    pipe = ds.window(blocks_per_window=2).map_batches(lambda b: b + 1)
+    rows = [int(r) for r in pipe.iter_rows()]
+    assert sorted(rows) == list(range(1, 9))
+    reps = rd.range(4, parallelism=1).repeat(2)
+    assert len(list(reps.iter_rows())) == 8
+
+
+def test_dataset_feeds_trainer_shards(ray_start_regular):
+    """Dataset.split -> session.get_dataset_shard wiring."""
+    from ray_tpu.air import session
+    from ray_tpu.train import JaxConfig, JaxTrainer
+    from ray_tpu.air import ScalingConfig
+
+    def loop(config):
+        shard = session.get_dataset_shard("train")
+        n = shard.count()
+        session.report({"rows": n, "rank": session.get_world_rank()})
+
+    ds = rd.range(8, parallelism=2)
+    trainer = JaxTrainer(
+        loop, jax_config=JaxConfig(),
+        scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": ds},
+        train_loop_config={},
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["rows"] == 4
