@@ -78,11 +78,10 @@ def main() -> None:
     n_params = gpt2.num_params(
         jax.eval_shape(lambda k: gpt2.init(cfg, k), jax.random.PRNGKey(0))
     )
-    # 6ND for the matmuls + 12*L*D*T^2 attention FLOPs, x(fwd+bwd) ~ already
-    # folded into the 6 and 12 constants; remat adds ~1 extra forward (x1.33)
+    # 6ND for the matmuls + 12*L*D*T^2 attention FLOPs, x(fwd+bwd) already
+    # folded into the 6 and 12 constants.  Model FLOPs only: remat's
+    # recomputation is NOT counted (that would be HFU, not MFU).
     flops_per_token = 6 * n_params + 12 * cfg.n_layers * cfg.d_model * T
-    if cfg.remat:
-        flops_per_token = int(flops_per_token * 4 / 3)
     mfu = tokens_per_sec * flops_per_token / peak_flops_per_chip()
 
     print(json.dumps({

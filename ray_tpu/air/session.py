@@ -14,8 +14,14 @@ from typing import Any, Dict, Optional
 
 from ray_tpu.air.checkpoint import Checkpoint
 
-_session_lock = threading.Lock()
-_session: Optional["_Session"] = None
+# Thread-local primary + process-global fallback: a superseded runner thread
+# (e.g. a PBT ``reset`` swapping trainables while the old fn drains) reads its
+# *own* session and can only CAS-clear the global if it still owns it, while
+# helper threads the user's train fn spawns (no TLS entry) still resolve the
+# most recently installed session.
+_tls = threading.local()
+_global_lock = threading.Lock()
+_global_session: Optional["_Session"] = None
 
 
 class _Session:
@@ -23,6 +29,7 @@ class _Session:
         self, *, world_size: int = 1, world_rank: int = 0, local_rank: int = 0,
         trial_name: str = "", trial_id: str = "", checkpoint: Optional[Checkpoint] = None,
         dataset_shards: Optional[Dict[str, Any]] = None, report_fn=None,
+        stop_event: Optional[threading.Event] = None,
     ):
         self.world_size = world_size
         self.world_rank = world_rank
@@ -32,6 +39,7 @@ class _Session:
         self.loaded_checkpoint = checkpoint
         self.dataset_shards = dataset_shards or {}
         self._report_fn = report_fn  # callable(metrics, checkpoint)
+        self.stop_event = stop_event
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
         if self._report_fn is not None:
@@ -39,13 +47,27 @@ class _Session:
 
 
 def _set_session(s: Optional[_Session]) -> None:
-    global _session
-    with _session_lock:
-        _session = s
+    global _global_session
+    prev = getattr(_tls, "session", None)
+    _tls.session = s
+    with _global_lock:
+        if s is not None:
+            _global_session = s
+        elif prev is not None and _global_session is prev:
+            _global_session = None
 
 
 def _get_session() -> Optional[_Session]:
-    return _session
+    s = getattr(_tls, "session", None)
+    return s if s is not None else _global_session
+
+
+def is_stop_requested() -> bool:
+    """True once the hosting trainable was told to stop (e.g. a PBT
+    ``reset`` superseded this trial) — long-running library loops such as
+    ``DataParallelTrainer.fit`` poll this to abort cooperatively."""
+    s = _get_session()
+    return bool(s is not None and s.stop_event is not None and s.stop_event.is_set())
 
 
 def report(metrics: Dict[str, Any], *, checkpoint: Optional[Checkpoint] = None) -> None:

@@ -70,9 +70,15 @@ def blockwise_attention(
     t_k = k.shape[-2]
     scale = scale if scale is not None else d ** -0.5
     block_k = min(block_k, t_k)
-    if t_k % block_k:
-        raise ValueError(f"t_k={t_k} not divisible by block_k={block_k}")
-    n_blocks = t_k // block_k
+    # Lengths that don't divide block_k are padded (padded keys masked out
+    # below) rather than shrinking the block — a prime t_k with block_k=1
+    # would mean t_k sequential 1-wide matmul steps.
+    pad = (-t_k) % block_k
+    if pad:
+        widths = [(0, 0)] * (k.ndim - 2) + [(0, pad), (0, 0)]
+        k = jnp.pad(k, widths)
+        v = jnp.pad(v, widths)
+    n_blocks = (t_k + pad) // block_k
 
     qf = q.astype(jnp.float32) * scale
     k_blocks = k.reshape(*k.shape[:-2], n_blocks, block_k, d)
@@ -86,10 +92,14 @@ def blockwise_attention(
     def step(carry, blk):
         idx, k_blk, v_blk = blk
         s = jnp.einsum("...qd,...kd->...qk", qf, k_blk.astype(jnp.float32))
+        k_pos = idx * block_k + jnp.arange(block_k)
         if causal:
-            k_pos = idx * block_k + jnp.arange(block_k)
             mask = q_pos[:, None] >= k_pos[None, :]
+            if pad:
+                mask &= (k_pos < t_k)[None, :]
             s = jnp.where(mask, s, NEG_INF)
+        elif pad:
+            s = jnp.where((k_pos < t_k)[None, :], s, NEG_INF)
         return _block_update(carry, s, v_blk), None
 
     o0 = jnp.zeros((*q.shape[:-1], d), jnp.float32)
@@ -243,6 +253,5 @@ def attention(
     )
     if on_tpu and aligned:
         return flash_attention_tpu(q, k, v, causal, scale, block_q, block_k)
-    return blockwise_attention(
-        q, k, v, causal=causal, scale=scale, block_k=min(block_k, t_k)
-    )
+    # blockwise pads+masks internally, so any seq len (192, primes, ...) works
+    return blockwise_attention(q, k, v, causal=causal, scale=scale, block_k=block_k)

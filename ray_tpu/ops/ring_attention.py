@@ -89,9 +89,6 @@ def ulysses_attention(
     from ray_tpu.ops.attention import blockwise_attention
 
     ql, kl, vl = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    # largest divisor of the gathered length <= 512 (blockwise requires
-    # block_k | T)
-    T = t * n
-    block = next(bk for bk in range(min(512, T), 0, -1) if T % bk == 0)
-    out = blockwise_attention(ql, kl, vl, causal=causal, scale=scale, block_k=block)
+    # blockwise pads+masks non-dividing lengths internally
+    out = blockwise_attention(ql, kl, vl, causal=causal, scale=scale, block_k=512)
     return gather_heads(out)

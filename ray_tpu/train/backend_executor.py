@@ -76,9 +76,16 @@ class BackendExecutor:
             return None
         if all(self._finished):
             return None
+        from ray_tpu.air import session as air_session
+
         deadline = time.monotonic() + timeout
         results: Dict[int, tuple] = {}
         while time.monotonic() < deadline:
+            if air_session.is_stop_requested():
+                # Hosting trial superseded (PBT reset): surface as "done" so
+                # fit() returns and its finally releases the gang's placement
+                # group promptly instead of holding TPUs past the reset.
+                return None
             pending = [
                 i for i in range(self.worker_group.num_workers)
                 if not self._finished[i] and i not in results

@@ -24,6 +24,7 @@ from ray_tpu.air import (
     RunConfig,
     ScalingConfig,
 )
+from ray_tpu.air import session as air_session
 from ray_tpu.train.backend import BackendConfig, JaxConfig
 from ray_tpu.train.backend_executor import BackendExecutor, TrainingFailedError
 
@@ -118,6 +119,8 @@ class DataParallelTrainer(BaseTrainer):
                 manager = _CheckpointBook(storage, ckpt_cfg)
                 last_metrics: Optional[Dict] = None
                 while True:
+                    if air_session.is_stop_requested():
+                        break  # superseded (e.g. PBT reset) — abort the gang
                     results = executor.get_next_results()
                     if results is None:
                         break

@@ -38,6 +38,7 @@ class ASHAScheduler(FIFOScheduler):
         self.max_t, self.grace, self.rf = max_t, grace_period, reduction_factor
         self.time_attr = time_attr
         self.rungs: Dict[int, List[float]] = defaultdict(list)
+        self._passed: Dict[str, int] = defaultdict(int)  # trial -> rungs cleared
 
     def _milestones(self) -> List[int]:
         ms, t = [], self.grace
@@ -54,15 +55,22 @@ class ASHAScheduler(FIFOScheduler):
         if t >= self.max_t:
             return STOP
         score = -value if self.mode == "min" else value
-        for m in self._milestones():
-            if t == m:
-                rung = self.rungs[m]
-                rung.append(score)
-                k = max(1, len(rung) // self.rf)
-                cutoff = sorted(rung, reverse=True)[k - 1]
-                if score < cutoff:
-                    return STOP
-        return CONTINUE
+        # Compare at the first result with t >= milestone (results need not
+        # land exactly on grace*rf^k).  Only the HIGHEST milestone crossed is
+        # recorded — a t=4-matured score folded into rung 1 would inflate the
+        # cutoff against trials legitimately reporting at t=1.
+        milestones = self._milestones()
+        n_cleared = self._passed[trial.trial_id]
+        crossed = [m for m in milestones[n_cleared:] if t >= m]
+        if not crossed:
+            return CONTINUE
+        self._passed[trial.trial_id] = n_cleared + len(crossed)
+        m = crossed[-1]
+        rung = self.rungs[m]
+        rung.append(score)
+        k = max(1, len(rung) // self.rf)
+        cutoff = sorted(rung, reverse=True)[k - 1]
+        return STOP if score < cutoff else CONTINUE
 
 
 class MedianStoppingRule(FIFOScheduler):

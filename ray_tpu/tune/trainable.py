@@ -72,6 +72,10 @@ class Trainable:
         self.cleanup()
 
 
+class _SessionStopped(BaseException):
+    """Raised inside a superseded runner thread at its next report."""
+
+
 class FunctionTrainable(Trainable):
     """Runs ``fn(config)`` on a thread; each ``step()`` is the next
     ``session.report`` payload."""
@@ -83,16 +87,21 @@ class FunctionTrainable(Trainable):
         self._latest_ckpt: Optional[Checkpoint] = None
         self._restored_ckpt: Optional[Checkpoint] = None
         self._thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
 
     def _ensure_started(self) -> None:
         if self._thread is not None:
             return
+        stop_event = self._stop_event
 
         def report_fn(metrics, checkpoint):
+            if stop_event.is_set():
+                raise _SessionStopped
             self._queue.put(("report", metrics, checkpoint))
 
         sess = air_session._Session(
             checkpoint=self._restored_ckpt, report_fn=report_fn,
+            stop_event=stop_event,
         )
 
         def runner():
@@ -100,6 +109,8 @@ class FunctionTrainable(Trainable):
             try:
                 self._fn(self.config)
                 self._queue.put(("finished", None, None))
+            except _SessionStopped:
+                pass
             except BaseException:  # noqa: BLE001
                 self._queue.put(("error", traceback.format_exc(), None))
             finally:
@@ -127,6 +138,14 @@ class FunctionTrainable(Trainable):
     def load_checkpoint(self, state: Dict) -> None:
         self._restored_ckpt = Checkpoint.from_dict(state)
 
+    def stop(self) -> None:
+        """Signal the runner thread to die at its next report and join it,
+        so a PBT ``reset`` never races a stale fn still training."""
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+        super().stop()
+
 
 def wrap_function(fn: Callable) -> type:
     """fn(config) -> Trainable subclass (``tune/trainable`` wrap_function)."""
@@ -138,9 +157,21 @@ def wrap_trainer(trainer) -> type:
     """BaseTrainer -> Trainable: each trial runs trainer.fit() with the
     trial config merged into train_loop_config (base_trainer.py:352-397)."""
     import copy
+    import uuid
 
     def fn(config):
         t = copy.copy(trainer)
+        # Each trial gets its own storage dir: a shared run_config would have
+        # every trial's checkpoint bookkeeping writing/deleting the same
+        # checkpoint_00000N paths and clobbering each other.
+        rc = copy.copy(t.run_config)
+        rc.name = f"{rc.name or 'train'}_{uuid.uuid4().hex[:8]}"
+        t.run_config = rc
+        # A restored/donor checkpoint (failure restore, PBT exploit) must
+        # seed the trainer, or the trial silently retrains from step 0.
+        restored = air_session.get_checkpoint()
+        if restored is not None:
+            t.resume_from_checkpoint = restored
         if getattr(t, "train_loop_config", None) is not None:
             merged = dict(t.train_loop_config)
             merged.update(config)
