@@ -2,7 +2,7 @@
 
 Analog of ``python/ray/serve`` (SURVEY §3.6): a controller actor reconciles
 declarative deployment state into replica actors (``num_tpus=1`` replicas
-for BASELINE config 5), handles route through a round-robin router under a
+for BASELINE config 5), handles route through a least-loaded router under a
 max-concurrent-queries cap, and an HTTP proxy actor exposes deployments
 over REST.
 """
@@ -13,20 +13,29 @@ from ray_tpu.serve.api import (
     delete,
     deployment,
     get_deployment_handle,
+    get_http_address,
     run,
     shutdown,
+    start,
     status,
 )
+from ray_tpu.serve.config import DeploymentConfig, HTTPOptions
 from ray_tpu.serve.handle import DeploymentHandle
+from ray_tpu.serve._private.http_util import Request
 
 __all__ = [
     "deployment",
     "Deployment",
+    "DeploymentConfig",
     "Application",
     "run",
+    "start",
     "delete",
     "status",
     "shutdown",
     "get_deployment_handle",
+    "get_http_address",
     "DeploymentHandle",
+    "HTTPOptions",
+    "Request",
 ]

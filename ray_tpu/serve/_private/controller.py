@@ -1,0 +1,322 @@
+"""ServeController: the serve control plane, as a named actor.
+
+Analog of ``python/ray/serve/controller.py:61`` (ServeController) plus the
+``DeploymentState`` reconciler (``serve/_private/deployment_state.py:958``):
+holds declarative deployment goal state, diffs it against live replica
+actors, and converges — creating replicas, replacing dead ones (detected by
+a background health loop pinging each replica), scaling up/down, and
+propagating ``user_config`` via ``reconfigure``.  Routers and proxies pull
+routing tables from here (the reference pushes via LongPollHost; with
+single-in-flight actor calls a blocking long-poll would wedge the
+controller, so consumers poll with a short TTL instead).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.serve.config import (
+    MAX_CONSECUTIVE_START_FAILURES,
+    DeploymentConfig,
+    ReplicaState,
+)
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class _Replica:
+    __slots__ = ("tag", "handle", "state")
+
+    def __init__(self, tag: str, handle, state: str = ReplicaState.STARTING):
+        self.tag = tag
+        self.handle = handle
+        self.state = state
+
+
+class _DeploymentState:
+    """Goal + actual state for one deployment (deployment_state.py:958)."""
+
+    def __init__(self, name: str, goal: dict):
+        self.name = name
+        self.goal = goal  # serialized_def/init_args/init_kwargs/config/route_prefix
+        self.replicas: List[_Replica] = []
+        self.version = 1
+        self.deleting = False
+        self.consecutive_failures = 0  # replica deaths with no RUNNING between
+        self.unhealthy_reason: Optional[str] = None
+        self.last_probe = 0.0
+
+    @property
+    def config(self) -> DeploymentConfig:
+        return self.goal["config"]
+
+
+class ServeController:
+    def __init__(self, http_config: Optional[dict] = None):
+        self._deployments: Dict[str, _DeploymentState] = {}
+        self._lock = threading.RLock()
+        self._stopped = threading.Event()
+        self._http_config = http_config or {}
+        self._health_thread = threading.Thread(
+            target=self._health_loop, daemon=True, name="serve-health"
+        )
+        self._health_thread.start()
+
+    # ------------------------------------------------------------------
+    # control-plane API (called by serve.api / proxies / handles)
+    # ------------------------------------------------------------------
+    def deploy(self, name: str, goal: dict) -> bool:
+        """Set/replace a deployment's goal state and converge toward it
+        (``controller.py`` deploy -> DeploymentState.deploy analog)."""
+        goal["config"].validate()
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                self._deployments[name] = state = _DeploymentState(name, goal)
+            else:
+                old = state.goal
+                code_changed = (
+                    old["serialized_def"] != goal["serialized_def"]
+                    or old["init_args"] != goal["init_args"]
+                    or old["init_kwargs"] != goal["init_kwargs"]
+                )
+                user_config_changed = (
+                    old["config"].user_config != goal["config"].user_config
+                )
+                state.goal = goal
+                state.deleting = False
+                state.consecutive_failures = 0
+                state.unhealthy_reason = None
+                if code_changed:
+                    # new code/args: replace every replica (simplified rolling
+                    # update — the reference also versions replicas)
+                    for r in list(state.replicas):
+                        self._stop_replica(state, r)
+                elif user_config_changed:
+                    for r in state.replicas:
+                        try:
+                            r.handle.reconfigure.remote(goal["config"].user_config)
+                        except Exception:
+                            pass
+                state.version += 1
+            self._reconcile(state)
+        return True
+
+    def delete_deployment(self, name: str) -> bool:
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return False
+            state.deleting = True
+            for r in list(state.replicas):
+                self._stop_replica(state, r)
+            del self._deployments[name]
+        return True
+
+    def get_routing_info(self, name: str) -> Optional[dict]:
+        """Routing snapshot for one deployment: consumed by Routers
+        (replaces the reference's long-poll channel)."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return None
+            return {
+                "version": state.version,
+                "max_concurrent_queries": state.config.max_concurrent_queries,
+                "replicas": [
+                    (r.tag, r.handle)
+                    for r in state.replicas
+                    if r.state == ReplicaState.RUNNING
+                ],
+            }
+
+    def get_route_table(self) -> Dict[str, str]:
+        """{route_prefix: deployment_name} for the HTTP proxy."""
+        with self._lock:
+            table = {}
+            for name, state in self._deployments.items():
+                prefix = state.goal.get("route_prefix")
+                if prefix:
+                    table[prefix] = name
+            return table
+
+    def get_status(self) -> Dict[str, dict]:
+        with self._lock:
+            out = {}
+            for name, state in self._deployments.items():
+                counts: Dict[str, int] = {}
+                for r in state.replicas:
+                    counts[r.state] = counts.get(r.state, 0) + 1
+                running = counts.get(ReplicaState.RUNNING, 0)
+                goal_n = state.config.num_replicas
+                if state.unhealthy_reason is not None:
+                    status = "UNHEALTHY"
+                elif running >= goal_n:
+                    status = "HEALTHY"
+                else:
+                    status = "UPDATING"
+                out[name] = {
+                    "status": status,
+                    "version": state.version,
+                    "replica_states": counts,
+                    "num_replicas_goal": goal_n,
+                    "message": state.unhealthy_reason or "",
+                }
+            return out
+
+    def list_deployments(self) -> List[str]:
+        with self._lock:
+            return list(self._deployments)
+
+    def graceful_shutdown(self) -> bool:
+        """Kill every replica; the controller actor itself is killed by
+        serve.shutdown() afterwards."""
+        self._stopped.set()
+        with self._lock:
+            for state in self._deployments.values():
+                for r in list(state.replicas):
+                    self._stop_replica(state, r)
+            self._deployments.clear()
+        return True
+
+    def ping(self) -> str:
+        return "pong"
+
+    # ------------------------------------------------------------------
+    # reconciliation (deployment_state.py:958 update loop)
+    # ------------------------------------------------------------------
+    def _reconcile(self, state: _DeploymentState) -> None:
+        """Converge one deployment's replica set toward its goal.  Caller
+        holds the lock."""
+        if state.unhealthy_reason is not None:
+            return  # crash-looping: stop churning workers until redeployed
+        goal_n = state.config.num_replicas
+        live = [r for r in state.replicas if r.state in (ReplicaState.STARTING, ReplicaState.RUNNING)]
+        for _ in range(goal_n - len(live)):
+            self._start_replica(state)
+        if len(live) > goal_n:
+            # scale down: drop STARTING replicas first, newest first
+            victims = sorted(
+                live, key=lambda r: (r.state == ReplicaState.RUNNING,)
+            )[: len(live) - goal_n]
+            for r in victims:
+                self._stop_replica(state, r)
+            state.version += 1
+
+    def _start_replica(self, state: _DeploymentState) -> None:
+        import ray_tpu
+        from ray_tpu.serve._private.replica import ServeReplica
+
+        goal = state.goal
+        tag = f"{state.name}#{uuid.uuid4().hex[:8]}"
+        options = dict(goal["config"].ray_actor_options or {})
+        handle = ray_tpu.remote(ServeReplica).options(**options).remote(
+            state.name,
+            tag,
+            goal["serialized_def"],
+            goal["init_args"],
+            goal["init_kwargs"],
+            goal["config"].user_config,
+        )
+        state.replicas.append(_Replica(tag, handle))
+        logger.info("serve: starting replica %s", tag)
+
+    def _stop_replica(self, state: _DeploymentState, replica: _Replica) -> None:
+        import ray_tpu
+
+        replica.state = ReplicaState.STOPPING
+        # Out of the routing set immediately (no new requests), then drain:
+        # queued requests ahead of prepare_for_shutdown still execute, the
+        # shutdown hook runs, and only then — or at the graceful timeout —
+        # the actor is killed.
+        if replica in state.replicas:
+            state.replicas.remove(replica)
+        state.version += 1
+        grace = state.config.graceful_shutdown_timeout_s
+
+        def drain():
+            try:
+                fut = replica.handle.prepare_for_shutdown.remote()
+                ray_tpu.get(fut, timeout=grace)
+            except Exception:
+                pass
+            try:
+                ray_tpu.kill(replica.handle)
+            except Exception:
+                pass
+
+        threading.Thread(target=drain, daemon=True, name=f"drain-{replica.tag}").start()
+
+    # ------------------------------------------------------------------
+    # health loop (GcsHealthCheckManager-style active probing of replicas)
+    # ------------------------------------------------------------------
+    def _health_loop(self) -> None:
+        import ray_tpu
+
+        while not self._stopped.is_set():
+            now = time.monotonic()
+            with self._lock:
+                probes: List[Tuple[_DeploymentState, _Replica, Any]] = []
+                for state in self._deployments.values():
+                    if now - state.last_probe < state.config.health_check_period_s:
+                        continue
+                    state.last_probe = now
+                    for r in state.replicas:
+                        if r.state in (ReplicaState.STARTING, ReplicaState.RUNNING):
+                            try:
+                                probes.append((state, r, r.handle.ping.remote()))
+                            except Exception:
+                                pass
+            if probes:
+                # one shared wait bounds the cycle regardless of replica
+                # count; non-ready pings mean "busy/starting", not dead
+                refs = [fut for _, _, fut in probes]
+                ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=2.0)
+                ready_set = {r.binary() for r in ready}
+                for state, r, fut in probes:
+                    if fut.binary() not in ready_set:
+                        continue
+                    try:
+                        ray_tpu.get(fut, timeout=5.0)
+                        alive = True
+                    except Exception:
+                        alive = False
+                    with self._lock:
+                        if r not in state.replicas:
+                            continue
+                        if alive:
+                            if r.state == ReplicaState.STARTING:
+                                r.state = ReplicaState.RUNNING
+                                state.version += 1
+                                state.consecutive_failures = 0
+                                logger.info("serve: replica %s RUNNING", r.tag)
+                        else:
+                            state.replicas.remove(r)
+                            state.version += 1
+                            if r.state == ReplicaState.STARTING:
+                                state.consecutive_failures += 1
+                            if (
+                                state.consecutive_failures
+                                >= MAX_CONSECUTIVE_START_FAILURES
+                            ):
+                                state.unhealthy_reason = (
+                                    f"replicas failed to start "
+                                    f"{state.consecutive_failures} times in a "
+                                    "row; giving up until next deploy"
+                                )
+                                logger.error(
+                                    "serve: deployment %s UNHEALTHY: %s",
+                                    state.name, state.unhealthy_reason,
+                                )
+                            elif not state.deleting:
+                                logger.warning(
+                                    "serve: replica %s died; replacing", r.tag
+                                )
+                                self._reconcile(state)
+            self._stopped.wait(0.25)

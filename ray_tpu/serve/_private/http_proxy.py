@@ -1,0 +1,154 @@
+"""HTTP proxy: the front door mapping routes to deployments.
+
+Analog of ``python/ray/serve/_private/http_proxy.py:218`` (HTTPProxy over
+uvicorn/starlette) rebuilt on the stdlib: a ``ThreadingHTTPServer`` runs
+inside the proxy actor, each connection thread resolves the route against a
+TTL-cached route table from the controller, assembles a picklable
+``Request``, routes it through a per-deployment Router (concurrency-capped),
+and encodes the replica's return value as the HTTP response.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import traceback
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from ray_tpu.serve._private.http_util import Request, encode_response
+from ray_tpu.serve._private.router import Router
+from ray_tpu.serve.config import ROUTE_TABLE_TTL_S
+
+
+class HTTPProxyActor:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 controller_name: Optional[str] = None):
+        import ray_tpu
+        from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+        self._controller = ray_tpu.get_actor(controller_name or CONTROLLER_NAME)
+        self._routers: Dict[str, Router] = {}
+        self._routers_lock = threading.Lock()
+        self._route_table: Dict[str, str] = {}
+        self._route_table_at = 0.0
+
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args):  # silence per-request stderr spam
+                pass
+
+            def _dispatch(self):
+                proxy._handle_http(self)
+
+            do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _dispatch
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.host, self.port = self._server.server_address[0], self._server.server_address[1]
+        threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="serve-http"
+        ).start()
+
+    # -- actor API -----------------------------------------------------
+    def ready(self):
+        """(host, port) once the socket is bound (it is, from __init__)."""
+        return self.host, self.port
+
+    def ping(self) -> str:
+        return "pong"
+
+    # -- request path ----------------------------------------------------
+    def _refresh_route_table(self, force: bool = False) -> Dict[str, str]:
+        import ray_tpu
+
+        now = time.monotonic()
+        if force or now - self._route_table_at >= ROUTE_TABLE_TTL_S:
+            self._route_table = ray_tpu.get(
+                self._controller.get_route_table.remote(), timeout=30
+            )
+            self._route_table_at = now
+        return self._route_table
+
+    def _match_route(self, path: str) -> Optional[str]:
+        """Longest-prefix route match (http_proxy.py's starlette routing
+        analog): '/api' matches '/api' and '/api/x', not '/apix'."""
+        for force in (False, True):
+            table = self._refresh_route_table(force=force)
+            best, best_len = None, -1
+            for prefix, name in table.items():
+                if path == prefix or path.startswith(prefix.rstrip("/") + "/"):
+                    if len(prefix) > best_len:
+                        best, best_len = name, len(prefix)
+            if best is not None:
+                return best
+            # miss may just be a stale cache (deployment created <TTL ago):
+            # force one refresh before 404ing
+        return None
+
+    def _handle_http(self, h: BaseHTTPRequestHandler) -> None:
+        import ray_tpu
+        from ray_tpu.exceptions import GetTimeoutError
+
+        try:
+            if h.path == "/-/routes":
+                self._respond(h, 200, json.dumps(self._refresh_route_table()).encode(),
+                              "application/json")
+                return
+            name = self._match_route(h.path.split("?")[0])
+            if name is None:
+                self._respond(h, 404, b'{"error": "no route"}', "application/json")
+                return
+            length = int(h.headers.get("Content-Length") or 0)
+            body = h.rfile.read(length) if length else b""
+            request = Request.from_raw(h.command, h.path, dict(h.headers), body)
+            with self._routers_lock:
+                router = self._routers.get(name)
+                if router is None:
+                    router = self._routers[name] = Router(self._controller, name)
+            result = self._route_with_retry(router, request)
+            payload, ctype = encode_response(result)
+            self._respond(h, 200, payload, ctype)
+        except GetTimeoutError as e:
+            if "no replica" in str(e):
+                self._respond(h, 503, b'{"error": "no replica available"}',
+                              "application/json")
+            else:
+                # the request is (still) executing — slow, not capacity
+                self._respond(h, 504, b'{"error": "replica execution timed out"}',
+                              "application/json")
+        except Exception as e:  # noqa: BLE001
+            err = json.dumps({"error": str(e), "traceback": traceback.format_exc()})
+            self._respond(h, 500, err.encode(), "application/json")
+
+    def _route_with_retry(self, router: Router, request: Request):
+        """Assign + get, retrying once if the chosen replica died under us
+        (stale membership during a scale-down/redeploy is routine, not a
+        user-visible error)."""
+        import ray_tpu
+        from ray_tpu.exceptions import RayActorError
+
+        last_exc = None
+        for _ in range(2):
+            ref = router.assign_request("__call__", (request,), {}, timeout=30.0)
+            try:
+                return ray_tpu.get(ref, timeout=120.0)
+            except RayActorError as e:
+                router.on_replica_error(ref)
+                last_exc = e
+        raise last_exc
+
+    @staticmethod
+    def _respond(h: BaseHTTPRequestHandler, code: int, body: bytes, ctype: str) -> None:
+        try:
+            h.send_response(code)
+            h.send_header("Content-Type", ctype)
+            h.send_header("Content-Length", str(len(body)))
+            h.end_headers()
+            h.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass

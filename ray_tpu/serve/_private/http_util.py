@@ -1,0 +1,66 @@
+"""HTTP request/response plumbing shared by the proxy and replicas.
+
+The reference hands replicas a starlette ``Request`` built by uvicorn
+(``serve/_private/http_util.py``); this environment has no ASGI stack, so
+``Request`` is a small picklable equivalent assembled by the stdlib proxy
+and shipped to the replica over the actor call.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+
+class Request:
+    """An HTTP request as seen by a deployment's ``__call__``.
+
+    Mirrors the parts of starlette's Request that serve users touch:
+    ``method``, ``path``, ``query_params``, ``headers``, ``body`` (bytes),
+    and ``json()``.
+    """
+
+    def __init__(
+        self,
+        method: str = "GET",
+        path: str = "/",
+        query_params: Optional[Dict[str, str]] = None,
+        headers: Optional[Dict[str, str]] = None,
+        body: bytes = b"",
+    ):
+        self.method = method
+        self.path = path
+        self.query_params = query_params or {}
+        self.headers = headers or {}
+        self.body = body
+
+    @classmethod
+    def from_raw(cls, method: str, raw_path: str, headers: Dict[str, str], body: bytes) -> "Request":
+        parts = urlsplit(raw_path)
+        return cls(
+            method=method,
+            path=parts.path,
+            query_params=dict(parse_qsl(parts.query)),
+            headers=headers,
+            body=body,
+        )
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"null")
+
+    def text(self) -> str:
+        return (self.body or b"").decode("utf-8", errors="replace")
+
+    def __repr__(self) -> str:
+        return f"Request({self.method} {self.path}, {len(self.body)}B)"
+
+
+def encode_response(result: Any) -> tuple:
+    """(body_bytes, content_type) for an HTTP response, mirroring the
+    reference proxy's str/bytes/json handling (``http_util.py`` Response)."""
+    if isinstance(result, bytes):
+        return result, "application/octet-stream"
+    if isinstance(result, str):
+        return result.encode(), "text/plain; charset=utf-8"
+    return json.dumps(result).encode(), "application/json"

@@ -1,0 +1,90 @@
+"""ServeReplica: the actor hosting one copy of a deployment's callable.
+
+Analog of ``python/ray/serve/_private/replica.py:250`` (RayServeReplica):
+constructs the user's class (or wraps a function), executes requests,
+applies ``user_config`` through ``reconfigure``, and answers health checks.
+TPU-backed deployments get here with ``ray_actor_options={"num_tpus": 1}``
+so the scheduler pins a chip before the model loads.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Tuple
+
+import cloudpickle
+
+
+class ServeReplica:
+    def __init__(
+        self,
+        deployment_name: str,
+        replica_tag: str,
+        serialized_def: bytes,
+        init_args: Tuple,
+        init_kwargs: Dict,
+        user_config: Optional[Any] = None,
+    ):
+        self.deployment_name = deployment_name
+        self.replica_tag = replica_tag
+        func_or_class = cloudpickle.loads(serialized_def)
+        if isinstance(func_or_class, type):
+            self.callable = func_or_class(*init_args, **init_kwargs)
+            self._is_function = False
+        else:
+            self.callable = func_or_class
+            self._is_function = True
+        if user_config is not None:
+            self.reconfigure(user_config)
+        self._num_requests = 0
+        self._start_time = time.time()
+
+    def handle_request(self, method_name: str, args: Tuple, kwargs: Dict) -> Any:
+        """Run one request (``replica.py:250`` handle_request analog).
+        ``method_name='__call__'`` hits the callable itself."""
+        self._num_requests += 1
+        if self._is_function:
+            if method_name not in ("__call__", None):
+                raise AttributeError(
+                    f"function deployment {self.deployment_name!r} has no "
+                    f"method {method_name!r}"
+                )
+            return self.callable(*args, **kwargs)
+        if method_name == "__call__":
+            if not callable(self.callable):
+                raise TypeError(
+                    f"deployment {self.deployment_name!r} defines no __call__; "
+                    "invoke a named method via handle.<method>.remote()"
+                )
+            return self.callable(*args, **kwargs)
+        return getattr(self.callable, method_name)(*args, **kwargs)
+
+    def reconfigure(self, user_config: Any) -> bool:
+        """Apply a new ``user_config`` in place (deployment_state reconciler
+        calls this instead of restarting the replica)."""
+        if not self._is_function and hasattr(self.callable, "reconfigure"):
+            self.callable.reconfigure(user_config)
+        return True
+
+    def ping(self) -> str:
+        """Liveness probe: a dead worker fails the call with RayActorError,
+        which is the controller's death signal."""
+        return "pong"
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "deployment": self.deployment_name,
+            "replica_tag": self.replica_tag,
+            "num_requests": self._num_requests,
+            "uptime_s": time.time() - self._start_time,
+        }
+
+    def prepare_for_shutdown(self) -> bool:
+        """Graceful-shutdown hook: user callables may define ``__del__`` or
+        ``shutdown``; call the latter if present."""
+        if not self._is_function and hasattr(self.callable, "shutdown"):
+            try:
+                self.callable.shutdown()
+            except Exception:
+                pass
+        return True

@@ -1,0 +1,142 @@
+"""Router: picks a replica for each request under a concurrency cap.
+
+Analog of ``python/ray/serve/_private/router.py:221`` (ReplicaSet with
+``max_concurrent_queries``) + ``:261`` (assign_replica): least-loaded
+selection among RUNNING replicas, counting this router's in-flight calls
+per replica, blocking when every replica is at its cap until an in-flight
+call drains.  Each handle/proxy owns a Router (per-caller accounting, as in
+the reference); the replica membership is pulled from the controller with a
+short TTL instead of the reference's long-poll push.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from ray_tpu.serve.config import ROUTE_TABLE_TTL_S
+
+
+class Router:
+    def __init__(self, controller_handle, deployment_name: str):
+        self._controller = controller_handle
+        self._name = deployment_name
+        self._lock = threading.Lock()
+        self._version = -1
+        self._replicas: List[Tuple[str, Any]] = []  # (tag, ActorHandle)
+        self._max_concurrent = 100
+        self._last_refresh = 0.0
+        self._inflight: Dict[str, List[Any]] = {}  # tag -> [ObjectRef]
+        self._rr = 0  # round-robin tiebreak among equally-loaded replicas
+
+    # ------------------------------------------------------------------
+    def _refresh(self, force: bool = False) -> None:
+        import ray_tpu
+
+        now = time.monotonic()
+        if not force and now - self._last_refresh < ROUTE_TABLE_TTL_S:
+            return
+        info = ray_tpu.get(
+            self._controller.get_routing_info.remote(self._name), timeout=30
+        )
+        with self._lock:
+            self._last_refresh = now
+            if info is None:
+                self._replicas = []
+                return
+            self._version = info["version"]
+            self._max_concurrent = info["max_concurrent_queries"]
+            self._replicas = info["replicas"]
+            live = {tag for tag, _ in self._replicas}
+            self._inflight = {
+                tag: refs for tag, refs in self._inflight.items() if tag in live
+            }
+
+    def _prune_inflight(self) -> None:
+        """Drop completed refs from the in-flight ledgers (lock held)."""
+        import ray_tpu
+
+        for tag, refs in self._inflight.items():
+            if not refs:
+                continue
+            ready, not_ready = ray_tpu.wait(
+                refs, num_returns=len(refs), timeout=0
+            )
+            self._inflight[tag] = not_ready
+
+    def _pick(self) -> Optional[Tuple[str, Any]]:
+        """Least-loaded replica under the cap, round-robin on ties (lock
+        held).  None if every replica is saturated or none are RUNNING."""
+        if not self._replicas:
+            return None
+        best = None
+        best_load = None
+        n = len(self._replicas)
+        for i in range(n):
+            tag, handle = self._replicas[(self._rr + i) % n]
+            load = len(self._inflight.get(tag, ()))
+            if load >= self._max_concurrent:
+                continue
+            if best_load is None or load < best_load:
+                best, best_load = (tag, handle), load
+        if best is not None:
+            self._rr = (self._rr + 1) % n
+        return best
+
+    def assign_request(
+        self,
+        method_name: str,
+        args: Tuple,
+        kwargs: Dict,
+        timeout: Optional[float] = 60.0,
+    ):
+        """Submit one request to a replica; returns the ObjectRef.  Blocks
+        while no replica is available (deployment still starting, or all at
+        max_concurrent_queries)."""
+        import ray_tpu
+        from ray_tpu.exceptions import GetTimeoutError
+
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        force = False
+        while True:
+            self._refresh(force=force)
+            force = False
+            with self._lock:
+                self._prune_inflight()
+                picked = self._pick()
+                if picked is not None:
+                    tag, handle = picked
+                    ref = handle.handle_request.remote(method_name, args, kwargs)
+                    self._inflight.setdefault(tag, []).append(ref)
+                    return ref
+                waitable = [r for refs in self._inflight.values() for r in refs]
+            if deadline is not None and time.monotonic() >= deadline:
+                raise GetTimeoutError(
+                    f"no replica of {self._name!r} available within {timeout}s"
+                )
+            if waitable:
+                # our own backpressure: wait for one in-flight call to drain
+                ray_tpu.wait(waitable, num_returns=1, timeout=0.5)
+            else:
+                # deployment still starting (or scaled to 0): poll membership
+                time.sleep(0.1)
+                force = True
+
+    def on_replica_error(self, ref) -> None:
+        """Caller observed a RayActorError from ``ref``: evict that replica
+        locally and force the next assignment to re-pull membership (the
+        reference router's replica-removal-on-failure path)."""
+        oid = ref.binary()
+        with self._lock:
+            dead_tag = None
+            for tag, refs in self._inflight.items():
+                if any(r.binary() == oid for r in refs):
+                    dead_tag = tag
+                    break
+            if dead_tag is not None:
+                self._inflight.pop(dead_tag, None)
+                self._replicas = [
+                    (t, h) for t, h in self._replicas if t != dead_tag
+                ]
+            self._last_refresh = 0.0

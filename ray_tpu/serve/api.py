@@ -1,0 +1,289 @@
+"""serve public API: @deployment, run, status, delete, shutdown.
+
+Analog of ``python/ray/serve/api.py`` (``@serve.deployment`` ``:251-277``,
+``serve.run`` ``:455``) + ``serve/deployment.py:35`` (Deployment): the
+declarative surface users touch.  ``Deployment.bind`` builds an
+``Application`` graph (nested bound deployments become DeploymentHandles in
+the parent's constructor — the deployment-graph composition path); ``run``
+ships it to the controller and blocks until every deployment is healthy.
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import cloudpickle
+
+from ray_tpu.serve.config import DeploymentConfig, HTTPOptions
+from ray_tpu.serve.handle import DeploymentHandle
+
+_client: Optional["_ServeClient"] = None
+
+
+class Deployment:
+    """A deployment definition (``serve/deployment.py:35`` analog).
+    Immutable; ``options()`` returns a modified copy."""
+
+    def __init__(
+        self,
+        func_or_class: Union[Callable, type],
+        name: str,
+        config: Optional[DeploymentConfig] = None,
+        route_prefix: Optional[str] = "__auto__",
+    ):
+        self._func_or_class = func_or_class
+        self.name = name
+        self.config = config or DeploymentConfig()
+        # "__auto__" -> "/<name>"; None -> not HTTP-exposed
+        self.route_prefix = f"/{name}" if route_prefix == "__auto__" else route_prefix
+
+    def options(
+        self,
+        name: Optional[str] = None,
+        num_replicas: Optional[int] = None,
+        max_concurrent_queries: Optional[int] = None,
+        user_config: Optional[Any] = None,
+        ray_actor_options: Optional[Dict] = None,
+        route_prefix: Optional[str] = "__unset__",
+    ) -> "Deployment":
+        cfg = copy.deepcopy(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_concurrent_queries is not None:
+            cfg.max_concurrent_queries = max_concurrent_queries
+        if user_config is not None:
+            cfg.user_config = user_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        d = Deployment(
+            self._func_or_class,
+            name or self.name,
+            cfg,
+            route_prefix="__auto__",
+        )
+        d.route_prefix = (
+            self.route_prefix if route_prefix == "__unset__" else route_prefix
+        )
+        if name and d.route_prefix == f"/{self.name}":
+            d.route_prefix = f"/{name}"
+        return d
+
+    def bind(self, *args, **kwargs) -> "Application":
+        """Bind constructor args, producing an Application DAG node
+        (``deployment.py`` bind / DAG build analog).  Args may contain other
+        Applications — they deploy first and arrive as handles."""
+        return Application(self, args, kwargs)
+
+    def __repr__(self):
+        return f"Deployment(name={self.name!r}, num_replicas={self.config.num_replicas})"
+
+
+class Application:
+    """A bound deployment graph node (``serve.built_application`` analog)."""
+
+    def __init__(self, deployment: Deployment, args: Tuple, kwargs: Dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+
+def deployment(
+    _func_or_class: Optional[Union[Callable, type]] = None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: int = 1,
+    max_concurrent_queries: int = 100,
+    user_config: Optional[Any] = None,
+    ray_actor_options: Optional[Dict] = None,
+    route_prefix: Optional[str] = "__auto__",
+) -> Union[Deployment, Callable[[Callable], Deployment]]:
+    """``@serve.deployment`` decorator (``api.py:251`` analog)."""
+
+    def make(func_or_class):
+        cfg = DeploymentConfig(
+            num_replicas=num_replicas,
+            max_concurrent_queries=max_concurrent_queries,
+            user_config=user_config,
+            ray_actor_options=dict(ray_actor_options or {}),
+        )
+        return Deployment(
+            func_or_class,
+            name or func_or_class.__name__,
+            cfg,
+            route_prefix=route_prefix,
+        )
+
+    if _func_or_class is not None:
+        return make(_func_or_class)
+    return make
+
+
+# ---------------------------------------------------------------------------
+# client / lifecycle
+# ---------------------------------------------------------------------------
+
+
+class _ServeClient:
+    """Driver-side connection to the serve control plane
+    (``_private/client.py`` ServeControllerClient analog)."""
+
+    def __init__(self, controller, proxy=None, http=None):
+        self.controller = controller
+        self.proxy = proxy
+        self.http = http  # (host, port) or None
+
+
+def start(http_options: Optional[HTTPOptions] = None, _http: bool = True) -> _ServeClient:
+    """Start (or connect to) the serve instance: controller + HTTP proxy
+    (``serve.start`` analog)."""
+    global _client
+    import ray_tpu
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME, ServeController
+    from ray_tpu.serve._private.http_proxy import HTTPProxyActor
+
+    ray_tpu.init()
+    if _client is not None:
+        try:
+            ray_tpu.get(_client.controller.ping.remote(), timeout=10)
+            return _client
+        except Exception:
+            _client = None  # stale (previous ray session); rebuild
+
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(controller.ping.remote(), timeout=10)
+    except Exception:
+        controller = (
+            ray_tpu.remote(ServeController)
+            .options(name=CONTROLLER_NAME)
+            .remote()
+        )
+        ray_tpu.get(controller.ping.remote(), timeout=60)
+
+    proxy = None
+    http = None
+    if _http:
+        opts = http_options or HTTPOptions()
+        proxy = ray_tpu.remote(HTTPProxyActor).remote(opts.host, opts.port)
+        http = tuple(ray_tpu.get(proxy.ready.remote(), timeout=60))
+    _client = _ServeClient(controller, proxy, http)
+    return _client
+
+
+def _get_client() -> _ServeClient:
+    if _client is None:
+        raise RuntimeError("serve not started — call serve.run()/serve.start() first")
+    return _client
+
+
+def _deploy_application(
+    client: _ServeClient, app: Application, deployed_names: Optional[list] = None
+) -> DeploymentHandle:
+    """Depth-first deploy: nested Applications become handles in the
+    parent's init args (deployment-graph build analog)."""
+    import ray_tpu
+
+    def resolve(v):
+        if isinstance(v, Application):
+            return _deploy_application(client, v, deployed_names)
+        return v
+
+    args = tuple(resolve(a) for a in app.args)
+    kwargs = {k: resolve(v) for k, v in app.kwargs.items()}
+    d = app.deployment
+    goal = {
+        "serialized_def": cloudpickle.dumps(d._func_or_class),
+        "init_args": args,
+        "init_kwargs": kwargs,
+        "config": d.config,
+        "route_prefix": d.route_prefix,
+    }
+    ray_tpu.get(client.controller.deploy.remote(d.name, goal), timeout=60)
+    if deployed_names is not None:
+        deployed_names.append(d.name)
+    return DeploymentHandle(d.name, client.controller)
+
+
+def run(
+    target: Union[Application, Deployment],
+    *,
+    host: str = "127.0.0.1",
+    port: int = 8000,
+    _blocking: bool = True,
+    timeout_s: float = 180.0,
+) -> DeploymentHandle:
+    """Deploy an application and wait until healthy (``api.py:455``).
+    Returns a handle to the root deployment."""
+    import ray_tpu
+
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError(f"serve.run expects an Application or Deployment, got {type(target)}")
+    client = start(HTTPOptions(host=host, port=port))
+    deployed_names: list = []
+    handle = _deploy_application(client, target, deployed_names)
+    if _blocking:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            status_map = ray_tpu.get(client.controller.get_status.remote(), timeout=30)
+            # only THIS app's deployments gate the wait — an unrelated
+            # unhealthy deployment must not fail this run
+            mine = {n: status_map[n] for n in deployed_names if n in status_map}
+            bad = [n for n, s in mine.items() if s["status"] == "UNHEALTHY"]
+            if bad:
+                raise RuntimeError(
+                    f"deployment(s) {bad} unhealthy: "
+                    + "; ".join(mine[n].get("message", "") for n in bad)
+                )
+            if all(s["status"] == "HEALTHY" for s in mine.values()):
+                break
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"deployments not healthy after {timeout_s}s: {mine}"
+                )
+            time.sleep(0.2)
+    return handle
+
+
+def get_deployment_handle(name: str) -> DeploymentHandle:
+    return DeploymentHandle(name, _get_client().controller)
+
+
+def status() -> Dict[str, dict]:
+    import ray_tpu
+
+    return ray_tpu.get(_get_client().controller.get_status.remote(), timeout=30)
+
+
+def get_http_address() -> Optional[Tuple[str, int]]:
+    """(host, port) of the running HTTP proxy."""
+    return _get_client().http
+
+
+def delete(name: str) -> None:
+    import ray_tpu
+
+    ray_tpu.get(_get_client().controller.delete_deployment.remote(name), timeout=30)
+
+
+def shutdown() -> None:
+    """Tear down all deployments, the proxy, and the controller."""
+    global _client
+    import ray_tpu
+
+    if _client is None:
+        return
+    try:
+        ray_tpu.get(_client.controller.graceful_shutdown.remote(), timeout=30)
+    except Exception:
+        pass
+    for h in (_client.proxy, _client.controller):
+        if h is not None:
+            try:
+                ray_tpu.kill(h)
+            except Exception:
+                pass
+    _client = None

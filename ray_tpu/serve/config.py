@@ -1,0 +1,59 @@
+"""Serve configuration dataclasses.
+
+Analog of the reference's ``python/ray/serve/config.py`` (DeploymentConfig,
+HTTPOptions) — the declarative half of a deployment: replica count, queue
+caps, actor resources, and the HTTP front door.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class DeploymentConfig:
+    """Goal-state knobs the controller reconciles toward
+    (``serve/config.py`` DeploymentConfig analog)."""
+
+    num_replicas: int = 1
+    max_concurrent_queries: int = 100
+    user_config: Optional[Any] = None
+    ray_actor_options: Dict[str, Any] = field(default_factory=dict)
+    health_check_period_s: float = 2.0
+    graceful_shutdown_timeout_s: float = 10.0
+
+    def validate(self) -> None:
+        if self.num_replicas < 0:
+            raise ValueError("num_replicas must be >= 0")
+        if self.max_concurrent_queries <= 0:
+            raise ValueError("max_concurrent_queries must be > 0")
+
+
+# How long routers/proxies trust a cached routing snapshot before re-pulling
+# from the controller (the poll-TTL stand-in for the reference's long-poll).
+ROUTE_TABLE_TTL_S = 1.0
+
+# Consecutive replica-start failures before the controller stops retrying a
+# deployment and marks it UNHEALTHY (deployment_state's backoff analog).
+MAX_CONSECUTIVE_START_FAILURES = 3
+
+
+@dataclass
+class HTTPOptions:
+    """HTTP proxy options (``serve/config.py`` HTTPOptions analog)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000
+    # port=0 binds an ephemeral port (test-friendly on shared machines)
+
+
+@dataclass
+class ReplicaState:
+    """One replica's lifecycle state as the controller tracks it
+    (``_private/common.py`` ReplicaState analog)."""
+
+    STARTING = "STARTING"
+    RUNNING = "RUNNING"
+    STOPPING = "STOPPING"
+    DEAD = "DEAD"

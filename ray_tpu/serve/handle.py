@@ -1,0 +1,81 @@
+"""DeploymentHandle: call a deployment from Python.
+
+Analog of the reference's ``serve/handle.py`` (RayServeHandle /
+RayServeSyncHandle): ``handle.remote(*args)`` routes a ``__call__`` request
+through a Router and returns an ObjectRef; ``handle.method.remote(...)``
+targets a named method.  Handles pickle (deployment composition passes them
+into other replicas' constructors) and rebuild their Router lazily in the
+destination process.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class _MethodCaller:
+    __slots__ = ("_handle", "_method")
+
+    def __init__(self, handle: "DeploymentHandle", method: str):
+        self._handle = handle
+        self._method = method
+
+    def remote(self, *args, **kwargs):
+        return self._handle._remote(self._method, args, kwargs)
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, controller_handle=None):
+        self.deployment_name = deployment_name
+        self._controller = controller_handle
+        self._router = None
+
+    # -- plumbing ------------------------------------------------------
+    def _get_router(self):
+        if self._router is None:
+            from ray_tpu.serve._private.router import Router
+
+            if self._controller is None:
+                import ray_tpu
+                from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+                self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            self._router = Router(self._controller, self.deployment_name)
+        return self._router
+
+    def _remote(self, method: str, args, kwargs):
+        return self._get_router().assign_request(method, args, kwargs)
+
+    # -- public --------------------------------------------------------
+    def remote(self, *args, **kwargs):
+        """Route one ``__call__`` request; returns an ObjectRef."""
+        return self._remote("__call__", args, kwargs)
+
+    def __getattr__(self, item: str) -> _MethodCaller:
+        if item.startswith("_") or item in ("deployment_name",):
+            raise AttributeError(item)
+        return _MethodCaller(self, item)
+
+    def options(self, **_kwargs) -> "DeploymentHandle":
+        """Accepted for API parity (method_name= etc. are expressed via
+        attribute access here)."""
+        return self
+
+    def __reduce__(self):
+        # Router state is per-process; rebuild lazily on the other side.
+        return (DeploymentHandle, (self.deployment_name, self._controller))
+
+    # Handles to the same deployment are interchangeable; the controller's
+    # code-change diff relies on this (fresh handle instances are created on
+    # every deploy of a composed app).
+    def __eq__(self, other):
+        return (
+            isinstance(other, DeploymentHandle)
+            and other.deployment_name == self.deployment_name
+        )
+
+    def __hash__(self):
+        return hash(("DeploymentHandle", self.deployment_name))
+
+    def __repr__(self) -> str:
+        return f"DeploymentHandle({self.deployment_name!r})"
