@@ -1,0 +1,34 @@
+"""ray_tpu.rllib — reinforcement learning on the jax substrate.
+
+Analog of the reference's ``rllib/`` minimal spine (SURVEY §2.4):
+``Algorithm``/``AlgorithmConfig`` as Tune Trainables, ``RolloutWorker``
+actors gathered in a ``WorkerSet``, ``SampleBatch`` columns, GAE
+postprocessing, and PPO with a fully-jitted loss+update.
+"""
+
+from ray_tpu.rllib.algorithm import (
+    Algorithm,
+    AlgorithmConfig,
+    synchronous_parallel_sample,
+    train_one_step,
+)
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.postprocessing import compute_gae
+from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.rollout_worker import RolloutWorker
+from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.rllib.worker_set import WorkerSet
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "PPO",
+    "PPOConfig",
+    "JaxPolicy",
+    "RolloutWorker",
+    "WorkerSet",
+    "SampleBatch",
+    "compute_gae",
+    "synchronous_parallel_sample",
+    "train_one_step",
+]

@@ -1,0 +1,212 @@
+"""Algorithm / AlgorithmConfig: the RLlib training driver.
+
+Analog of ``/root/reference/rllib/algorithms/algorithm.py:142`` (Algorithm
+— a Tune Trainable whose ``step`` runs ``training_step`` and aggregates
+rollout metrics) and ``algorithm_config.py:112`` (the fluent builder).
+An Algorithm owns a WorkerSet; subclasses implement ``training_step()``
+(sample → SGD → sync), the reference's ``algorithm.py:1284`` seam.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Optional, Type
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+from ray_tpu.rllib.worker_set import WorkerSet
+from ray_tpu.tune.trainable import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent config builder (``algorithm_config.py:112`` analog)."""
+
+    def __init__(self, algo_class: Optional[Type["Algorithm"]] = None):
+        self.algo_class = algo_class
+        self._config: Dict[str, Any] = {
+            "env": None,
+            "env_creator": None,
+            "env_config": {},
+            "num_rollout_workers": 0,
+            "num_cpus_per_worker": 1,
+            "rollout_fragment_length": 200,
+            "train_batch_size": 4000,
+            "gamma": 0.99,
+            "lr": 5e-4,
+            "fcnet_hiddens": (64, 64),
+            "seed": 0,
+            "framework": "jax",
+        }
+
+    # -- fluent sections (reference section names) ---------------------
+    def environment(self, env: Optional[str] = None, *, env_creator=None,
+                    env_config: Optional[Dict] = None) -> "AlgorithmConfig":
+        if env is not None:
+            self._config["env"] = env
+        if env_creator is not None:
+            self._config["env_creator"] = env_creator
+        if env_config is not None:
+            self._config["env_config"] = env_config
+        return self
+
+    def rollouts(self, *, num_rollout_workers: Optional[int] = None,
+                 rollout_fragment_length: Optional[int] = None) -> "AlgorithmConfig":
+        if num_rollout_workers is not None:
+            self._config["num_rollout_workers"] = num_rollout_workers
+        if rollout_fragment_length is not None:
+            self._config["rollout_fragment_length"] = rollout_fragment_length
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        self._config.update(kwargs)
+        return self
+
+    def resources(self, *, num_cpus_per_worker: Optional[int] = None) -> "AlgorithmConfig":
+        if num_cpus_per_worker is not None:
+            self._config["num_cpus_per_worker"] = num_cpus_per_worker
+        return self
+
+    def framework(self, framework: str = "jax") -> "AlgorithmConfig":
+        if framework != "jax":
+            raise ValueError("only framework='jax' is supported")
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self._config["seed"] = seed
+        return self
+
+    # -- materialize ---------------------------------------------------
+    def to_dict(self) -> Dict[str, Any]:
+        d = copy.copy(self._config)
+        d["_algo_class"] = self.algo_class
+        return d
+
+    def build(self) -> "Algorithm":
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class; use e.g. PPOConfig()")
+        return self.algo_class(config=self.to_dict())
+
+
+class Algorithm(Trainable):
+    """Tune-trainable RL driver (``algorithm.py:142``)."""
+
+    _default_config: Dict[str, Any] = {}
+
+    def __init__(self, config: Optional[Any] = None, **kwargs):
+        if isinstance(config, AlgorithmConfig):
+            config = config.to_dict()
+        super().__init__(config, **kwargs)
+
+    # -- Trainable hooks -----------------------------------------------
+    def setup(self, config: Dict[str, Any]) -> None:
+        merged = dict(self._default_config)
+        merged.update({k: v for k, v in config.items() if k != "_algo_class"})
+        self.config = merged
+        self.workers = WorkerSet(merged)
+        self._timesteps_total = 0
+
+    def step(self) -> Dict[str, Any]:
+        results = self.training_step()
+        metrics = (
+            self.workers.collect_metrics()
+            + [self.workers.local_worker.get_metrics()]
+            if self.workers.remote_workers
+            else [self.workers.local_worker.get_metrics()]
+        )
+        rews = [m["episode_reward_mean"] for m in metrics
+                if not np.isnan(m["episode_reward_mean"])]
+        lens = [m["episode_len_mean"] for m in metrics
+                if not np.isnan(m["episode_len_mean"])]
+        results.update({
+            "episode_reward_mean": float(np.mean(rews)) if rews else np.nan,
+            "episode_len_mean": float(np.mean(lens)) if lens else np.nan,
+            "episodes_total": int(sum(m["episodes_total"] for m in metrics)),
+            "timesteps_total": self._timesteps_total,
+        })
+        return results
+
+    def training_step(self) -> Dict[str, Any]:
+        """Default: sample and do nothing (``algorithm.py:1284`` is
+        framework-specific; subclasses override)."""
+        batch = self.workers.synchronous_parallel_sample()
+        self._timesteps_total += batch.count
+        return {}
+
+    def cleanup(self) -> None:
+        self.workers.stop()
+
+    # -- checkpointing (Trainable currency) ----------------------------
+    def save_checkpoint(self) -> Dict:
+        return {
+            "policy_state": self.workers.local_worker.policy.get_state(),
+            "timesteps_total": self._timesteps_total,
+            "config": {k: v for k, v in self.config.items()
+                       if isinstance(v, (int, float, str, bool, tuple, list, dict, type(None)))},
+        }
+
+    def load_checkpoint(self, state: Dict) -> None:
+        if "policy_state" in state:
+            self.workers.local_worker.policy.set_state(state["policy_state"])
+        else:  # older checkpoints carried bare weights
+            self.workers.local_worker.set_weights(state["weights"])
+        self._timesteps_total = state.get("timesteps_total", 0)
+        self.workers.sync_weights()
+
+    # -- inference ------------------------------------------------------
+    def compute_single_action(self, obs, explore: bool = False) -> int:
+        """Greedy (or sampled) action for one observation."""
+        policy = self.workers.local_worker.policy
+        obs = np.asarray(obs, np.float32)[None]
+        if explore:
+            action, _, _ = policy.compute_actions(obs)
+            return int(action[0])
+        import jax
+
+        from ray_tpu.rllib.models import apply_actor_critic
+
+        logits, _ = apply_actor_critic(policy.params, obs)
+        return int(np.argmax(np.asarray(logits)[0]))
+
+    def get_policy(self):
+        return self.workers.local_worker.policy
+
+
+# -- execution ops (rollout_ops/train_ops analogs as free functions) -----
+
+def synchronous_parallel_sample(worker_set: WorkerSet, *, max_env_steps: int) -> SampleBatch:
+    """Sample rounds until at least ``max_env_steps`` are collected
+    (``execution/rollout_ops.py:21``)."""
+    batches = []
+    total = 0
+    while total < max_env_steps:
+        b = worker_set.synchronous_parallel_sample()
+        batches.append(b)
+        total += b.count
+    return SampleBatch.concat_samples(batches)
+
+
+def train_one_step(
+    policy,
+    batch: SampleBatch,
+    *,
+    num_sgd_iter: int,
+    sgd_minibatch_size: int,
+    rng: np.random.Generator,
+    required_keys: tuple,
+) -> Dict[str, float]:
+    """Minibatch SGD epochs over one train batch
+    (``execution/train_ops.py:26``)."""
+    metrics: Dict[str, float] = {}
+    count = 0
+    mb_size = min(sgd_minibatch_size, batch.count)
+    for _ in range(num_sgd_iter):
+        for mb in batch.minibatches(mb_size, rng):
+            out = policy.learn_on_minibatch(
+                {k: mb[k] for k in required_keys}
+            )
+            for k, v in out.items():
+                metrics[k] = metrics.get(k, 0.0) + v
+            count += 1
+    return {k: v / max(count, 1) for k, v in metrics.items()}

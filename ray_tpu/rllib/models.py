@@ -1,0 +1,52 @@
+"""Actor-critic model in pure jax.
+
+The reference's ``ModelCatalog`` (``rllib/models/catalog.py:195``) builds
+torch/tf nets; here the default model is a jax MLP with separate policy and
+value trunks, expressed as a params pytree + pure apply so the whole PPO
+update jits into one XLA program.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_actor_critic(
+    rng: jax.Array, obs_dim: int, num_actions: int,
+    hiddens: Sequence[int] = (64, 64),
+) -> Dict:
+    """Params for policy and value MLPs (orthogonal-ish init: scaled
+    normal, zeros bias; final layers down-scaled as in PPO practice)."""
+
+    def dense(key, n_in, n_out, scale):
+        w_key, _ = jax.random.split(key)
+        w = jax.random.normal(w_key, (n_in, n_out)) * scale / jnp.sqrt(n_in)
+        return {"w": w, "b": jnp.zeros((n_out,))}
+
+    keys = jax.random.split(rng, 2 * len(hiddens) + 2)
+    pi, vf = [], []
+    n_in = obs_dim
+    for i, h in enumerate(hiddens):
+        pi.append(dense(keys[2 * i], n_in, h, 1.0))
+        vf.append(dense(keys[2 * i + 1], n_in, h, 1.0))
+        n_in = h
+    pi.append(dense(keys[-2], n_in, num_actions, 0.01))
+    vf.append(dense(keys[-1], n_in, 1, 1.0))
+    return {"pi": pi, "vf": vf}
+
+
+def apply_actor_critic(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """obs [B, obs_dim] -> (logits [B, A], value [B])."""
+
+    def mlp(layers, x):
+        for layer in layers[:-1]:
+            x = jnp.tanh(x @ layer["w"] + layer["b"])
+        last = layers[-1]
+        return x @ last["w"] + last["b"]
+
+    logits = mlp(params["pi"], obs)
+    value = mlp(params["vf"], obs)[..., 0]
+    return logits, value

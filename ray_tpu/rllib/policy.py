@@ -1,0 +1,116 @@
+"""JaxPolicy: params + jitted action sampling and SGD update.
+
+Analog of ``/root/reference/rllib/policy/policy.py:161`` (the per-agent
+compute_actions / learn_on_batch surface of ``TorchPolicyV2``,
+``torch_policy_v2.py:62``) on the jax substrate: everything that touches
+the accelerator is a pure jitted function over a params pytree, so the same
+policy runs on CPU workers for rollouts and on TPU for learner SGD.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.models import apply_actor_critic, init_actor_critic
+
+
+class JaxPolicy:
+    def __init__(
+        self,
+        obs_dim: int,
+        num_actions: int,
+        *,
+        lr: float = 5e-4,
+        hiddens=(64, 64),
+        seed: int = 0,
+        loss_fn: Optional[Callable] = None,
+        grad_clip: Optional[float] = 0.5,
+    ):
+        self.obs_dim = obs_dim
+        self.num_actions = num_actions
+        self._rng = jax.random.PRNGKey(seed)
+        self.params = init_actor_critic(
+            jax.random.PRNGKey(seed + 1), obs_dim, num_actions, hiddens
+        )
+        tx = [optax.clip_by_global_norm(grad_clip)] if grad_clip else []
+        self.optimizer = optax.chain(*tx, optax.adam(lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self._loss_fn = loss_fn  # (params, batch_dict) -> (loss, metrics)
+
+        @jax.jit
+        def _sample(params, rng, obs):
+            logits, value = apply_actor_critic(params, obs)
+            action = jax.random.categorical(rng, logits, axis=-1)
+            logp = jax.nn.log_softmax(logits)
+            action_logp = jnp.take_along_axis(logp, action[:, None], axis=-1)[:, 0]
+            return action, action_logp, value
+
+        @jax.jit
+        def _value(params, obs):
+            _, value = apply_actor_critic(params, obs)
+            return value
+
+        self._sample_jit = _sample
+        self._value_jit = _value
+        self._update_jit = None
+        if loss_fn is not None:
+
+            @jax.jit
+            def _update(params, opt_state, batch):
+                (loss, metrics), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params, batch)
+                updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return params, opt_state, loss, metrics
+
+            self._update_jit = _update
+
+    # -- acting --------------------------------------------------------
+    def compute_actions(self, obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """obs [B, D] -> (actions, action_logp, vf_preds), all numpy."""
+        self._rng, key = jax.random.split(self._rng)
+        a, lp, v = self._sample_jit(self.params, key, jnp.asarray(obs))
+        return np.asarray(a), np.asarray(lp), np.asarray(v)
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._value_jit(self.params, jnp.asarray(obs)))
+
+    # -- learning ------------------------------------------------------
+    def learn_on_minibatch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        if self._update_jit is None:
+            raise RuntimeError("policy constructed without a loss_fn")
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self.params, self.opt_state, loss, metrics = self._update_jit(
+            self.params, self.opt_state, jb
+        )
+        out = {"total_loss": float(loss)}
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+    # -- weights -------------------------------------------------------
+    def get_weights(self) -> Any:
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights: Any) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def get_state(self) -> Dict[str, Any]:
+        """Weights + optimizer moments, so a restored learner resumes with
+        the exact Adam state (not zeroed moments)."""
+        return {
+            "weights": self.get_weights(),
+            "opt_state": jax.tree_util.tree_map(np.asarray, self.opt_state),
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.set_weights(state["weights"])
+        if state.get("opt_state") is not None:
+            self.opt_state = jax.tree_util.tree_map(
+                jnp.asarray, state["opt_state"]
+            )

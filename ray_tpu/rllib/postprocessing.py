@@ -1,0 +1,39 @@
+"""GAE advantage estimation (``rllib/evaluation/postprocessing.py`` analog)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def compute_gae(
+    batch: SampleBatch,
+    last_value: float,
+    gamma: float = 0.99,
+    lambda_: float = 0.95,
+) -> SampleBatch:
+    """Generalized Advantage Estimation over one trajectory fragment.
+
+    ``last_value`` bootstraps the tail when the fragment was truncated
+    mid-episode (0.0 if the episode terminated).  Adds ADVANTAGES and
+    VALUE_TARGETS columns in place.
+    """
+    rewards = batch[SampleBatch.REWARDS]
+    values = batch[SampleBatch.VF_PREDS]
+    terminateds = batch[SampleBatch.TERMINATEDS]
+    n = len(rewards)
+    adv = np.zeros(n, dtype=np.float32)
+    last_gae = 0.0
+    next_value = last_value
+    for t in range(n - 1, -1, -1):
+        # a terminal step bootstraps nothing and cuts the trace coming from
+        # the NEXT episode's steps (we iterate backwards)
+        nonterminal = 0.0 if terminateds[t] else 1.0
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lambda_ * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    batch[SampleBatch.ADVANTAGES] = adv
+    batch[SampleBatch.VALUE_TARGETS] = (adv + values).astype(np.float32)
+    return batch

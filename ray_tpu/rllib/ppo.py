@@ -1,0 +1,118 @@
+"""PPO: clipped-surrogate policy optimization in jax.
+
+Analog of ``/root/reference/rllib/algorithms/ppo/ppo.py:311``
+(PPO.training_step: synchronous sampling → minibatch SGD with the clipped
+objective) with the loss of ``ppo_torch_policy.py`` expressed as a pure
+jax function, so one ``jax.jit`` covers forward, loss, backward, and the
+optimizer update.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, train_one_step
+from ray_tpu.rllib.models import apply_actor_critic
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def make_ppo_loss(clip_param: float, vf_clip_param: float,
+                  vf_loss_coeff: float, entropy_coeff: float):
+    """Loss factory; the returned closure is jitted inside JaxPolicy."""
+
+    def loss(params, batch):
+        logits, values = apply_actor_critic(params, batch[SampleBatch.OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
+        logp = jnp.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]
+        old_logp = batch[SampleBatch.ACTION_LOGP]
+        adv = batch[SampleBatch.ADVANTAGES]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        ratio = jnp.exp(logp - old_logp)
+        surr1 = ratio * adv
+        surr2 = jnp.clip(ratio, 1 - clip_param, 1 + clip_param) * adv
+        policy_loss = -jnp.mean(jnp.minimum(surr1, surr2))
+
+        vf_err = jnp.square(values - batch[SampleBatch.VALUE_TARGETS])
+        vf_loss = jnp.mean(jnp.minimum(vf_err, vf_clip_param ** 2))
+
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = policy_loss + vf_loss_coeff * vf_loss - entropy_coeff * entropy
+        metrics = {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+            "kl": jnp.mean(old_logp - logp),
+        }
+        return total, metrics
+
+    return loss
+
+
+def _ppo_loss_factory(config: Dict[str, Any]):
+    """Module-level so configs stay picklable; RolloutWorker calls this to
+    attach the loss at policy construction (one init, no learner rebuild)."""
+    return make_ppo_loss(
+        config["clip_param"], config["vf_clip_param"],
+        config["vf_loss_coeff"], config["entropy_coeff"],
+    )
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=PPO)
+        self._config.update(
+            _loss_factory=_ppo_loss_factory,
+            lr=3e-4,
+            train_batch_size=4000,
+            sgd_minibatch_size=128,
+            num_sgd_iter=10,
+            clip_param=0.2,
+            vf_clip_param=10.0,
+            vf_loss_coeff=0.5,
+            entropy_coeff=0.0,
+            lambda_=0.95,
+            grad_clip=0.5,
+        )
+
+
+class PPO(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        super().setup(config)
+        # the local worker's policy was built with _loss_factory attached,
+        # so it IS the learner — no rebuild
+        self._sgd_rng = np.random.default_rng(self.config.get("seed", 0))
+
+    def training_step(self) -> Dict[str, Any]:
+        """``ppo.py:311``: synchronous parallel sampling to
+        ``train_batch_size``, then clipped-objective minibatch SGD, then
+        weight broadcast."""
+        from ray_tpu.rllib.algorithm import synchronous_parallel_sample
+
+        cfg = self.config
+        self.workers.sync_weights()
+        batch = synchronous_parallel_sample(
+            self.workers, max_env_steps=cfg["train_batch_size"]
+        )
+        self._timesteps_total += batch.count
+        learner_metrics = train_one_step(
+            self.workers.local_worker.policy,
+            batch,
+            num_sgd_iter=cfg["num_sgd_iter"],
+            sgd_minibatch_size=cfg["sgd_minibatch_size"],
+            rng=self._sgd_rng,
+            required_keys=(
+                SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.ACTION_LOGP,
+                SampleBatch.ADVANTAGES, SampleBatch.VALUE_TARGETS,
+            ),
+        )
+        return {"info": {"learner": learner_metrics}}
+
+
+# set after the class exists (PPOConfig's __init__ references PPO)
+PPO._default_config = PPOConfig().to_dict()

@@ -1,0 +1,147 @@
+"""RolloutWorker: env stepping + trajectory postprocessing.
+
+Analog of ``/root/reference/rllib/evaluation/rollout_worker.py:153``: owns
+env instances and a policy copy, collects fixed-size sample fragments,
+postprocesses each episode segment with GAE at its boundary (terminal → no
+bootstrap; truncation/fragment end → bootstrap with v(s_T)), and exposes
+get/set_weights for learner sync.  Runs inline (local worker) or as an
+actor (``num_rollout_workers > 0``).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.postprocessing import compute_gae
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def _default_env_creator(env_name: str):
+    import gymnasium as gym
+
+    return gym.make(env_name)
+
+
+class RolloutWorker:
+    def __init__(self, config: Dict[str, Any], worker_index: int = 0):
+        self.config = config
+        self.worker_index = worker_index
+        env_creator: Optional[Callable] = config.get("env_creator")
+        if env_creator is not None:
+            self.env = env_creator(config.get("env_config", {}))
+        else:
+            self.env = _default_env_creator(config["env"])
+        obs_dim = int(np.prod(self.env.observation_space.shape))
+        num_actions = int(self.env.action_space.n)
+        seed = int(config.get("seed") or 0) + worker_index
+
+        from ray_tpu.rllib.policy import JaxPolicy
+
+        loss_factory = config.get("_loss_factory")
+        self.policy = JaxPolicy(
+            obs_dim,
+            num_actions,
+            lr=config.get("lr", 5e-4),
+            hiddens=tuple(config.get("fcnet_hiddens", (64, 64))),
+            seed=seed,  # per-worker: decorrelates action sampling rng
+            loss_fn=loss_factory(config) if loss_factory else None,
+            grad_clip=config.get("grad_clip", 0.5),
+        )
+        self.gamma = config.get("gamma", 0.99)
+        self.lambda_ = config.get("lambda_", 0.95)
+        self.fragment_length = config.get("rollout_fragment_length", 200)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._episode_reward = 0.0
+        self._episode_len = 0
+        self._episode_rewards: deque = deque(maxlen=100)
+        self._episode_lengths: deque = deque(maxlen=100)
+        self._eps_id = worker_index * 1_000_000
+        self._total_steps = 0
+
+    # ------------------------------------------------------------------
+    def sample(self) -> SampleBatch:
+        """One fragment of ``rollout_fragment_length`` steps, GAE-complete
+        (``rollout_worker.py`` sample -> SamplerInput analog)."""
+        cols: Dict[str, List] = {k: [] for k in (
+            SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
+            SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS,
+            SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS, SampleBatch.EPS_ID,
+        )}
+        segments: List[SampleBatch] = []
+        seg_start = 0
+
+        def close_segment(last_value: float):
+            nonlocal seg_start
+            if seg_start >= len(cols[SampleBatch.OBS]):
+                return
+            seg = SampleBatch({
+                k: np.asarray(v[seg_start:]) for k, v in cols.items()
+            })
+            segments.append(compute_gae(seg, last_value, self.gamma, self.lambda_))
+            seg_start = len(cols[SampleBatch.OBS])
+
+        for _ in range(self.fragment_length):
+            # flatten: the policy is an MLP over a 1-D feature vector
+            obs = np.asarray(self._obs, dtype=np.float32).reshape(-1)
+            action, logp, vf = self.policy.compute_actions(obs[None])
+            a = int(action[0])
+            next_obs, reward, terminated, truncated, _ = self.env.step(a)
+            cols[SampleBatch.OBS].append(obs)
+            cols[SampleBatch.ACTIONS].append(a)
+            cols[SampleBatch.REWARDS].append(np.float32(reward))
+            cols[SampleBatch.TERMINATEDS].append(terminated)
+            cols[SampleBatch.TRUNCATEDS].append(truncated)
+            cols[SampleBatch.ACTION_LOGP].append(np.float32(logp[0]))
+            cols[SampleBatch.VF_PREDS].append(np.float32(vf[0]))
+            cols[SampleBatch.EPS_ID].append(self._eps_id)
+            self._episode_reward += float(reward)
+            self._episode_len += 1
+            self._total_steps += 1
+            self._obs = next_obs
+            if terminated or truncated:
+                # terminal: no bootstrap; truncation: bootstrap v(s_T)
+                last_value = 0.0 if terminated else float(
+                    self.policy.value(
+                        np.asarray(next_obs, np.float32).reshape(1, -1)
+                    )[0]
+                )
+                close_segment(last_value)
+                self._episode_rewards.append(self._episode_reward)
+                self._episode_lengths.append(self._episode_len)
+                self._episode_reward = 0.0
+                self._episode_len = 0
+                self._eps_id += 1
+                self._obs, _ = self.env.reset()
+        # fragment ended mid-episode: bootstrap with v(current obs)
+        close_segment(float(
+            self.policy.value(np.asarray(self._obs, np.float32).reshape(1, -1))[0]
+        ))
+        return SampleBatch.concat_samples(segments)
+
+    # ------------------------------------------------------------------
+    def get_metrics(self) -> Dict[str, Any]:
+        rewards = list(self._episode_rewards)
+        return {
+            "episode_reward_mean": float(np.mean(rewards)) if rewards else np.nan,
+            "episode_len_mean": (
+                float(np.mean(self._episode_lengths)) if self._episode_lengths else np.nan
+            ),
+            "episodes_total": self._eps_id - self.worker_index * 1_000_000,
+            "worker_steps": self._total_steps,
+        }
+
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights) -> bool:
+        self.policy.set_weights(weights)
+        return True
+
+    def apply(self, fn_blob: bytes):
+        """Run a pickled fn(worker) — the reference's foreach_worker hook."""
+        import cloudpickle
+
+        return cloudpickle.loads(fn_blob)(self)

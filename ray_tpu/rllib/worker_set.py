@@ -1,0 +1,64 @@
+"""WorkerSet: local learner-side worker + remote rollout actors.
+
+Analog of ``/root/reference/rllib/evaluation/worker_set.py:77`` plus the
+execution ops it feeds (``execution/rollout_ops.py:21``
+``synchronous_parallel_sample``): remote workers sample in parallel as
+actors; weight sync broadcasts one ``put`` object to all of them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import ray_tpu
+from ray_tpu.rllib.rollout_worker import RolloutWorker
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class WorkerSet:
+    def __init__(self, config: Dict[str, Any]):
+        self.config = config
+        n = config.get("num_rollout_workers", 0)
+        # Local worker: holds the learner policy; also samples when n == 0.
+        self.local_worker = RolloutWorker(config, worker_index=0)
+        RemoteWorker = ray_tpu.remote(RolloutWorker)
+        opts = {"num_cpus": config.get("num_cpus_per_worker", 1)}
+        self.remote_workers = [
+            RemoteWorker.options(**opts).remote(config, worker_index=i + 1)
+            for i in range(n)
+        ]
+
+    # ------------------------------------------------------------------
+    def sync_weights(self) -> None:
+        """Broadcast local-worker weights to all remotes (one shared object,
+        not one copy per worker)."""
+        if not self.remote_workers:
+            return
+        ref = ray_tpu.put(self.local_worker.get_weights())
+        ray_tpu.get(
+            [w.set_weights.remote(ref) for w in self.remote_workers], timeout=120
+        )
+
+    def synchronous_parallel_sample(self) -> SampleBatch:
+        """One sampling round across all workers
+        (``execution/rollout_ops.py:21`` analog)."""
+        if not self.remote_workers:
+            return self.local_worker.sample()
+        batches = ray_tpu.get(
+            [w.sample.remote() for w in self.remote_workers], timeout=600
+        )
+        return SampleBatch.concat_samples(batches)
+
+    def collect_metrics(self) -> List[Dict[str, Any]]:
+        if not self.remote_workers:
+            return [self.local_worker.get_metrics()]
+        return ray_tpu.get(
+            [w.get_metrics.remote() for w in self.remote_workers], timeout=60
+        )
+
+    def stop(self) -> None:
+        for w in self.remote_workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
