@@ -11,7 +11,9 @@ fused normalization/loss layers.
 from ray_tpu.ops.attention import (
     attention,
     blockwise_attention,
+    causal_skip_attention,
     flash_attention_tpu,
+    full_attention,
     mha_reference,
 )
 from ray_tpu.ops.ring_attention import ring_attention
@@ -25,6 +27,8 @@ from ray_tpu.ops.layers import (
 __all__ = [
     "attention",
     "blockwise_attention",
+    "causal_skip_attention",
+    "full_attention",
     "flash_attention_tpu",
     "mha_reference",
     "ring_attention",

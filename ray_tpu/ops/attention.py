@@ -1,16 +1,26 @@
-"""Attention: reference, blockwise (flash-style jax), and pallas TPU kernel.
+"""Attention implementations with one contract — ``[B, H, T, D]`` q/k/v.
 
-Three implementations with one contract — ``[B, H, T, D]`` q/k/v, causal or
-full — picked by :func:`attention`:
+:func:`attention` dispatches by shape (measured on v5e, see each impl's
+docstring):
 
-- :func:`mha_reference` — naive O(T²) softmax attention; ground truth.
-- :func:`blockwise_attention` — online-softmax over k/v blocks via
-  ``lax.scan``; O(T) memory, differentiable through the scan, and the
-  inner block the ring-attention layer reuses.
-- :func:`flash_attention_tpu` — pallas kernel tiled for the MXU
-  (128-aligned blocks, f32 accumulators in VMEM scratch, bf16 matmuls),
-  wrapped in ``jax.custom_vjp`` with a blockwise-recompute backward so it
-  trains.
+- :func:`causal_skip_attention` — the causal production path at moderate
+  T: unrolled q-blocks contracting only visible keys (~40% FLOPs saved),
+  bf16 matmuls with f32 accumulation.  Fastest measured fwd+bwd.
+- :func:`full_attention` — masked materialized-scores path (non-causal,
+  or shapes causal-skip can't take).
+- :func:`blockwise_attention` — online-softmax ``lax.scan`` over k/v
+  blocks; O(block) memory, any length (pads+masks), differentiable; also
+  the inner block the ring-attention layer reuses.
+
+Not in the dispatch:
+
+- :func:`mha_reference` — naive O(T²) f32 attention; numerical ground
+  truth for tests.
+- :func:`flash_attention_tpu` — our pallas MXU-tiled kernel with a
+  blockwise-recompute backward.  Benchmarked SLOWER than the XLA paths
+  above at GPT-2 shapes (d_head=64) on v5e — kept as an explicit opt-in
+  and as the starting point for long-context kernel work, not selected
+  automatically.
 """
 
 from __future__ import annotations
@@ -243,15 +253,98 @@ def attention(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
     scale: Optional[float] = None, block_q: int = 128, block_k: int = 128,
 ) -> jax.Array:
-    """Dispatch: pallas kernel on TPU with aligned shapes, blockwise jax
-    otherwise.  Single entry point used by the model zoo."""
-    t_q, t_k, d = q.shape[-2], k.shape[-2], q.shape[-1]
-    on_tpu = _HAS_PALLAS and jax.default_backend() == "tpu"
-    aligned = (
-        t_q % min(block_q, t_q) == 0 and t_k % min(block_k, t_k) == 0
-        and t_q >= 128 and t_k >= 128 and d % 128 == 0
+    """Dispatch to the fastest correct implementation for the shape.
+    Single entry point used by the model zoo.
+
+    - causal, square, block-divisible, moderate T → :func:`causal_skip_attention`
+    - moderate T → :func:`full_attention` (masked, MXU dtypes)
+    - long T → :func:`blockwise_attention` (O(block) memory, pads+masks
+      any length; ring attention covers sharded-T)
+    """
+    t_q, t_k = q.shape[-2], k.shape[-2]
+    if t_q <= _MAX_MATERIALIZED_T and t_k <= _MAX_MATERIALIZED_T:
+        if causal and t_q == t_k and t_q % 256 == 0 and t_q >= 512:
+            return causal_skip_attention(q, k, v, scale=scale, block=256)
+        return full_attention(q, k, v, causal=causal, scale=scale)
+    return blockwise_attention(
+        q, k, v, causal=causal, scale=scale, block_k=block_k
     )
-    if on_tpu and aligned:
-        return flash_attention_tpu(q, k, v, causal, scale, block_q, block_k)
-    # blockwise pads+masks internally, so any seq len (192, primes, ...) works
-    return blockwise_attention(q, k, v, causal=causal, scale=scale, block_k=block_k)
+
+
+def _scores(q, k, scale: float) -> jax.Array:
+    """Q·Kᵀ in the input dtype with f32 accumulation (MXU-friendly)."""
+    bdims = tuple(range(q.ndim - 2))
+    return lax.dot_general(
+        q, k, (((q.ndim - 1,), (k.ndim - 1,)), (bdims, bdims)),
+        preferred_element_type=jnp.float32,
+    ) * scale
+
+
+def _weighted_values(p: jax.Array, v: jax.Array) -> jax.Array:
+    """softmax(P)·V with P cast back to V's dtype for the MXU."""
+    bdims = tuple(range(p.ndim - 2))
+    return lax.dot_general(
+        p.astype(v.dtype), v,
+        (((p.ndim - 1,), (v.ndim - 2,)), (bdims, bdims)),
+    )
+
+
+def full_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Materialized-scores attention with MXU-friendly dtypes: inputs stay
+    in their dtype (bf16 in the models), scores accumulate in f32
+    (``preferred_element_type``), softmax in f32, P@V back in input dtype.
+
+    Measured faster fwd+bwd on v5e at moderate T than our pallas kernel,
+    jax's in-tree pallas flash, and f32 blockwise (XLA fuses the masked
+    softmax; head_dim=64 tiles fine).
+    """
+    *_, t_q, d = q.shape
+    t_k = k.shape[-2]
+    scale = scale if scale is not None else d ** -0.5
+    s = _scores(q, k, scale)
+    if causal:
+        mask = jnp.tril(jnp.ones((t_q, t_k), dtype=bool), t_k - t_q)
+        s = jnp.where(mask, s, NEG_INF)
+    return _weighted_values(jax.nn.softmax(s, axis=-1), v)
+
+
+def causal_skip_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, *,
+    scale: Optional[float] = None, block: int = 256,
+) -> jax.Array:
+    """Causal attention that skips fully-masked key blocks: an unrolled
+    loop over q blocks where block i only contracts keys ``[0:(i+1)*block]``
+    — ~40% fewer FLOPs than masked full attention at T=1024, every matmul
+    shape static so XLA tiles each branch onto the MXU.  Requires
+    ``t_q == t_k`` divisible by ``block``.
+
+    One dot + one full-width masked select per q block, deliberately: an
+    A/B with separate unmasked-prefix/masked-diagonal dots measured ~7%
+    SLOWER end-to-end (XLA fuses the select into the softmax for free, but
+    two dots + concat fuse worse than one).  Measured ~2.5x faster fwd+bwd
+    than both pallas flash kernels (ours and jax's in-tree) at GPT-2
+    shapes on v5e — which is why this, not the pallas path, is the
+    dispatcher's causal default.
+    """
+    *_, t, d = q.shape
+    scale = scale if scale is not None else d ** -0.5
+    n = t // block
+    outs = []
+    for i in range(n):
+        qi = lax.slice_in_dim(q, i * block, (i + 1) * block, axis=-2)
+        kv_len = (i + 1) * block
+        ki = lax.slice_in_dim(k, 0, kv_len, axis=-2)
+        vi = lax.slice_in_dim(v, 0, kv_len, axis=-2)
+        q_pos = i * block + jnp.arange(block)
+        mask = q_pos[:, None] >= jnp.arange(kv_len)[None, :]
+        s = jnp.where(mask, _scores(qi, ki, scale), NEG_INF)
+        outs.append(_weighted_values(jax.nn.softmax(s, axis=-1), vi))
+    return jnp.concatenate(outs, axis=-2)
+
+
+# Above this, materialized scores risk HBM pressure; the O(block) blockwise
+# path takes over.
+_MAX_MATERIALIZED_T = 4096
