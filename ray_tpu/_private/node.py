@@ -179,6 +179,8 @@ class Node:
     ):
         from ray_tpu._private.resource_spec import autodetect_resources
 
+        from ray_tpu._private import shm as shm_mod
+
         self.cfg = get_config()
         self.session_dir = session_dir or (
             f"/tmp/ray_tpu/session_{os.getpid()}_{os.urandom(4).hex()}"
@@ -187,9 +189,21 @@ class Node:
         self.address = os.path.join(self.session_dir, "raylet.sock")
         self.authkey = os.urandom(16)
 
+        # Session-scoped shm namespace: sweep segments a SIGKILL'd previous
+        # head orphaned, then mark this session alive for the next sweeper.
+        self.session_id = os.urandom(4).hex()
+        os.environ[shm_mod._SESSION_ENV] = self.session_id  # workers inherit
+        swept = shm_mod.sweep_orphaned_segments()
+        if swept:
+            logger.info("swept %d orphaned shm segments from dead sessions", swept)
+        shm_mod.write_session_marker(self.session_id, os.getpid())
+
         self.lock = threading.RLock()
         self.cond = threading.Condition(self.lock)
-        self.registry = ObjectRegistry()
+        self.registry = ObjectRegistry(
+            capacity_bytes=self.cfg.object_store_memory or None,
+            spill_dir=os.path.join(self.session_dir, "spill"),
+        )
         self.gcs = GcsTables()
 
         self.nodes: Dict[str, NodeState] = {}
@@ -487,12 +501,24 @@ class Node:
     # objects
     # ------------------------------------------------------------------
     def seal_object(self, oid: bytes, loc: ObjectLocation, contained: List[bytes]) -> None:
-        for c in contained:
-            self.registry.add_ref(c)
-        self.registry.seal(oid, loc)
+        # contained refs are counted (and remembered for cascade-decrement
+        # when this object dies) inside the registry
+        self.registry.seal(oid, loc, contained)
         self._service_pending_gets()
         with self.lock:
             self.cond.notify_all()
+
+    def _release_spec_pins(self, spec: dict) -> None:
+        """Release a task spec's argument pins (idempotent — pops the
+        lists).  The pins were counted by the submitting client at
+        spec-build time (while its arg handles were provably alive, so the
+        increment can't race a finalizer's decrement); ``owned_oids`` are
+        spec-private objects (the big-args payload) whose initial refcount
+        belongs to the spec itself."""
+        for oid in spec.pop("pinned_refs", None) or []:
+            self.registry.remove_ref(oid)
+        for oid in spec.pop("owned_oids", None) or []:
+            self.registry.remove_ref(oid)
 
     def _on_get_request(self, conn: Connection, msg: dict, worker: Optional[WorkerHandle]) -> None:
         oids = msg["oids"]
@@ -573,6 +599,7 @@ class Node:
         from ray_tpu._private.object_store import store_value
         from ray_tpu._private.object_ref import ObjectRef
 
+        self._release_spec_pins(spec)
         for oid in spec["return_ids"]:
             loc, _ = store_value(ObjectRef(oid), err, is_error=True)
             self.registry.seal(oid, loc)
@@ -743,7 +770,15 @@ class Node:
         tid = spec["task_id"]
         with self.lock:
             rt = self.running.pop(tid, None)
+            full_spec = w.current_task  # has pinned_refs (spec_ref doesn't)
             w.current_task = None
+        # The task is over: its argument pins drop.  Borrowing workers have
+        # already registered their own handle refs (their add_ref messages
+        # precede this task_done on the same connection).  Actor creation
+        # specs keep their pins — they are re-dispatched on restart.
+        if full_spec is not None and not spec.get("is_actor_creation"):
+            self._release_spec_pins(full_spec)
+        with self.lock:
             ti = self.gcs.tasks.get(tid)
             if ti:
                 ti.state = "FAILED" if msg.get("failed") else "FINISHED"
@@ -880,6 +915,8 @@ class Node:
             else:
                 art.info.state = "ALIVE"
             self.cond.notify_all()
+        if failed:
+            self._release_spec_pins(art.info.creation_spec)
 
     def submit_actor_task(self, spec: dict) -> None:
         from ray_tpu.exceptions import RayActorError
@@ -935,6 +972,9 @@ class Node:
                 failed_specs.extend(art.queue)
                 art.queue.clear()
             self.cond.notify_all()
+        if info.state == "DEAD":
+            # permanently gone: creation-spec arg pins drop now
+            self._release_spec_pins(info.creation_spec)
         err = RayActorError(f"Actor {info.class_name} died: {reason}")
         for spec in failed_specs:
             self._seal_error_returns(spec, err)
@@ -970,6 +1010,8 @@ class Node:
                     art.held = {}
                     art.tpu_ids = []
                 self.cond.notify_all()
+        if art.info.state == "DEAD":
+            self._release_spec_pins(art.info.creation_spec)
         err = RayActorError(f"Actor {art.info.class_name} was killed before creation")
         for spec in failed_specs:
             self._seal_error_returns(spec, err)
@@ -1125,3 +1167,6 @@ class Node:
         except Exception:
             pass
         self.registry.shutdown()
+        from ray_tpu._private import shm as shm_mod
+
+        shm_mod.remove_session_marker(self.session_id)

@@ -12,7 +12,10 @@ import os
 
 
 class ObjectRef:
-    __slots__ = ("_id",)
+    # __weakref__ lets the runtime attach a finalizer per handle so garbage-
+    # collected refs decrement the owner-side count (ReferenceCounter's
+    # local-handle tracking seam).
+    __slots__ = ("_id", "__weakref__")
 
     def __init__(self, id_bytes: bytes):
         assert isinstance(id_bytes, bytes) and len(id_bytes) == 16

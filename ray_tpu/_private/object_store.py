@@ -5,8 +5,12 @@ Splits the reference's design across the same seams:
 - ``ObjectRegistry`` lives in the head process and plays the role of the
   plasma store's directory + ``ObjectLifecycleManager``
   (``src/ray/object_manager/plasma/store.h:55``,
-  ``object_lifecycle_manager.h:101``): it maps object id -> location, tracks
-  sealing, sizes, and reference counts, and unlinks segments on eviction.
+  ``object_lifecycle_manager.h:101``) plus the owner-side
+  ``ReferenceCounter`` (``src/ray/core_worker/reference_count.h:61``):
+  object id -> location, sealing, sizes, reference counts (handle refs +
+  contained-in-object refs + task-spec pins), eviction-by-spilling at the
+  ``object_store_memory`` cap (``local_object_manager.h:41`` analog), and
+  segment unlinking when the count hits zero.
 - Producers (workers/driver) serialize into a fresh shm segment themselves
   and then *seal* it with the registry — the plasma create/seal protocol
   without copying payloads through a socket.
@@ -15,27 +19,32 @@ Splits the reference's design across the same seams:
   (``src/ray/core_worker/store_provider/memory_store/memory_store.h``).
 
 Each consumer process keeps attached segments alive in ``_ATTACHED`` for the
-life of the process, like plasma clients holding their mmaps.
+life of the process, like plasma clients holding their mmaps (zero-copy
+views of values alias the mapping, so it cannot be unmapped eagerly).
 """
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import serialization
 from ray_tpu._private.config import get_config
 from ray_tpu._private.object_ref import ObjectRef
-from ray_tpu._private.shm import ShmSegment
+from ray_tpu._private.shm import ShmSegment, session_shm_name
 
 
 @dataclass
 class ObjectLocation:
-    """Where an object's payload lives. Exactly one of inline/shm is set."""
+    """Where an object's payload lives.  Exactly one of inline/shm_name/
+    spilled_path is set."""
 
     inline: Optional[bytes] = None
     shm_name: Optional[str] = None
+    spilled_path: Optional[str] = None
     size: int = 0
     # Serialized error objects raise on get (RayTaskError analog).
     is_error: bool = False
@@ -49,24 +58,41 @@ class ObjectLocation:
 class _Entry:
     loc: Optional[ObjectLocation] = None
     sealed: threading.Event = field(default_factory=threading.Event)
+    # handle refs (one per process holding live ObjectRefs) + contained-in-
+    # object refs + task-spec pins; starts at 1 for the creator's handle
     ref_count: int = 1
+    contained: List[bytes] = field(default_factory=list)
+    last_access: float = field(default_factory=time.monotonic)
+
+
+# Objects touched within this window are not spill candidates — closes the
+# race where a get reply carrying an shm location is in flight while the
+# head spills the segment out from under the consumer.
+_SPILL_MIN_IDLE_S = 5.0
 
 
 class ObjectRegistry:
     """Head-process directory of all objects in the session."""
 
-    def __init__(self):
+    def __init__(self, capacity_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
         self._lock = threading.Lock()
         self._objects: Dict[bytes, _Entry] = {}
-        self._bytes_used = 0
+        self._bytes_used = 0  # shm bytes only (spilled/inline don't count)
+        self._capacity = capacity_bytes
+        self._spill_dir = spill_dir
+        self._num_spilled = 0
 
+    # -- creation / sealing --------------------------------------------
     def create_pending(self, oid: bytes) -> None:
         """Declare an object that a task will produce (return slot)."""
         with self._lock:
             self._objects.setdefault(oid, _Entry())
 
-    def seal(self, oid: bytes, loc: ObjectLocation) -> None:
+    def seal(self, oid: bytes, loc: ObjectLocation,
+             contained: Optional[List[bytes]] = None) -> None:
         unlink = None
+        dead: List[bytes] = []
         with self._lock:
             e = self._objects.setdefault(oid, _Entry())
             if e.loc is not None:
@@ -77,11 +103,24 @@ class ObjectRegistry:
                 unlink = loc.shm_name
             else:
                 e.loc = loc
-                self._bytes_used += loc.size
+                e.contained = list(contained or [])
+                for c in e.contained:
+                    ce = self._objects.get(c)
+                    if ce is not None:
+                        ce.ref_count += 1
+                if loc.shm_name:
+                    self._bytes_used += loc.size
             e.sealed.set()
+            if e.ref_count <= 0:
+                # every handle died before the producer finished (fire-and-
+                # forget): reclaim immediately
+                self._delete_locked(oid, e, dead)
         if unlink:
             ShmSegment.unlink(unlink)
+        self._reap(dead)
+        self._maybe_spill()
 
+    # -- lookup --------------------------------------------------------
     def is_sealed(self, oid: bytes) -> bool:
         with self._lock:
             e = self._objects.get(oid)
@@ -92,15 +131,18 @@ class ObjectRegistry:
             e = self._objects.setdefault(oid, _Entry())
         if not e.sealed.wait(timeout):
             return None
+        e.last_access = time.monotonic()
         return e.loc
 
     def get_location(self, oid: bytes) -> Optional[ObjectLocation]:
         with self._lock:
             e = self._objects.get(oid)
-        if e is None or not e.sealed.is_set():
-            return None
-        return e.loc
+            if e is None or not e.sealed.is_set():
+                return None
+            e.last_access = time.monotonic()
+            return e.loc
 
+    # -- reference counting --------------------------------------------
     def add_ref(self, oid: bytes, n: int = 1) -> None:
         with self._lock:
             e = self._objects.get(oid)
@@ -108,26 +150,99 @@ class ObjectRegistry:
                 e.ref_count += n
 
     def remove_ref(self, oid: bytes, n: int = 1) -> None:
-        """Distributed-ref-counting-lite (ReferenceCounter, reference_count.h:61)."""
-        unlink = None
+        """Owner-side count decrement; deletes (and cascades to contained
+        refs) at zero.  Unsealed entries linger at count<=0 until their
+        producer seals, then reclaim immediately."""
+        dead: List[bytes] = []
         with self._lock:
-            e = self._objects.get(oid)
-            if e is None:
-                return
-            e.ref_count -= n
-            if e.ref_count <= 0 and e.sealed.is_set():
-                if e.loc and e.loc.shm_name:
-                    unlink = e.loc.shm_name
-                    self._bytes_used -= e.loc.size
-                del self._objects[oid]
-        if unlink:
-            ShmSegment.unlink(unlink)
+            self._remove_ref_locked(oid, n, dead)
+        self._reap(dead)
 
+    def _remove_ref_locked(self, oid: bytes, n: int, dead: List[bytes]) -> None:
+        e = self._objects.get(oid)
+        if e is None:
+            return
+        e.ref_count -= n
+        if e.ref_count <= 0 and e.sealed.is_set():
+            self._delete_locked(oid, e, dead)
+
+    def _delete_locked(self, oid: bytes, e: _Entry, dead: List[tuple]) -> None:
+        if e.loc is not None:
+            if e.loc.shm_name:
+                dead.append(("shm", e.loc.shm_name))
+                self._bytes_used -= e.loc.size
+            elif e.loc.spilled_path:
+                dead.append(("file", e.loc.spilled_path))
+        del self._objects[oid]
+        for c in e.contained:
+            self._remove_ref_locked(c, 1, dead)
+
+    @staticmethod
+    def _reap(dead: List[tuple]) -> None:
+        for kind, name in dead:
+            if kind == "file":
+                try:
+                    os.unlink(name)
+                except OSError:
+                    pass
+            else:
+                ShmSegment.unlink(name)
+
+    # -- capacity / spilling -------------------------------------------
+    def _maybe_spill(self) -> None:
+        """Move least-recently-accessed shm objects to disk until under the
+        capacity (plasma eviction + LocalObjectManager spill analog).
+        Spilled objects stay gettable — consumers read the file."""
+        if self._capacity is None or self._spill_dir is None:
+            return
+        while True:
+            with self._lock:
+                if self._bytes_used <= self._capacity:
+                    return
+                now = time.monotonic()
+                candidates = [
+                    (e.last_access, oid, e)
+                    for oid, e in self._objects.items()
+                    if e.sealed.is_set() and e.loc is not None and e.loc.shm_name
+                    and now - e.last_access >= _SPILL_MIN_IDLE_S
+                ]
+                if not candidates:
+                    return  # everything hot; stay over cap rather than race
+                candidates.sort()
+                _, oid, e = candidates[0]
+                shm_name, size = e.loc.shm_name, e.loc.size
+            os.makedirs(self._spill_dir, exist_ok=True)
+            path = os.path.join(self._spill_dir, oid.hex())
+            try:
+                seg = ShmSegment.attach(shm_name, size)
+                try:
+                    with open(path, "wb") as f:
+                        f.write(seg.buf)
+                finally:
+                    seg.close()
+            except OSError:
+                return
+            with self._lock:
+                e2 = self._objects.get(oid)
+                if e2 is None or e2.loc is None or e2.loc.shm_name != shm_name:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    continue  # deleted concurrently
+                e2.loc.shm_name = None
+                e2.loc.spilled_path = path
+                self._bytes_used -= size
+                self._num_spilled += 1
+            ShmSegment.unlink(shm_name)
+
+    # -- admin ---------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
             return {
                 "num_objects": len(self._objects),
                 "bytes_used": self._bytes_used,
+                "num_spilled": self._num_spilled,
             }
 
     def all_shm_names(self) -> List[str]:
@@ -138,7 +253,14 @@ class ObjectRegistry:
         for name in self.all_shm_names():
             ShmSegment.unlink(name)
         with self._lock:
+            spilled = [e.loc.spilled_path for e in self._objects.values()
+                       if e.loc and e.loc.spilled_path]
             self._objects.clear()
+        for p in spilled:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -157,7 +279,7 @@ def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[Obj
     if total <= cfg.max_direct_call_object_size:
         blob = serialization.to_bytes(meta, buffers)
         return ObjectLocation(inline=blob, is_error=is_error), refs
-    name = f"{cfg.shm_prefix}-{ref.hex()}"
+    name = session_shm_name(ref.hex())
     seg = ShmSegment.create(name, total)
     try:
         serialization.write_into(seg.buf, meta, buffers)
@@ -167,9 +289,13 @@ def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[Obj
 
 
 def read_value(loc: ObjectLocation) -> Any:
-    """Deserialize an object from its location (zero-copy for shm payloads)."""
+    """Deserialize an object from its location (zero-copy for shm payloads;
+    spilled objects are read back from disk)."""
     if loc.inline is not None:
         value = serialization.deserialize(memoryview(loc.inline))
+    elif loc.spilled_path is not None:
+        with open(loc.spilled_path, "rb") as f:
+            value = serialization.deserialize(memoryview(f.read()))
     else:
         with _ATTACHED_LOCK:
             seg = _ATTACHED.get(loc.shm_name)

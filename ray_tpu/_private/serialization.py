@@ -63,8 +63,15 @@ class _OOBPickler(_cloudpickle.Pickler):
 
 def _deserialize_object_ref(hex_id: str):
     from ray_tpu._private.object_ref import ObjectRef
+    from ray_tpu._private.worker import global_worker
 
-    return ObjectRef.from_hex(hex_id)
+    ref = ObjectRef.from_hex(hex_id)
+    if global_worker.connected:
+        # borrow registration: this process now holds a handle; the
+        # enclosing container (task spec or sealed object) is still pinned
+        # while we deserialize, so the add_ref cannot race the deletion
+        return global_worker.track_ref(ref, owned=False)
+    return ref
 
 
 def serialize(value: Any) -> Tuple[bytes, List[memoryview], list]:

@@ -72,3 +72,82 @@ class ShmSegment:
     @staticmethod
     def exists(name: str) -> bool:
         return os.path.exists(os.path.join(SHM_DIR, name))
+
+
+# ---------------------------------------------------------------------------
+# Session-scoped naming + orphan sweeping
+# ---------------------------------------------------------------------------
+# Segment names are "{prefix}-{session}-{oid}".  Every session also writes a
+# liveness marker "{prefix}-{session}-alive" containing the head PID, so the
+# next init() can reclaim segments a SIGKILL'd head left behind without
+# touching a concurrently-running session's objects.
+
+_SESSION_ENV = "RAY_TPU_SESSION"
+
+
+def current_session_id() -> str:
+    return os.environ.get(_SESSION_ENV, "nosession")
+
+
+def session_shm_name(oid_hex: str) -> str:
+    from ray_tpu._private.config import get_config
+
+    return f"{get_config().shm_prefix}-{current_session_id()}-{oid_hex}"
+
+
+def write_session_marker(session_id: str, pid: int) -> None:
+    from ray_tpu._private.config import get_config
+
+    path = os.path.join(SHM_DIR, f"{get_config().shm_prefix}-{session_id}-alive")
+    with open(path, "w") as f:
+        f.write(str(pid))
+
+
+def remove_session_marker(session_id: str) -> None:
+    from ray_tpu._private.config import get_config
+
+    try:
+        os.unlink(os.path.join(SHM_DIR, f"{get_config().shm_prefix}-{session_id}-alive"))
+    except OSError:
+        pass
+
+
+def sweep_orphaned_segments() -> int:
+    """Unlink segments belonging to sessions whose head process is dead
+    (no marker, or marker PID not alive).  Returns how many were removed.
+    Called at head start — the plasma-store restart cleanup the reference
+    gets from deleting its whole arena file."""
+    from ray_tpu._private.config import get_config
+
+    prefix = get_config().shm_prefix
+    try:
+        names = os.listdir(SHM_DIR)
+    except OSError:
+        return 0
+    sessions: dict = {}
+    for n in names:
+        if not n.startswith(prefix + "-"):
+            continue
+        rest = n[len(prefix) + 1:]
+        sid = rest.split("-", 1)[0]
+        sessions.setdefault(sid, []).append(n)
+    removed = 0
+    for sid, segs in sessions.items():
+        marker = f"{prefix}-{sid}-alive"
+        alive = False
+        try:
+            with open(os.path.join(SHM_DIR, marker)) as f:
+                pid = int(f.read().strip() or "0")
+            os.kill(pid, 0)  # raises if dead
+            alive = True
+        except (OSError, ValueError):
+            alive = False
+        if alive:
+            continue
+        for n in segs:
+            try:
+                os.unlink(os.path.join(SHM_DIR, n))
+                removed += 1
+            except OSError:
+                pass
+    return removed

@@ -16,7 +16,10 @@ import hashlib
 import os
 import queue
 import threading
+import time
 import traceback
+import weakref
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -57,6 +60,81 @@ class Worker:
         self.current_actor_id: Optional[bytes] = None
         self.actor_instance: Any = None
         self.task_depth: int = 0
+        # local handle counts per oid; the head is told when this process's
+        # first handle appears (borrow) and when its last one dies
+        self._ref_counts: Dict[bytes, int] = {}
+        self._ref_lock = threading.Lock()
+        # Finalizers only ever append here — a deque append is atomic,
+        # allocates without taking our lock, and is reentrancy-safe, so a
+        # GC pass firing a finalizer mid-track_ref can't self-deadlock
+        # (the reference's ReferenceCounter defers finalizer work the same
+        # way).  Drained by flush_removals on client calls + a 1s timer.
+        self._dead_handles: "deque[bytes]" = deque()
+        self._flusher_started = False
+
+    # ------------------------------------------------------------------
+    # reference tracking (client half of ReferenceCounter)
+    # ------------------------------------------------------------------
+    def track_ref(self, ref: ObjectRef, *, owned: bool) -> ObjectRef:
+        """Register a live handle.  ``owned=True`` for refs whose head-side
+        entry was created on this process's behalf with an initial count
+        (put / task returns); ``owned=False`` for deserialized borrows,
+        which add_ref immediately (the enclosing container's pin is still
+        held, so the increment can't race the object's deletion)."""
+        oid = ref.binary()
+        announce = False
+        with self._ref_lock:
+            n = self._ref_counts.get(oid, 0)
+            self._ref_counts[oid] = n + 1
+            if n == 0 and not owned:
+                announce = True
+        if announce and self.client is not None and not self.client.closed:
+            try:
+                self.client.add_refs([oid])
+            except Exception:
+                pass
+        weakref.finalize(ref, self._dead_handles.append, oid)
+        self._ensure_flusher()
+        return ref
+
+    def _ensure_flusher(self) -> None:
+        if self._flusher_started:
+            return
+        self._flusher_started = True
+
+        def loop():
+            while True:
+                time.sleep(1.0)
+                if self.client is None or self.client.closed:
+                    continue
+                try:
+                    self.flush_removals()
+                except Exception:
+                    pass
+
+        threading.Thread(target=loop, daemon=True, name="ref-flusher").start()
+
+    def flush_removals(self) -> None:
+        """Drain finalizer notifications: decrement local counts, tell the
+        head about handles whose last local copy died."""
+        removals: List[bytes] = []
+        with self._ref_lock:
+            while True:
+                try:
+                    oid = self._dead_handles.popleft()
+                except IndexError:
+                    break
+                n = self._ref_counts.get(oid, 0) - 1
+                if n > 0:
+                    self._ref_counts[oid] = n
+                else:
+                    self._ref_counts.pop(oid, None)
+                    removals.append(oid)
+        if removals and self.client is not None and not self.client.closed:
+            try:
+                self.client.remove_refs(removals)
+            except Exception:
+                pass
 
     @property
     def connected(self) -> bool:
@@ -66,14 +144,16 @@ class Worker:
     # objects
     # ------------------------------------------------------------------
     def put(self, value: Any) -> ObjectRef:
+        self.flush_removals()
         ref = ObjectRef.random()
         loc, contained = store_value(ref, value)
         self.client.seal(ref.binary(), loc, [r.binary() for r in contained])
-        return ref
+        return self.track_ref(ref, owned=True)
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         from ray_tpu.exceptions import GetTimeoutError
 
+        self.flush_removals()
         oids = [r.binary() for r in refs]
         blocked = self.mode == "worker" and self.task_depth > 0
         if blocked:
@@ -85,11 +165,18 @@ class Worker:
                 self.client.notify_unblocked()
         if locations is None:
             raise GetTimeoutError(f"Get timed out after {timeout}s for {len(oids)} objects")
-        return [read_value(locations[oid]) for oid in oids]
+        try:
+            return [read_value(locations[oid]) for oid in oids]
+        except FileNotFoundError:
+            # segment spilled/moved between location reply and attach —
+            # one refetch gets the fresh location
+            locations = self.client.get_locations(list(set(oids)), timeout)
+            return [read_value(locations[oid]) for oid in oids]
 
     def wait(
         self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float]
     ) -> Tuple[List[ObjectRef], List[ObjectRef]]:
+        self.flush_removals()
         oids = [r.binary() for r in refs]
         blocked = self.mode == "worker" and self.task_depth > 0
         if blocked:
@@ -155,20 +242,29 @@ class Worker:
         conv_args = tuple(_convert(a) for a in args)
         conv_kwargs = {k: _convert(v) for k, v in kwargs.items()}
         meta, buffers, contained = serialization.serialize((conv_args, conv_kwargs))
-        if contained:
-            self.client.add_refs([r.binary() for r in contained])
+        # Pin every referenced object for the task's lifetime: top-level arg
+        # refs (dep_ids) and refs nested inside serialized args.  Counted
+        # HERE, while the caller's handles are provably alive (they sit in
+        # ``args``), so a handle finalizer can't race the increment; the
+        # head releases the pins when the task completes.
+        pinned = list(dict.fromkeys(dep_ids + [r.binary() for r in contained]))
+        if pinned:
+            self.client.add_refs(pinned)
+        owned_oids: List[bytes] = []
         total = serialization.total_size(meta, buffers)
         if total <= cfg.max_direct_call_object_size:
             args_blob = serialization.to_bytes(meta, buffers)
             args_oid = None
         else:
-            # big args travel via the object store, not the control socket
+            # big args travel via the object store, not the control socket;
+            # the spec owns this object's initial refcount
             big_ref = ObjectRef.random()
             loc, _ = store_value(big_ref, (conv_args, conv_kwargs))
             self.client.seal(big_ref.binary(), loc, [])
             args_blob = None
             args_oid = big_ref.binary()
             dep_ids.append(args_oid)
+            owned_oids.append(args_oid)
         task_id = new_id()
         return_ids = [new_id() for _ in range(num_returns)]
         spec = {
@@ -178,6 +274,8 @@ class Worker:
             "args_blob": args_blob,
             "args_oid": args_oid,
             "dep_ids": dep_ids,
+            "pinned_refs": pinned,
+            "owned_oids": owned_oids,
             "return_ids": return_ids,
             "num_returns": num_returns,
             "resources": dict(resources),
@@ -190,7 +288,9 @@ class Worker:
             "actor_name": actor_name,
             "runtime_env": runtime_env,
         }
-        return spec, [ObjectRef(oid) for oid in return_ids]
+        return spec, [
+            self.track_ref(ObjectRef(oid), owned=True) for oid in return_ids
+        ]
 
 
 global_worker = Worker()
@@ -237,7 +337,13 @@ def _execute_task(msg: dict) -> None:
     failed = False
     error_str = None
     try:
-        args, kwargs = _resolve_args(spec, dep_locs)
+        try:
+            args, kwargs = _resolve_args(spec, dep_locs)
+        except FileNotFoundError:
+            # a dep's segment was spilled between dispatch and attach —
+            # refetch locations once (same guard Worker.get has)
+            fresh = w.client.get_locations(list(dep_locs), timeout=60)
+            args, kwargs = _resolve_args(spec, fresh or dep_locs)
         if spec.get("is_actor_creation"):
             cls = w.fetch_function(spec["fn_id"])
             w.task_depth += 1
