@@ -76,6 +76,26 @@ def _worker_pythonpath(existing: str) -> str:
     return os.pathsep.join(parts)
 
 
+def _runtime_env_key(runtime_env: Optional[dict]) -> Optional[str]:
+    """Stable identity of a runtime_env — pooled workers are keyed by it so a
+    task only ever reuses a worker spawned with the same environment (the
+    reference's dedicated-worker-per-runtime-env rule,
+    ``src/ray/raylet/worker_pool.h:156``)."""
+    if not runtime_env:
+        return None
+    import json
+
+    return json.dumps(runtime_env, sort_keys=True)
+
+
+def _apply_runtime_env(env: Dict[str, str], runtime_env: Optional[dict]) -> Optional[str]:
+    """Fold env_vars into a worker's spawn env; returns the cwd override."""
+    if not runtime_env:
+        return None
+    env.update(runtime_env.get("env_vars") or {})
+    return runtime_env.get("working_dir")
+
+
 def _fits(req: Dict[str, float], avail: Dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
 
@@ -101,7 +121,10 @@ class WorkerHandle:
     actor_id: Optional[bytes] = None
     current_task: Optional[dict] = None
     send_lock: threading.Lock = field(default_factory=threading.Lock)
-    blocked: bool = False
+    # Nested/concurrent ray.get depth: CPUs are released on 0->1 and
+    # reacquired on 1->0 (threaded actors can block several methods at once).
+    block_depth: int = 0
+    runtime_env_key: Optional[str] = None
 
     def send(self, msg: dict) -> None:
         with self.send_lock:
@@ -117,6 +140,8 @@ class NodeState:
     env: Dict[str, str] = field(default_factory=dict)
     idle: List[WorkerHandle] = field(default_factory=list)
     starting: int = 0
+    # in-flight spawns per runtime_env key (None = plain workers)
+    starting_by_key: Dict[Optional[str], int] = field(default_factory=dict)
     # tasks whose resources are held, waiting for an idle worker
     ready_queue: deque = field(default_factory=deque)
     alive: bool = True
@@ -134,10 +159,16 @@ class ActorRuntime:
     info: ActorInfo
     worker: Optional[WorkerHandle] = None
     queue: deque = field(default_factory=deque)  # pending method specs
-    running: Optional[dict] = None  # in-flight method spec
+    # in-flight method specs by task id; up to max_concurrency of them
+    # (threaded/async actors — OutOfOrderActorSchedulingQueue analog)
+    inflight: Dict[bytes, dict] = field(default_factory=dict)
     held: Dict[str, float] = field(default_factory=dict)
     tpu_ids: List[int] = field(default_factory=list)
     node_id: Optional[str] = None
+
+    @property
+    def max_concurrency(self) -> int:
+        return int(self.info.creation_spec.get("max_concurrency") or 1)
 
 
 @dataclass
@@ -394,8 +425,12 @@ class Node:
     # ------------------------------------------------------------------
     # workers
     # ------------------------------------------------------------------
-    def _spawn_worker(self, ns: NodeState) -> None:
-        """Fork/exec a language worker (WorkerPool::StartWorkerProcess analog)."""
+    def _spawn_worker(self, ns: NodeState, runtime_env: Optional[dict] = None) -> None:
+        """Fork/exec a language worker (WorkerPool::StartWorkerProcess analog).
+
+        With a runtime_env, the worker is spawned inside that environment
+        (env_vars + working_dir) and only ever serves tasks declaring the
+        identical env."""
         worker_id = os.urandom(8)
         env = dict(os.environ)
         env.update(ns.env)
@@ -405,13 +440,18 @@ class Node:
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
+        cwd = _apply_runtime_env(env, runtime_env)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker"],
             env=env,
+            cwd=cwd,
         )
-        h = WorkerHandle(worker_id=worker_id, node_id=ns.node_id, proc=proc)
+        key = _runtime_env_key(runtime_env)
+        h = WorkerHandle(worker_id=worker_id, node_id=ns.node_id, proc=proc,
+                         runtime_env_key=key)
         self.workers[worker_id] = h
         ns.starting += 1
+        ns.starting_by_key[key] = ns.starting_by_key.get(key, 0) + 1
 
     def _on_register_worker(self, conn: Connection, msg: dict) -> WorkerHandle:
         worker_id = bytes.fromhex(msg["worker_id"])
@@ -426,6 +466,8 @@ class Node:
             ns = self.nodes.get(h.node_id)
             if ns is not None:
                 ns.starting = max(0, ns.starting - 1)
+                k = h.runtime_env_key
+                ns.starting_by_key[k] = max(0, ns.starting_by_key.get(k, 0) - 1)
                 # Dedicated actor workers never join the general idle pool —
                 # they only ever run their actor's tasks.
                 if not h.is_actor_worker:
@@ -474,19 +516,30 @@ class Node:
         if h is None:
             return
         with self.lock:
-            if h.blocked == blocked or h.current_task is None:
-                return
-            h.blocked = blocked
-            tid = h.current_task["task_id"] if not h.is_actor_worker else None
             held = None
+            node_id = None
             if h.is_actor_worker and h.actor_id in self.actors:
                 held = self.actors[h.actor_id].held
                 node_id = self.actors[h.actor_id].node_id
-            elif tid is not None and tid in self.running:
-                held = self.running[tid]["held"]
-                node_id = self.running[tid]["node_id"]
+            elif h.current_task is not None:
+                tid = h.current_task["task_id"]
+                if tid in self.running:
+                    held = self.running[tid]["held"]
+                    node_id = self.running[tid]["node_id"]
             if held is None:
                 return
+            # depth-counted: only the 0->1 and 1->0 transitions move CPUs
+            # (threaded actors may have several methods blocked at once)
+            if blocked:
+                h.block_depth += 1
+                if h.block_depth != 1:
+                    return
+            else:
+                if h.block_depth == 0:
+                    return
+                h.block_depth -= 1
+                if h.block_depth != 0:
+                    return
             cpus = {CPU: held.get(CPU, 0.0)}
             ns = self.nodes.get(node_id)
             if ns is None or cpus[CPU] == 0.0:
@@ -702,27 +755,47 @@ class Node:
         for spec, e in failed_specs:
             self._seal_error_returns(spec, e)
         with self.lock:
-            # phase 2: dispatch ready tasks to idle workers; spawn if needed
+            # phase 2: dispatch ready tasks to idle workers whose runtime_env
+            # matches; spawn env-keyed workers for the rest
             for ns in self.nodes.values():
                 if not ns.alive:
                     continue
+                deferred = []
                 while ns.ready_queue:
-                    if not ns.idle:
-                        cap = int(ns.total.get(CPU, 1)) + self.cfg.maximum_startup_concurrency
-                        n_workers = sum(
-                            1
-                            for w in self.workers.values()
-                            if w.node_id == ns.node_id and w.state != "dead" and not w.is_actor_worker
-                        )
-                        # Spawn only what the queue needs; python startup is
-                        # expensive, so never boot more than 2 at a time.
-                        need = len(ns.ready_queue) - ns.starting
-                        if need > 0 and n_workers + ns.starting < max(1, cap) and ns.starting < 2:
-                            self._spawn_worker(ns)
-                        break
                     spec, tpu_ids, bundle = ns.ready_queue.popleft()
-                    w = ns.idle.pop()
+                    key = _runtime_env_key(spec.get("runtime_env"))
+                    w = next((c for c in ns.idle if c.runtime_env_key == key), None)
+                    if w is None:
+                        deferred.append((spec, tpu_ids, bundle, key))
+                        continue
+                    ns.idle.remove(w)
                     self._dispatch(ns, w, spec, tpu_ids, bundle)
+                if deferred:
+                    cap = int(ns.total.get(CPU, 1)) + self.cfg.maximum_startup_concurrency
+                    n_workers = sum(
+                        1
+                        for w in self.workers.values()
+                        if w.node_id == ns.node_id and w.state != "dead" and not w.is_actor_worker
+                    )
+                    # Spawn only what the queues need; python startup is
+                    # expensive, so never boot more than 2 at a time per env.
+                    need_by_key: Dict[Optional[str], int] = {}
+                    env_by_key: Dict[Optional[str], Optional[dict]] = {}
+                    for spec, _, _, key in deferred:
+                        need_by_key[key] = need_by_key.get(key, 0) + 1
+                        env_by_key.setdefault(key, spec.get("runtime_env"))
+                    for key, need in need_by_key.items():
+                        starting = ns.starting_by_key.get(key, 0)
+                        while (
+                            need > starting
+                            and starting < 2
+                            and n_workers + ns.starting < max(1, cap)
+                        ):
+                            self._spawn_worker(ns, runtime_env=env_by_key[key])
+                            starting += 1
+                            n_workers += 1
+                    for spec, tpu_ids, bundle, _ in deferred:
+                        ns.ready_queue.append((spec, tpu_ids, bundle))
 
     def _dispatch(self, ns: NodeState, w: WorkerHandle, spec: dict, tpu_ids: List[int], bundle) -> None:
         w.state = "busy"
@@ -756,9 +829,9 @@ class Node:
             if ns is None:
                 return
             held = dict(rt["held"])
-            if rt["worker"].blocked:
-                held[CPU] = held.get(CPU, 0.0) - held.get(CPU, 0.0)  # CPUs already released
-                rt["worker"].blocked = False
+            if rt["worker"].block_depth > 0:
+                held[CPU] = 0.0  # CPUs already released by the blocked path
+                rt["worker"].block_depth = 0
             bundle = rt.get("bundle")
             pool = bundle.available if bundle is not None and not bundle.detached else ns.available
             _release(held, pool)
@@ -770,8 +843,13 @@ class Node:
         tid = spec["task_id"]
         with self.lock:
             rt = self.running.pop(tid, None)
-            full_spec = w.current_task  # has pinned_refs (spec_ref doesn't)
-            w.current_task = None
+            if w.is_actor_worker and not spec.get("is_actor_creation"):
+                # concurrent actors complete out of order — find by task id
+                art0 = self.actors.get(w.actor_id)
+                full_spec = art0.inflight.get(tid) if art0 else None
+            else:
+                full_spec = w.current_task  # has pinned_refs (spec_ref doesn't)
+                w.current_task = None
         # The task is over: its argument pins drop.  Borrowing workers have
         # already registered their own handle refs (their add_ref messages
         # precede this task_done on the same connection).  Actor creation
@@ -798,7 +876,7 @@ class Node:
             if w.is_actor_worker and w.actor_id in self.actors:
                 art = self.actors[w.actor_id]
                 if not is_creation:
-                    art.running = None
+                    art.inflight.pop(tid, None)
             self.cond.notify_all()
 
     # ------------------------------------------------------------------
@@ -854,7 +932,10 @@ class Node:
                     if art.tpu_ids:
                         env["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in art.tpu_ids)
                         env["RAY_TPU_ASSIGNED_TPUS"] = env["TPU_VISIBLE_CHIPS"]
-                    proc = subprocess.Popen([sys.executable, "-m", "ray_tpu._private.worker"], env=env)
+                    if art.max_concurrency > 1:
+                        env["RAY_TPU_MAX_CONCURRENCY"] = str(art.max_concurrency)
+                    cwd = _apply_runtime_env(env, spec.get("runtime_env"))
+                    proc = subprocess.Popen([sys.executable, "-m", "ray_tpu._private.worker"], env=env, cwd=cwd)
                     h = WorkerHandle(
                         worker_id=worker_id,
                         node_id=ns.node_id,
@@ -887,22 +968,24 @@ class Node:
                             art.info.state = "STARTING"
                         except (OSError, ValueError):
                             pass
-                elif art.info.state == "ALIVE" and art.running is None and art.queue:
-                    spec = art.queue.popleft()
-                    if not self._deps_ready(spec):
-                        art.queue.appendleft(spec)
-                        continue
-                    art.running = spec
-                    w.current_task = spec
-                    try:
-                        w.send({
-                            "type": "execute",
-                            "spec": spec,
-                            "dep_locs": self._dep_locations(spec),
-                            "tpu_ids": art.tpu_ids,
-                        })
-                    except (OSError, ValueError):
-                        pass
+                elif art.info.state == "ALIVE":
+                    # pipeline up to max_concurrency in-flight methods
+                    # (threaded/async actors run them concurrently worker-side)
+                    while art.queue and len(art.inflight) < art.max_concurrency:
+                        spec = art.queue.popleft()
+                        if not self._deps_ready(spec):
+                            art.queue.appendleft(spec)
+                            break
+                        art.inflight[spec["task_id"]] = spec
+                        try:
+                            w.send({
+                                "type": "execute",
+                                "spec": spec,
+                                "dep_locs": self._dep_locations(spec),
+                                "tpu_ids": art.tpu_ids,
+                            })
+                        except (OSError, ValueError):
+                            break
 
     def _on_actor_started(self, spec: dict, w: WorkerHandle, failed: bool, error: Optional[str]) -> None:
         with self.lock:
@@ -942,10 +1025,8 @@ class Node:
             if art is None:
                 return
             info = art.info
-            failed_specs = []
-            if art.running is not None:
-                failed_specs.append(art.running)
-                art.running = None
+            failed_specs = list(art.inflight.values())
+            art.inflight.clear()
             art.worker = None
             # release resources
             ns = self.nodes.get(art.node_id) if art.node_id else None

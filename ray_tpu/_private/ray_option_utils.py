@@ -7,7 +7,8 @@ max_retries/max_restarts/num_returns/resources/...).
 
 from __future__ import annotations
 
-from typing import Any, Dict
+import os
+from typing import Any, Dict, Optional
 
 TASK_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "num_returns", "max_retries",
@@ -18,6 +19,39 @@ ACTOR_OPTIONS = {
     "scheduling_strategy", "name", "lifetime", "runtime_env", "memory",
     "max_concurrency",
 }
+
+# The runtime_env MVP honors process-level environments; anything the
+# reference installs through its per-node agent (pip/conda/container/
+# py_modules, ``python/ray/_private/runtime_env/plugin.py``) is rejected
+# loudly instead of silently dropped.
+SUPPORTED_RUNTIME_ENV_KEYS = {"env_vars", "working_dir"}
+
+
+def validate_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    if runtime_env is None:
+        return None
+    if not isinstance(runtime_env, dict):
+        raise TypeError(f"runtime_env must be a dict, got {type(runtime_env)}")
+    unsupported = set(runtime_env) - SUPPORTED_RUNTIME_ENV_KEYS
+    if unsupported:
+        raise ValueError(
+            f"Unsupported runtime_env keys {sorted(unsupported)}; this build "
+            f"supports {sorted(SUPPORTED_RUNTIME_ENV_KEYS)}"
+        )
+    env_vars = runtime_env.get("env_vars")
+    if env_vars is not None:
+        if not isinstance(env_vars, dict) or not all(
+            isinstance(k, str) and isinstance(v, str) for k, v in env_vars.items()
+        ):
+            raise TypeError("runtime_env['env_vars'] must be a Dict[str, str]")
+    working_dir = runtime_env.get("working_dir")
+    if working_dir is not None:
+        if not isinstance(working_dir, str) or not os.path.isdir(working_dir):
+            raise ValueError(
+                f"runtime_env['working_dir'] must be an existing local directory, "
+                f"got {working_dir!r}"
+            )
+    return runtime_env
 
 
 def validate_options(opts: Dict[str, Any], for_actor: bool) -> Dict[str, Any]:
@@ -35,6 +69,11 @@ def validate_options(opts: Dict[str, Any], for_actor: bool) -> Dict[str, Any]:
     nr = opts.get("num_returns")
     if nr is not None and (not isinstance(nr, int) or nr < 1):
         raise ValueError(f"num_returns must be an int >= 1, got {nr!r}")
+    mc = opts.get("max_concurrency")
+    if mc is not None and (not isinstance(mc, int) or mc < 1):
+        raise ValueError(f"max_concurrency must be an int >= 1, got {mc!r}")
+    if "runtime_env" in opts:
+        validate_runtime_env(opts["runtime_env"])
     return opts
 
 

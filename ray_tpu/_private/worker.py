@@ -12,7 +12,9 @@ and storing returns.  Also builds task specs (TaskSpecBuilder analog,
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
+import inspect
 import os
 import queue
 import threading
@@ -59,7 +61,9 @@ class Worker:
         self.current_task_id: Optional[bytes] = None
         self.current_actor_id: Optional[bytes] = None
         self.actor_instance: Any = None
-        self.task_depth: int = 0
+        # per-thread: threaded actors run several methods at once, and each
+        # thread's nested-get blocked/unblocked notifications must pair up
+        self._depth_local = threading.local()
         # local handle counts per oid; the head is told when this process's
         # first handle appears (borrow) and when its last one dies
         self._ref_counts: Dict[bytes, int] = {}
@@ -71,6 +75,14 @@ class Worker:
         # way).  Drained by flush_removals on client calls + a 1s timer.
         self._dead_handles: "deque[bytes]" = deque()
         self._flusher_started = False
+
+    @property
+    def task_depth(self) -> int:
+        return getattr(self._depth_local, "depth", 0)
+
+    @task_depth.setter
+    def task_depth(self, value: int) -> None:
+        self._depth_local.depth = value
 
     # ------------------------------------------------------------------
     # reference tracking (client half of ReferenceCounter)
@@ -229,6 +241,7 @@ class Worker:
         max_restarts: int = 0,
         actor_name: Optional[str] = None,
         runtime_env: Optional[dict] = None,
+        max_concurrency: int = 1,
     ) -> Tuple[dict, List[ObjectRef]]:
         cfg = get_config()
         dep_ids: List[bytes] = []
@@ -287,6 +300,7 @@ class Worker:
             "max_restarts": max_restarts,
             "actor_name": actor_name,
             "runtime_env": runtime_env,
+            "max_concurrency": max_concurrency,
         }
         return spec, [
             self.track_ref(ObjectRef(oid), owned=True) for oid in return_ids
@@ -299,6 +313,27 @@ global_worker = Worker()
 # ---------------------------------------------------------------------------
 # Task execution (worker process)
 # ---------------------------------------------------------------------------
+
+_async_loop: Optional[asyncio.AbstractEventLoop] = None
+_async_loop_lock = threading.Lock()
+
+
+def _get_async_loop() -> asyncio.AbstractEventLoop:
+    """Lazily start the worker's single persistent event loop thread."""
+    global _async_loop
+    with _async_loop_lock:
+        if _async_loop is None:
+            loop = asyncio.new_event_loop()
+            t = threading.Thread(target=loop.run_forever, daemon=True,
+                                 name="actor-async-loop")
+            t.start()
+            _async_loop = loop
+    return _async_loop
+
+
+async def _ensure_coro(awaitable):
+    return await awaitable
+
 
 def _resolve_args(spec: dict, dep_locs: Dict[bytes, ObjectLocation]) -> Tuple[tuple, dict]:
     if spec.get("args_oid"):
@@ -358,6 +393,14 @@ def _execute_task(msg: dict) -> None:
             w.task_depth += 1
             try:
                 out = method(*args, **kwargs)
+                if inspect.isawaitable(out):
+                    # async actor method: run on the worker's persistent event
+                    # loop so N awaited calls interleave (fiber.h / asyncio
+                    # concurrency-group analog); this thread parks on the
+                    # future while the loop multiplexes all in-flight methods
+                    out = asyncio.run_coroutine_threadsafe(
+                        _ensure_coro(out), _get_async_loop()
+                    ).result()
             finally:
                 w.task_depth -= 1
             results = _split_returns(out, spec["num_returns"])
@@ -366,6 +409,10 @@ def _execute_task(msg: dict) -> None:
             w.task_depth += 1
             try:
                 out = fn(*args, **kwargs)
+                if inspect.isawaitable(out):  # async remote function
+                    out = asyncio.run_coroutine_threadsafe(
+                        _ensure_coro(out), _get_async_loop()
+                    ).result()
             finally:
                 w.task_depth -= 1
             results = _split_returns(out, spec["num_returns"])
@@ -428,12 +475,34 @@ def main() -> None:
     w.client = client
     client.register_worker()
 
+    # Threaded/async actor support: with max_concurrency > 1 the head
+    # pipelines up to N methods at us; a BoundedExecutor-analog pool runs
+    # them concurrently (creation always runs inline, before any method).
+    max_concurrency = int(os.environ.get("RAY_TPU_MAX_CONCURRENCY", "1"))
+    pool = None
+    if max_concurrency > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        pool = ThreadPoolExecutor(
+            max_workers=min(max_concurrency, 64), thread_name_prefix="actor-exec"
+        )
+
     while True:
         msg = client._exec_queue.get()
         if msg["type"] == "exit":
             break
         if msg["type"] == "execute":
-            _execute_task(msg)
+            spec = msg["spec"]
+            if (
+                pool is not None
+                and spec.get("actor_id") is not None
+                and not spec.get("is_actor_creation")
+            ):
+                pool.submit(_execute_task, msg)
+            else:
+                _execute_task(msg)
+    if pool is not None:
+        pool.shutdown(wait=False)
     client.close()
     os._exit(0)
 

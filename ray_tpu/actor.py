@@ -113,6 +113,17 @@ class ActorClass:
             self._class_blob = cloudpickle.dumps(self._cls)
         fn_id = w.register_function(self._class_blob)
         actor_id = new_id()
+        # async actors default to high concurrency (the reference's asyncio
+        # actor default); sync actors serialize unless max_concurrency is set
+        max_concurrency = options.get("max_concurrency")
+        if max_concurrency is None:
+            import inspect
+
+            is_async = any(
+                inspect.iscoroutinefunction(m)
+                for _, m in inspect.getmembers(self._cls, inspect.isfunction)
+            )
+            max_concurrency = 1000 if is_async else 1
         # Actors default to 1 CPU for placement but hold 0 while idle in the
         # reference; we hold what was requested for the actor's lifetime.
         resources = ray_option_utils.resources_from_options(options, default_num_cpus=1)
@@ -129,6 +140,7 @@ class ActorClass:
             max_restarts=options.get("max_restarts", 0),
             actor_name=options.get("name"),
             runtime_env=options.get("runtime_env"),
+            max_concurrency=max_concurrency,
         )
         w.client.create_actor(spec)
         return ActorHandle(actor_id, self._cls.__name__)
