@@ -65,13 +65,13 @@ def init(cfg: BertConfig, key: jax.Array) -> Dict[str, Any]:
     }
 
 
-def logical_axes() -> Dict[str, Any]:
+def logical_axes(cfg: Optional["BertConfig"] = None) -> Dict[str, Any]:
     return {
         "wte": ("vocab", "embed"),
         "wpe": (None, "embed"),
         "wtype": (None, "embed"),
         "ln_emb_w": ("embed",), "ln_emb_b": ("embed",),
-        "blocks": block_logical_axes(),
+        "blocks": block_logical_axes(cfg.n_experts if cfg else 0),
         "pool_w": ("embed", "embed"),
         "pool_b": ("embed",),
         "cls_w": ("embed", None),
@@ -89,7 +89,7 @@ def apply(
     if token_types is not None:
         x = x + params["wtype"][token_types]
     x = layernorm(x, params["ln_emb_w"], params["ln_emb_b"]).astype(cfg.dtype)
-    x = apply_stack(x, params["blocks"], cfg, mesh)
+    x, _ = apply_stack(x, params["blocks"], cfg, mesh)
     cls = jnp.tanh(x[:, 0].astype(jnp.float32) @ params["pool_w"] + params["pool_b"])
     return cls @ params["cls_w"] + params["cls_b"]
 

@@ -59,33 +59,36 @@ def init(cfg: GPT2Config, key: jax.Array) -> Dict[str, Any]:
     }
 
 
-def logical_axes() -> Dict[str, Any]:
+def logical_axes(cfg: Optional[GPT2Config] = None) -> Dict[str, Any]:
     return {
         "wte": ("vocab", "embed"),
         "wpe": (None, "embed"),
-        "blocks": block_logical_axes(),
+        "blocks": block_logical_axes(cfg.n_experts if cfg else 0),
         "lnf_w": ("embed",),
         "lnf_b": ("embed",),
     }
 
 
-def param_shardings(mesh: Mesh, rules: ShardingRules):
-    return logical_to_sharding(logical_axes(), mesh, rules)
+def param_shardings(mesh: Mesh, rules: ShardingRules, cfg: Optional[GPT2Config] = None):
+    return logical_to_sharding(logical_axes(cfg), mesh, rules)
 
 
 def apply(
     params: Dict[str, Any], tokens: jax.Array, cfg: GPT2Config,
-    mesh: Optional[Mesh] = None,
-) -> jax.Array:
-    """tokens [B, T] int32 -> logits [B, T, V] (f32)."""
+    mesh: Optional[Mesh] = None, *, return_aux: bool = False,
+):
+    """tokens [B, T] int32 -> logits [B, T, V] (f32).
+
+    With ``return_aux=True`` returns ``(logits, aux)`` where aux is the
+    MoE load-balance loss (0 for dense configs)."""
     B, T = tokens.shape
     x = params["wte"][tokens] + params["wpe"][:T]
     x = x.astype(cfg.dtype)
-    x = apply_stack(x, params["blocks"], cfg, mesh)
+    x, aux = apply_stack(x, params["blocks"], cfg, mesh)
     x = layernorm(x, params["lnf_w"].astype(cfg.dtype), params["lnf_b"].astype(cfg.dtype))
     # tied embeddings for the LM head
-    logits = x @ params["wte"].T.astype(cfg.dtype)
-    return logits.astype(jnp.float32)
+    logits = (x @ params["wte"].T.astype(cfg.dtype)).astype(jnp.float32)
+    return (logits, aux) if return_aux else logits
 
 
 def loss_fn(
@@ -98,8 +101,11 @@ def loss_fn(
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
     else:
         inputs, targets = batch["inputs"], batch["targets"]
-    logits = apply(params, inputs, cfg, mesh)
-    return cross_entropy_loss(logits, targets)
+    logits, aux = apply(params, inputs, cfg, mesh, return_aux=True)
+    loss = cross_entropy_loss(logits, targets)
+    if cfg.n_experts > 0:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
 
 
 def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,

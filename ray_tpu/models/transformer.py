@@ -29,6 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_tpu.ops.attention import attention
 from ray_tpu.ops.layers import layernorm
+from ray_tpu.ops.moe import init_moe_params, moe_ffn, moe_logical_axes
 from ray_tpu.ops.ring_attention import ring_attention
 
 
@@ -46,6 +47,14 @@ class TransformerConfig:
     # pre-LN (GPT-2 style) by default; post-LN matches original BERT so
     # HF checkpoints load faithfully.
     post_ln: bool = False
+    # MoE: >0 replaces every block's FFN with a Switch-style top-1 MoE of
+    # this many experts (expert axis shards over the mesh's ep axis).
+    n_experts: int = 0
+    capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
+    # pipeline parallelism: microbatch count when the mesh has pp > 1
+    # (0 = one microbatch per stage).
+    pp_microbatches: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -56,37 +65,51 @@ def init_block_params(cfg: TransformerConfig, key: jax.Array) -> Dict[str, jax.A
     """Stacked block params, GPT-2 init (normal 0.02, residual projections
     scaled by 1/sqrt(2L))."""
     L, D, F = cfg.n_layers, cfg.d_model, cfg.d_ff
-    ks = jax.random.split(key, 4)
+    ks = jax.random.split(key, 5)
     std, res_std = 0.02, 0.02 / (2 * L) ** 0.5
-    return {
+    p = {
         "ln1_w": jnp.ones((L, D)), "ln1_b": jnp.zeros((L, D)),
         "wqkv": jax.random.normal(ks[0], (L, D, 3 * D)) * std,
         "bqkv": jnp.zeros((L, 3 * D)),
         "wo": jax.random.normal(ks[1], (L, D, D)) * res_std,
         "bo": jnp.zeros((L, D)),
         "ln2_w": jnp.ones((L, D)), "ln2_b": jnp.zeros((L, D)),
-        "w1": jax.random.normal(ks[2], (L, D, F)) * std,
-        "b1": jnp.zeros((L, F)),
-        "w2": jax.random.normal(ks[3], (L, F, D)) * res_std,
-        "b2": jnp.zeros((L, D)),
     }
+    if cfg.n_experts > 0:
+        p.update(init_moe_params(ks[4], L, D, F, cfg.n_experts,
+                                 std=std, res_std=res_std))
+    else:
+        p.update({
+            "w1": jax.random.normal(ks[2], (L, D, F)) * std,
+            "b1": jnp.zeros((L, F)),
+            "w2": jax.random.normal(ks[3], (L, F, D)) * res_std,
+            "b2": jnp.zeros((L, D)),
+        })
+    return p
 
 
-def block_logical_axes() -> Dict[str, Tuple]:
-    """Logical axis names for the stacked block params (leading layer axis
-    is never sharded across tp/fsdp — it is the scan axis)."""
-    return {
+def block_logical_axes(n_experts: int = 0) -> Dict[str, Tuple]:
+    """Logical axis names for the stacked block params.  The leading
+    ``layers`` axis is the scan axis; it shards over ``pp`` (and only
+    ``pp``) when the mesh pipelines."""
+    axes = {
         "ln1_w": ("layers", "embed"), "ln1_b": ("layers", "embed"),
         "wqkv": ("layers", "embed", "heads"),
         "bqkv": ("layers", "heads"),
         "wo": ("layers", "heads", "embed"),
         "bo": ("layers", "embed"),
         "ln2_w": ("layers", "embed"), "ln2_b": ("layers", "embed"),
-        "w1": ("layers", "embed", "mlp"),
-        "b1": ("layers", "mlp"),
-        "w2": ("layers", "mlp", "embed"),
-        "b2": ("layers", "embed"),
     }
+    if n_experts > 0:
+        axes.update(moe_logical_axes())
+    else:
+        axes.update({
+            "w1": ("layers", "embed", "mlp"),
+            "b1": ("layers", "mlp"),
+            "w2": ("layers", "mlp", "embed"),
+            "b2": ("layers", "embed"),
+        })
+    return axes
 
 
 def _attend(q, k, v, *, causal: bool, mesh: Optional[Mesh]) -> jax.Array:
@@ -109,11 +132,13 @@ def _attend(q, k, v, *, causal: bool, mesh: Optional[Mesh]) -> jax.Array:
 def apply_block(
     x: jax.Array, p: Dict[str, jax.Array], cfg: TransformerConfig,
     mesh: Optional[Mesh] = None,
-) -> jax.Array:
-    """One transformer block, pre-LN or post-LN.  x: [B, T, D] in cfg.dtype."""
+) -> Tuple[jax.Array, jax.Array]:
+    """One transformer block, pre-LN or post-LN.  x: [B, T, D] in cfg.dtype.
+    Returns ``(x, aux)`` — aux is the MoE load-balance loss (0 when dense)."""
     B, T, D = x.shape
     H, dh = cfg.n_heads, cfg.head_dim
     c = lambda w: w.astype(cfg.dtype)
+    aux = jnp.zeros((), jnp.float32)
 
     def attn(h):
         qkv = h @ c(p["wqkv"]) + c(p["bqkv"])
@@ -123,9 +148,18 @@ def apply_block(
         out = out.transpose(0, 2, 1, 3).reshape(B, T, D)
         return out @ c(p["wo"]) + c(p["bo"])
 
-    def ffn(h):
-        h = jax.nn.gelu(h @ c(p["w1"]) + c(p["b1"]), approximate=True)
-        return h @ c(p["w2"]) + c(p["b2"])
+    if cfg.n_experts > 0:
+        def ffn(h):
+            nonlocal aux
+            y, a = moe_ffn(h, p["router"], c(p["ew1"]), c(p["eb1"]),
+                           c(p["ew2"]), c(p["eb2"]),
+                           capacity_factor=cfg.capacity_factor, mesh=mesh)
+            aux = aux + a
+            return y
+    else:
+        def ffn(h):
+            h = jax.nn.gelu(h @ c(p["w1"]) + c(p["b1"]), approximate=True)
+            return h @ c(p["w2"]) + c(p["b2"])
 
     if cfg.post_ln:  # original-BERT residual->norm order
         x = layernorm(x + attn(x), c(p["ln1_w"]), c(p["ln1_b"]))
@@ -133,19 +167,34 @@ def apply_block(
     else:  # GPT-2 pre-LN
         x = x + attn(layernorm(x, c(p["ln1_w"]), c(p["ln1_b"])))
         x = x + ffn(layernorm(x, c(p["ln2_w"]), c(p["ln2_b"])))
-    return x
+    return x, aux
 
 
 def apply_stack(
     x: jax.Array, blocks: Dict[str, jax.Array], cfg: TransformerConfig,
     mesh: Optional[Mesh] = None,
-) -> jax.Array:
-    """scan over the stacked layer axis; each step optionally remat'd."""
+) -> Tuple[jax.Array, jax.Array]:
+    """Run the stacked layers; returns ``(x, aux)``.
+
+    Without ``pp`` the stack is one remat'd ``lax.scan`` over the layer
+    axis.  With a ``pp > 1`` mesh axis, the layer axis is sharded into
+    stages and the scan runs inside the GPipe engine
+    (:func:`ray_tpu.parallel.pipeline.gpipe`) — same math, microbatched.
+    """
 
     def body(x, layer_params):
-        return apply_block(x, layer_params, cfg, mesh), None
+        return apply_block(x, layer_params, cfg, mesh)
 
     if cfg.remat:
         body = jax.checkpoint(body)
-    x, _ = lax.scan(body, x, blocks)
-    return x
+
+    def stage(local_blocks, h):
+        h, auxs = lax.scan(body, h, local_blocks)
+        return h, auxs.sum()
+
+    from ray_tpu.parallel.pipeline import gpipe, pp_size
+
+    if mesh is not None and pp_size(mesh) > 1:
+        return gpipe(stage, blocks, x, mesh=mesh,
+                     n_microbatches=cfg.pp_microbatches)
+    return stage(blocks, x)

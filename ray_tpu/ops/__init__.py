@@ -17,6 +17,7 @@ from ray_tpu.ops.attention import (
     mha_reference,
 )
 from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops.moe import moe_ffn
 from ray_tpu.ops.layers import (
     cross_entropy_loss,
     layernorm,
@@ -25,6 +26,7 @@ from ray_tpu.ops.layers import (
 )
 
 __all__ = [
+    "moe_ffn",
     "attention",
     "blockwise_attention",
     "causal_skip_attention",

@@ -19,6 +19,7 @@ from ray_tpu.parallel.mesh import (
     get_abstract_mesh,
     local_mesh,
 )
+from ray_tpu.parallel.pipeline import gpipe, pp_size
 from ray_tpu.parallel.sharding import (
     ShardingRules,
     infer_sharding,
@@ -27,6 +28,8 @@ from ray_tpu.parallel.sharding import (
 )
 
 __all__ = [
+    "gpipe",
+    "pp_size",
     "MeshSpec",
     "create_mesh",
     "local_mesh",

@@ -1,0 +1,127 @@
+"""Microbatched pipeline parallelism over the ``pp`` mesh axis.
+
+The reference has no pipeline engine — SURVEY §2.5 marks PP "Absent as an
+engine; primitives only" (``DatasetPipeline`` is *data* pipelining,
+``python/ray/data/dataset_pipeline.py``).  This is the TPU-native engine
+built the way the scaling-book prescribes: a GPipe schedule expressed as a
+``lax.scan`` over pipeline ticks inside a **partial-manual**
+``jax.shard_map`` — only ``pp`` is manual; every other mesh axis
+(dp/fsdp/tp/ep/sp) stays under GSPMD so the stage body keeps its sharding
+annotations and XLA keeps inserting those collectives.
+
+Mechanics:
+
+- Layer-stacked params (leading ``[L, ...]`` axis) are sharded over ``pp``,
+  so each stage owns ``L / pp`` contiguous layers and runs one compiled
+  stage body regardless of depth.
+- Activations hop stage-to-stage with ``lax.ppermute`` — a single ICI
+  neighbour transfer per tick on a TPU torus.
+- The batch is split into ``M`` microbatches; the schedule runs
+  ``M + pp - 1`` ticks (the GPipe bubble).  Backward is jax autodiff
+  through the scan + ppermute, i.e. the reverse schedule, no hand-written
+  backward needed.
+- Every stage computes every tick (bubble ticks process don't-care data);
+  per-tick validity masks keep aux losses exact.
+
+Cost model: bubble fraction = (pp-1)/(M+pp-1); pick M >= 4*pp for <20%
+overhead.  Activation memory per device is O(M/pp) microbatches thanks to
+remat inside the stage body.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pp_size(mesh: Optional[Mesh]) -> int:
+    """Size of the pipeline axis (1 when absent)."""
+    if mesh is None or "pp" not in mesh.axis_names:
+        return 1
+    return mesh.shape["pp"]
+
+
+def gpipe(
+    stage_body: Callable[[Any, jax.Array], Tuple[jax.Array, jax.Array]],
+    blocks: Any,
+    x: jax.Array,
+    *,
+    mesh: Mesh,
+    n_microbatches: int = 0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Run ``x`` through a pipeline of stages over the ``pp`` mesh axis.
+
+    Args:
+        stage_body: ``(local_blocks, h) -> (h, aux)`` applying this stage's
+            layer slice to one microbatch; ``aux`` is a scalar auxiliary
+            loss (0.0 when unused).  Runs under GSPMD for non-pp axes.
+        blocks: layer-stacked param pytree; every leaf's leading axis is
+            the layer axis, sharded over ``pp`` (``L % pp == 0``).
+        x: ``[B, ...]`` activations; ``B % n_microbatches == 0``.
+        mesh: mesh containing a ``pp`` axis.
+        n_microbatches: microbatch count ``M`` (default: ``pp``).
+
+    Returns:
+        ``(y, aux)`` — same-shaped activations and the summed aux loss
+        (mean over microbatches, summed over all layers).
+    """
+    npp = pp_size(mesh)
+    if npp == 1:
+        y, aux = stage_body(blocks, x)
+        return y, aux
+
+    M = n_microbatches or npp
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    # Schedule plumbing (select/ppermute/psum of activations) runs in f32:
+    # XLA's partial-manual partitioner miscompiles ("invalid binary
+    # instruction opcode copy") when a non-f32 dtype crosses the
+    # manual/auto boundary; stage compute still runs in x.dtype.
+    dtype = x.dtype
+    xm = x.reshape(M, B // M, *x.shape[1:]).astype(jnp.float32)
+    perm = [(i, (i + 1) % npp) for i in range(npp)]
+
+    def program(blocks, xm):
+        stage = lax.axis_index("pp")
+        xm = lax.pcast(xm, ("pp",), to="varying")
+        state = jnp.zeros_like(xm[0])
+        outputs = jnp.zeros_like(xm)
+        aux0 = lax.pcast(jnp.zeros((), jnp.float32), ("pp",), to="varying")
+
+        def tick(carry, t):
+            state, outputs, aux_sum = carry
+            inp = jnp.where(stage == 0, xm[jnp.minimum(t, M - 1)], state)
+            y, aux = stage_body(blocks, inp.astype(dtype))
+            y = y.astype(jnp.float32)
+            # microbatch (t - stage) is in flight here iff 0 <= t-stage < M
+            valid = (t >= stage) & (t < stage + M)
+            aux_sum = aux_sum + jnp.where(valid, aux.astype(jnp.float32), 0.0)
+            idx = jnp.clip(t - (npp - 1), 0, M - 1)
+            write = (stage == npp - 1) & (t >= npp - 1)
+            outputs = outputs.at[idx].set(jnp.where(write, y, outputs[idx]))
+            state = lax.ppermute(y, "pp", perm)
+            return (state, outputs, aux_sum), None
+
+        (_, outputs, aux_sum), _ = lax.scan(
+            tick, (state, outputs, aux0), jnp.arange(M + npp - 1)
+        )
+        # the finished microbatches live on the last stage; mask-psum
+        # replicates them (and sums per-stage aux) across the pp axis
+        outputs = lax.psum(jnp.where(stage == npp - 1, outputs, 0.0), "pp")
+        aux = lax.psum(aux_sum, "pp") / M
+        return outputs, aux
+
+    blk_specs = jax.tree.map(lambda _: P("pp"), blocks)
+    y, aux = jax.shard_map(
+        program,
+        mesh=mesh,
+        in_specs=(blk_specs, P()),
+        out_specs=(P(), P()),
+        axis_names={"pp"},
+    )(blocks, xm)
+    return y.reshape(B, *x.shape[1:]).astype(dtype), aux

@@ -77,6 +77,8 @@ def rules_for_mesh(mesh: Mesh) -> ShardingRules:
         kv="tp" if "tp" in axes else None,
         vocab="tp" if "tp" in axes else None,
         expert="ep" if "ep" in axes else None,
+        # the stacked layer axis becomes the pipeline-stage axis
+        layers="pp" if "pp" in axes else None,
     )
 
 
