@@ -125,6 +125,8 @@ class WorkerHandle:
     # reacquired on 1->0 (threaded actors can block several methods at once).
     block_depth: int = 0
     runtime_env_key: Optional[str] = None
+    # wall time this worker last became idle (idle-pool reaping)
+    idle_since: float = 0.0
 
     def send(self, msg: dict) -> None:
         with self.send_lock:
@@ -142,6 +144,9 @@ class NodeState:
     starting: int = 0
     # in-flight spawns per runtime_env key (None = plain workers)
     starting_by_key: Dict[Optional[str], int] = field(default_factory=dict)
+    # consecutive pre-registration deaths per runtime_env key — a worker
+    # that cannot boot (bad env) must surface an error, not hang the task
+    spawn_failures: Dict[Optional[str], int] = field(default_factory=dict)
     # tasks whose resources are held, waiting for an idle worker
     ready_queue: deque = field(default_factory=deque)
     alive: bool = True
@@ -425,6 +430,34 @@ class Node:
     # ------------------------------------------------------------------
     # workers
     # ------------------------------------------------------------------
+    def _spawn_worker_process(
+        self,
+        ns: NodeState,
+        worker_id: bytes,
+        runtime_env: Optional[dict],
+        extra_env: Optional[Dict[str, str]] = None,
+    ) -> subprocess.Popen:
+        """Env assembly + Popen shared by pooled and dedicated actor workers.
+
+        User env_vars apply first so harness-critical vars always win (a
+        runtime_env can never clobber the worker's ability to boot and
+        register); a user PYTHONPATH is merged, not replaced.  Raises
+        OSError when the process cannot spawn (e.g. working_dir vanished)."""
+        env = dict(os.environ)
+        env.update(ns.env)
+        cwd = _apply_runtime_env(env, runtime_env)
+        env["RAY_TPU_ADDRESS"] = self.address
+        env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
+        env["RAY_TPU_NODE_ID"] = ns.node_id
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if extra_env:
+            env.update(extra_env)
+        env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
+        return subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker"], env=env, cwd=cwd
+        )
+
     def _spawn_worker(self, ns: NodeState, runtime_env: Optional[dict] = None) -> None:
         """Fork/exec a language worker (WorkerPool::StartWorkerProcess analog).
 
@@ -432,21 +465,18 @@ class Node:
         (env_vars + working_dir) and only ever serves tasks declaring the
         identical env."""
         worker_id = os.urandom(8)
-        env = dict(os.environ)
-        env.update(ns.env)
-        env["RAY_TPU_ADDRESS"] = self.address
-        env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
-        env["RAY_TPU_NODE_ID"] = ns.node_id
-        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
-        env["RAY_TPU_SESSION_DIR"] = self.session_dir
-        env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
-        cwd = _apply_runtime_env(env, runtime_env)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker"],
-            env=env,
-            cwd=cwd,
-        )
         key = _runtime_env_key(runtime_env)
+        try:
+            proc = self._spawn_worker_process(ns, worker_id, runtime_env)
+        except OSError as e:  # e.g. runtime_env working_dir doesn't exist
+            logger.warning("worker spawn failed for env %r: %s", key, e)
+            if key is not None:
+                # trip the env's circuit breaker; plain (key=None) workers
+                # keep retrying — a transient fork failure must not
+                # permanently poison the default pool
+                with self.lock:
+                    ns.spawn_failures[key] = ns.spawn_failures.get(key, 0) + 3
+            return
         h = WorkerHandle(worker_id=worker_id, node_id=ns.node_id, proc=proc,
                          runtime_env_key=key)
         self.workers[worker_id] = h
@@ -465,12 +495,14 @@ class Node:
             h.state = "idle"
             ns = self.nodes.get(h.node_id)
             if ns is not None:
-                ns.starting = max(0, ns.starting - 1)
-                k = h.runtime_env_key
-                ns.starting_by_key[k] = max(0, ns.starting_by_key.get(k, 0) - 1)
-                # Dedicated actor workers never join the general idle pool —
-                # they only ever run their actor's tasks.
+                # Dedicated actor workers never join the general idle pool
+                # and are not counted in the pool's spawn accounting.
                 if not h.is_actor_worker:
+                    ns.starting = max(0, ns.starting - 1)
+                    k = h.runtime_env_key
+                    ns.starting_by_key[k] = max(0, ns.starting_by_key.get(k, 0) - 1)
+                    ns.spawn_failures.pop(k, None)  # a successful boot resets
+                    h.idle_since = time.time()
                     ns.idle.append(h)
             self.cond.notify_all()
         return h
@@ -481,10 +513,21 @@ class Node:
         with self.lock:
             if h.state == "dead":
                 return
+            was_starting = h.state == "starting"
             h.state = "dead"
             ns = self.nodes.get(h.node_id)
             if ns and h in ns.idle:
                 ns.idle.remove(h)
+            if ns and was_starting and not h.is_actor_worker:
+                # died before registering: release the in-flight spawn slot
+                # and count the failure so a boot-looping runtime_env
+                # surfaces an error instead of deferring forever (plain
+                # workers retry indefinitely — see _spawn_worker)
+                ns.starting = max(0, ns.starting - 1)
+                k = h.runtime_env_key
+                ns.starting_by_key[k] = max(0, ns.starting_by_key.get(k, 0) - 1)
+                if k is not None:
+                    ns.spawn_failures[k] = ns.spawn_failures.get(k, 0) + 1
             spec = h.current_task
             h.current_task = None
         if self._shutdown:
@@ -715,9 +758,45 @@ class Node:
             with self.lock:
                 self.cond.wait(timeout=0.2)
             try:
+                self._sweep_workers()
                 self._schedule_once()
             except Exception:
                 logger.error("scheduler error:\n%s", traceback.format_exc())
+
+    def _sweep_workers(self) -> None:
+        """Detect pre-registration deaths and reap stale env-keyed idle
+        workers.
+
+        A worker that crashes before connecting has no connection whose
+        close would report it (the reference's WorkerPool learns this from
+        the process monitor); poll those procs here.  Env-keyed idle
+        workers only serve their exact runtime_env, so past the idle
+        threshold they are killed to return their pool slot."""
+        dead, reap = [], []
+        now = time.time()
+        with self.lock:
+            for w in self.workers.values():
+                if w.state == "starting" and w.proc is not None and w.proc.poll() is not None:
+                    dead.append(w)
+            thr = self.cfg.idle_worker_killing_time_threshold_s
+            for ns in self.nodes.values():
+                for w in list(ns.idle):
+                    if w.runtime_env_key is not None and now - w.idle_since > thr:
+                        reap.append(w)
+        for w in dead:
+            self._on_worker_death(
+                w, reason=f"exited with code {w.proc.returncode} before registering"
+            )
+        for w in reap:
+            self._kill_worker(w, reason="idle runtime_env worker reaped")
+
+    def _kill_worker(self, w: WorkerHandle, reason: str) -> None:
+        self._on_worker_death(w, reason=reason)
+        try:
+            if w.proc is not None:
+                w.proc.kill()
+        except Exception:
+            pass
 
     def _schedule_once(self) -> None:
         self._schedule_pgs()
@@ -754,6 +833,7 @@ class Node:
             self.pending_tasks = still_pending
         for spec, e in failed_specs:
             self._seal_error_returns(spec, e)
+        env_failed: List[Tuple[dict, Optional[str]]] = []
         with self.lock:
             # phase 2: dispatch ready tasks to idle workers whose runtime_env
             # matches; spawn env-keyed workers for the rest
@@ -785,6 +865,8 @@ class Node:
                         need_by_key[key] = need_by_key.get(key, 0) + 1
                         env_by_key.setdefault(key, spec.get("runtime_env"))
                     for key, need in need_by_key.items():
+                        if ns.spawn_failures.get(key, 0) >= 3:
+                            continue  # boot-looping env; failed below
                         starting = ns.starting_by_key.get(key, 0)
                         while (
                             need > starting
@@ -794,8 +876,37 @@ class Node:
                             self._spawn_worker(ns, runtime_env=env_by_key[key])
                             starting += 1
                             n_workers += 1
-                    for spec, tpu_ids, bundle, _ in deferred:
-                        ns.ready_queue.append((spec, tpu_ids, bundle))
+                        if need > starting and n_workers + ns.starting >= max(1, cap):
+                            # at the worker cap: evict an idle worker whose
+                            # env can't serve any queued task so this env
+                            # gets a slot (env-keyed pooling stays live)
+                            victim = next(
+                                (w for w in ns.idle if w.runtime_env_key not in need_by_key),
+                                None,
+                            )
+                            if victim is not None:
+                                self._kill_worker(victim, reason="evicted for new runtime_env")
+                                n_workers -= 1
+                                self._spawn_worker(ns, runtime_env=env_by_key[key])
+                                n_workers += 1
+                    for spec, tpu_ids, bundle, key in deferred:
+                        if ns.spawn_failures.get(key, 0) >= 3:
+                            # release the resources phase 1 acquired; the
+                            # error is sealed below, outside the lock
+                            pool = bundle.available if bundle is not None else ns.available
+                            _release(spec.get("resources", {}), pool)
+                            ns.tpu_free.extend(tpu_ids)
+                            env_failed.append((spec, key))
+                        else:
+                            ns.ready_queue.append((spec, tpu_ids, bundle))
+        for spec, key in env_failed:
+            self._seal_error_returns(
+                spec,
+                RuntimeError(
+                    f"runtime_env setup failed: workers for env {key!r} died "
+                    f"3 times before registering (bad env_vars/working_dir?)"
+                ),
+            )
 
     def _dispatch(self, ns: NodeState, w: WorkerHandle, spec: dict, tpu_ids: List[int], bundle) -> None:
         w.state = "busy"
@@ -872,6 +983,7 @@ class Node:
                 w.state = "idle"
                 ns = self.nodes.get(w.node_id)
                 if ns and ns.alive:
+                    w.idle_since = time.time()
                     ns.idle.append(w)
             if w.is_actor_worker and w.actor_id in self.actors:
                 art = self.actors[w.actor_id]
@@ -900,6 +1012,7 @@ class Node:
             self.cond.notify_all()
 
     def _schedule_actor_creations_and_tasks(self) -> None:
+        spawn_failed: List[Tuple[ActorRuntime, List[dict], Exception]] = []
         with self.lock:
             for art in list(self.actors.values()):
                 info = art.info
@@ -921,33 +1034,55 @@ class Node:
                     art.tpu_ids = [ns.tpu_free.pop() for _ in range(min(n_tpu, len(ns.tpu_free)))]
                     # dedicated worker for the actor
                     worker_id = os.urandom(8)
-                    env = dict(os.environ)
-                    env.update(ns.env)
-                    env["RAY_TPU_ADDRESS"] = self.address
-                    env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
-                    env["RAY_TPU_NODE_ID"] = ns.node_id
-                    env["RAY_TPU_WORKER_ID"] = worker_id.hex()
-                    env["RAY_TPU_SESSION_DIR"] = self.session_dir
-                    env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
+                    extra_env: Dict[str, str] = {}
                     if art.tpu_ids:
-                        env["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in art.tpu_ids)
-                        env["RAY_TPU_ASSIGNED_TPUS"] = env["TPU_VISIBLE_CHIPS"]
+                        extra_env["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in art.tpu_ids)
+                        extra_env["RAY_TPU_ASSIGNED_TPUS"] = extra_env["TPU_VISIBLE_CHIPS"]
                     if art.max_concurrency > 1:
-                        env["RAY_TPU_MAX_CONCURRENCY"] = str(art.max_concurrency)
-                    cwd = _apply_runtime_env(env, spec.get("runtime_env"))
-                    proc = subprocess.Popen([sys.executable, "-m", "ray_tpu._private.worker"], env=env, cwd=cwd)
+                        extra_env["RAY_TPU_MAX_CONCURRENCY"] = str(art.max_concurrency)
+                    try:
+                        proc = self._spawn_worker_process(
+                            ns, worker_id, spec.get("runtime_env"), extra_env
+                        )
+                    except OSError as e:
+                        # cannot even fork (bad working_dir, fd/memory
+                        # pressure): give the resources back and fail the
+                        # actor — re-acquiring every pass would drain the
+                        # node's availability with nothing to show for it
+                        _release(art.held, pool)
+                        ns.tpu_free.extend(art.tpu_ids)
+                        art.held = {}
+                        art.tpu_ids = []
+                        info.state = "DEAD"
+                        info.death_cause = f"worker spawn failed: {e}"
+                        failed = list(art.queue)
+                        art.queue.clear()
+                        spawn_failed.append((art, failed, e))
+                        continue
                     h = WorkerHandle(
                         worker_id=worker_id,
                         node_id=ns.node_id,
                         proc=proc,
                         is_actor_worker=True,
                         actor_id=info.actor_id,
+                        runtime_env_key=_runtime_env_key(spec.get("runtime_env")),
                     )
                     self.workers[worker_id] = h
                     art.worker = h
                     info.node_id = ns.node_id
                     info.worker_id = worker_id
                     info.state = "CREATING"
+        if spawn_failed:
+            from ray_tpu.exceptions import RayActorError
+
+            for art, failed, e in spawn_failed:
+                err = RayActorError(
+                    f"Actor {art.info.class_name} worker failed to spawn: {e}"
+                )
+                self._seal_error_returns(art.info.creation_spec, err)
+                for s in failed:
+                    self._seal_error_returns(s, err)
+        with self.lock:
             # dispatch actor creation + method calls to registered actor workers
             for art in list(self.actors.values()):
                 w = art.worker
@@ -1028,12 +1163,17 @@ class Node:
             failed_specs = list(art.inflight.values())
             art.inflight.clear()
             art.worker = None
-            # release resources
+            # release resources (skip CPUs a blocked method already gave
+            # back through _on_blocked, or the pool double-counts them)
             ns = self.nodes.get(art.node_id) if art.node_id else None
             if ns is not None and art.held:
                 bundle = getattr(art, "bundle", None)
                 pool = bundle.available if bundle is not None and not bundle.detached else ns.available
-                _release(art.held, pool)
+                held = dict(art.held)
+                if w.block_depth > 0:
+                    held[CPU] = 0.0
+                    w.block_depth = 0
+                _release(held, pool)
                 ns.tpu_free.extend(art.tpu_ids)
                 art.held = {}
                 art.tpu_ids = []

@@ -335,6 +335,24 @@ async def _ensure_coro(awaitable):
     return await awaitable
 
 
+_completion_pool = None
+_completion_pool_lock = threading.Lock()
+
+
+def _completion_executor():
+    """Single side thread that seals async-method results so the event loop
+    never blocks on serialization/shm writes."""
+    global _completion_pool
+    with _completion_pool_lock:
+        if _completion_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            _completion_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="async-complete"
+            )
+        return _completion_pool
+
+
 def _resolve_args(spec: dict, dep_locs: Dict[bytes, ObjectLocation]) -> Tuple[tuple, dict]:
     if spec.get("args_oid"):
         conv_args, conv_kwargs = read_value(dep_locs[spec["args_oid"]])
@@ -394,13 +412,36 @@ def _execute_task(msg: dict) -> None:
             try:
                 out = method(*args, **kwargs)
                 if inspect.isawaitable(out):
-                    # async actor method: run on the worker's persistent event
-                    # loop so N awaited calls interleave (fiber.h / asyncio
-                    # concurrency-group analog); this thread parks on the
-                    # future while the loop multiplexes all in-flight methods
-                    out = asyncio.run_coroutine_threadsafe(
+                    # async actor method: hand the coroutine to the worker's
+                    # persistent event loop and finish via callback (fiber.h
+                    # / asyncio concurrency-group analog).  No thread parks
+                    # on the result, so in-flight concurrency is bounded by
+                    # the loop, not the executor pool — 1000 awaiting calls
+                    # cost 1000 loop tasks, not 1000 threads.
+                    fut = asyncio.run_coroutine_threadsafe(
                         _ensure_coro(out), _get_async_loop()
-                    ).result()
+                    )
+
+                    def _complete(f, spec=spec):
+                        # runs on the loop thread: compute the outcome only,
+                        # then seal on a side thread — result serialization
+                        # must never stall the other in-flight coroutines
+                        try:
+                            res = _split_returns(f.result(), spec["num_returns"])
+                            failed_, err_str = False, None
+                        except BaseException as e:  # noqa: BLE001
+                            tb = traceback.format_exc()
+                            err = e if isinstance(e, RayTaskError) else RayTaskError(
+                                f"Task {spec.get('name')} failed:\n{tb}", cause=e
+                            )
+                            res = [err] * spec["num_returns"]
+                            failed_, err_str = True, f"{type(e).__name__}: {e}"
+                        _completion_executor().submit(
+                            _seal_and_report, w, spec, res, failed_, err_str
+                        )
+
+                    fut.add_done_callback(_complete)
+                    return
             finally:
                 w.task_depth -= 1
             results = _split_returns(out, spec["num_returns"])
@@ -424,6 +465,16 @@ def _execute_task(msg: dict) -> None:
             f"Task {spec.get('name')} failed:\n{tb}", cause=e
         )
         results = [err] * spec["num_returns"]
+    _seal_and_report(w, spec, results, failed, error_str)
+
+
+def _seal_and_report(w, spec: dict, results: List[Any], failed: bool,
+                     error_str: Optional[str]) -> None:
+    """Seal the return objects and tell the head the task finished.  Runs on
+    the executing thread for sync tasks and on the event-loop thread (via
+    add_done_callback) for async actor methods."""
+    from ray_tpu.exceptions import RayTaskError
+
     for oid, value in zip(spec["return_ids"], results):
         ref = ObjectRef(oid)
         try:
@@ -483,8 +534,11 @@ def main() -> None:
     if max_concurrency > 1:
         from concurrent.futures import ThreadPoolExecutor
 
+        # Threads are created lazily; async methods release their thread as
+        # soon as the coroutine is scheduled, so the pool only fills when
+        # the user runs that many *sync* methods concurrently.
         pool = ThreadPoolExecutor(
-            max_workers=min(max_concurrency, 64), thread_name_prefix="actor-exec"
+            max_workers=max_concurrency, thread_name_prefix="actor-exec"
         )
 
     while True:

@@ -113,3 +113,30 @@ def test_concurrent_gets_inside_threaded_actor(ray_start_regular):
         return "ok"
 
     assert ray_tpu.get(ping.remote(), timeout=60) == "ok"
+
+
+def test_async_waiters_beyond_old_thread_cap(ray_start_regular):
+    """99 awaiting methods + 1 releaser under max_concurrency=100: awaiting
+    methods must not park executor threads (the event loop multiplexes all
+    in-flight coroutines), or any thread-pool cap below max_concurrency
+    (the old hardcoded 64) deadlocks the releasing call forever."""
+    import asyncio
+
+    @ray_tpu.remote(max_concurrency=100)
+    class Gate:
+        def __init__(self):
+            self.event = asyncio.Event()
+
+        async def wait(self, i):
+            await self.event.wait()
+            return i
+
+        async def open(self):
+            self.event.set()
+            return "opened"
+
+    g = Gate.remote()
+    waiters = [g.wait.remote(i) for i in range(99)]
+    time.sleep(1.0)  # let the waiters dispatch & park on the event
+    assert ray_tpu.get(g.open.remote(), timeout=60) == "opened"
+    assert sorted(ray_tpu.get(waiters, timeout=120)) == list(range(99))

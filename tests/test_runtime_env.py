@@ -81,3 +81,61 @@ def test_missing_working_dir_errors(ray_start_regular):
         @ray_tpu.remote(runtime_env={"working_dir": "/nonexistent/dir/xyz"})
         def f():
             pass
+
+
+def test_user_pythonpath_merged_not_clobbered(ray_start_regular):
+    """A user PYTHONPATH must not break worker boot (merged, not replaced)."""
+    wd = tempfile.mkdtemp(prefix="rtpu_pp_")
+    with open(os.path.join(wd, "rtpu_pp_probe.py"), "w") as f:
+        f.write("VALUE = 'from-user-path'\n")
+
+    @ray_tpu.remote(runtime_env={"env_vars": {"PYTHONPATH": wd}})
+    def read():
+        import rtpu_pp_probe
+        return rtpu_pp_probe.VALUE
+
+    assert ray_tpu.get(read.remote(), timeout=120) == "from-user-path"
+
+
+def test_unspawnable_env_surfaces_error(ray_start_regular):
+    """A runtime_env whose worker cannot even spawn (working_dir deleted
+    after validation) must raise, not defer the task forever (the
+    spawn-failure circuit breaker)."""
+    import shutil
+
+    wd = tempfile.mkdtemp(prefix="rtpu_gone_")
+
+    @ray_tpu.remote(runtime_env={"working_dir": wd})
+    def f():
+        return 1
+
+    shutil.rmtree(wd)  # dies between validation and spawn
+    with pytest.raises(Exception, match="runtime_env|died|Worker"):
+        ray_tpu.get(f.remote(), timeout=120)
+
+
+def test_actor_unspawnable_env_surfaces_error(ray_start_regular):
+    """Actor whose dedicated worker cannot spawn must raise RayActorError on
+    its first method, with node resources returned (not re-acquired every
+    scheduler pass)."""
+    import shutil
+
+    wd = tempfile.mkdtemp(prefix="rtpu_agone_")
+
+    @ray_tpu.remote
+    class A:
+        def ping(self):
+            return "up"
+
+    handle = A.options(runtime_env={"working_dir": wd})
+    shutil.rmtree(wd)
+    a = handle.remote()
+    with pytest.raises(Exception, match="spawn|died|Actor"):
+        ray_tpu.get(a.ping.remote(), timeout=120)
+
+    # the node is not drained: plain tasks still run
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == 1
