@@ -280,11 +280,18 @@ def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[Obj
         blob = serialization.to_bytes(meta, buffers)
         return ObjectLocation(inline=blob, is_error=is_error), refs
     name = session_shm_name(ref.hex())
-    seg = ShmSegment.create(name, total)
+    # producer side writes through the fd (page-allocation path, ~2.4x the
+    # mmap-memcpy bandwidth on tmpfs); consumers still mmap zero-copy
+    path = ShmSegment.path_for(name)
+    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
     try:
-        serialization.write_into(seg.buf, meta, buffers)
-    finally:
-        seg.close()
+        written = serialization.write_to_fd(fd, meta, buffers)
+        assert written == total, f"wrote {written}, expected {total}"
+    except BaseException:
+        os.close(fd)
+        os.unlink(path)
+        raise
+    os.close(fd)
     return ObjectLocation(shm_name=name, size=total, is_error=is_error), refs
 
 

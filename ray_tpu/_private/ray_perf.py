@@ -1,0 +1,163 @@
+"""Core microbenchmark harness — the ``ray_perf.py`` analog.
+
+Mirrors the reference's microbenchmark surface
+(``/root/reference/python/ray/_private/ray_perf.py:93`` ``main`` — timed
+put/get, task and actor call throughput, run by
+``release/microbenchmark/run_microbenchmark.py``): these numbers are the
+core runtime's regression surface (BASELINE.md).  Run as a module to print
+one JSON object per metric and write ``BENCH_core.json`` at the repo root:
+
+    python -m ray_tpu._private.ray_perf [--quick]
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Callable, Dict, List
+
+import numpy as np
+
+import ray_tpu
+
+
+def timeit(name: str, fn: Callable[[], Any], multiplier: int = 1,
+           min_time_s: float = 1.0, results: List[Dict] | None = None) -> Dict:
+    """Run ``fn`` repeatedly for ~min_time_s; report ops/s (x multiplier)."""
+    fn()  # warmup
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time_s:
+        fn()
+        count += 1
+    dt = time.perf_counter() - start
+    rate = count * multiplier / dt
+    rec = {"metric": name, "value": round(rate, 2), "unit": "ops/s"}
+    print(json.dumps(rec), flush=True)
+    if results is not None:
+        results.append(rec)
+    return rec
+
+
+def main(quick: bool = False) -> List[Dict]:
+    """All core microbenchmarks on a local node.  ``quick`` shrinks the
+    large-object sizes and iteration floors for CI."""
+    results: List[Dict] = []
+    min_t = 0.3 if quick else 1.0
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        # -------------------------------------------------- put/get small
+        small = b"x" * 1024
+
+        def put_small():
+            ray_tpu.put(small)
+
+        timeit("put_small_1kb", put_small, min_time_s=min_t, results=results)
+
+        ref_small = ray_tpu.put(small)
+
+        def get_small():
+            ray_tpu.get(ref_small)
+
+        timeit("get_small_1kb", get_small, min_time_s=min_t, results=results)
+
+        # ------------------------------------------------- put/get large
+        mb = 64 if quick else 256
+        arr = np.random.default_rng(0).integers(0, 255, mb << 20, dtype=np.uint8)
+        t0 = time.perf_counter()
+        ref_big = ray_tpu.put(arr)
+        put_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = ray_tpu.get(ref_big)
+        get_dt = time.perf_counter() - t0
+        assert out.nbytes == arr.nbytes
+        del out
+        for name, dt in (("put", put_dt), ("get", get_dt)):
+            rec = {"metric": f"{name}_numpy_{mb}mb_gbps",
+                   "value": round(mb / 1024 / dt, 3), "unit": "GiB/s"}
+            print(json.dumps(rec), flush=True)
+            results.append(rec)
+        del ref_big
+
+        # -------------------------------------------------- tasks
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        # single in-flight round trip (scheduler+dispatch+seal latency)
+        def task_rt():
+            ray_tpu.get(noop.remote(), timeout=60)
+
+        timeit("task_round_trip", task_rt, min_time_s=min_t, results=results)
+
+        # pipelined wave (throughput with the pool warm)
+        wave = 20 if quick else 100
+
+        def task_wave():
+            ray_tpu.get([noop.remote() for _ in range(wave)], timeout=120)
+
+        timeit("task_throughput", task_wave, multiplier=wave,
+               min_time_s=min_t, results=results)
+
+        # -------------------------------------------------- actors
+        @ray_tpu.remote
+        class Echo:
+            def ping(self):
+                return None
+
+        a = Echo.remote()
+        ray_tpu.get(a.ping.remote(), timeout=60)
+
+        def actor_rt():
+            ray_tpu.get(a.ping.remote(), timeout=60)
+
+        timeit("actor_call_round_trip", actor_rt, min_time_s=min_t, results=results)
+
+        def actor_wave():
+            ray_tpu.get([a.ping.remote() for _ in range(wave)], timeout=120)
+
+        timeit("actor_call_throughput", actor_wave, multiplier=wave,
+               min_time_s=min_t, results=results)
+
+        # threaded actor: pipelined calls overlap worker-side
+        @ray_tpu.remote(max_concurrency=8)
+        class EchoMC:
+            def ping(self):
+                return None
+
+        mc = EchoMC.remote()
+        ray_tpu.get(mc.ping.remote(), timeout=60)
+
+        def actor_mc_wave():
+            ray_tpu.get([mc.ping.remote() for _ in range(wave)], timeout=120)
+
+        timeit("threaded_actor_call_throughput", actor_mc_wave, multiplier=wave,
+               min_time_s=min_t, results=results)
+
+        # -------------------------------------------------- wait
+        refs = [noop.remote() for _ in range(8)]
+        ray_tpu.get(refs, timeout=60)
+
+        def do_wait():
+            ray_tpu.wait(refs, num_returns=len(refs), timeout=60)
+
+        timeit("wait_8_ready", do_wait, min_time_s=min_t, results=results)
+    finally:
+        ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+        "BENCH_core.json"))
+    args = p.parse_args()
+    res = main(quick=args.quick)
+    with open(args.out, "w") as f:
+        json.dump({"benchmarks": res, "host": "single-node"}, f, indent=2)
+    print(f"wrote {args.out}")

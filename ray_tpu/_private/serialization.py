@@ -120,6 +120,37 @@ def write_into(dest: memoryview, meta: bytes, buffers: List[memoryview]) -> int:
     return off
 
 
+def write_to_fd(fd: int, meta: bytes, buffers: List[memoryview]) -> int:
+    """Write the wire layout straight to ``fd`` with ``os.write``.
+
+    On tmpfs this is ~2.4x faster than memcpy into a fresh mmap: the write
+    syscall allocates pages directly instead of zero-filling each page and
+    then faulting it in again for the copy.  Returns bytes written."""
+    import os
+
+    off = 0
+
+    def put(view) -> None:
+        nonlocal off
+        view = memoryview(view).cast("B")
+        while view.nbytes:
+            n = os.write(fd, view)
+            off += n
+            view = view[n:]
+
+    put(_HEADER.pack(len(meta)))
+    put(meta)
+    pad = _pad(len(meta)) - len(meta)  # matches write_into's layout
+    if pad:
+        put(b"\0" * pad)
+    for b in buffers:
+        put(b)
+        rem = _pad(b.nbytes) - b.nbytes
+        if rem:
+            put(b"\0" * rem)
+    return off
+
+
 def to_bytes(meta: bytes, buffers: List[memoryview]) -> bytes:
     out = bytearray(total_size(meta, buffers))
     write_into(memoryview(out), meta, buffers)

@@ -42,6 +42,10 @@ class ShmSegment:
             finally:
                 os.close(fd)
 
+    @staticmethod
+    def path_for(name: str) -> str:
+        return os.path.join(SHM_DIR, name)
+
     @classmethod
     def create(cls, name: str, size: int) -> "ShmSegment":
         return cls(name, size, create=True)

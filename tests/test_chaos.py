@@ -1,0 +1,69 @@
+"""Chaos tests: the runtime absorbs repeated worker SIGKILLs.
+
+Mirror of the reference's ``python/ray/tests/test_chaos.py`` (task retry
+under node kill ``:66``, actor retry ``:101``) built on the WorkerKiller
+(``ray_tpu/_private/test_utils.py``; reference ``NodeKillerActor``
+``test_utils.py:1301``).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.test_utils import WorkerKiller
+
+
+def test_tasks_survive_worker_kills(ray_start_regular):
+    """Slow tasks with retries complete correctly while busy workers are
+    SIGKILLed on an interval, and at least one kill actually happened."""
+
+    @ray_tpu.remote(max_retries=10)
+    def slow_square(i):
+        time.sleep(0.3)
+        return i * i
+
+    killer = WorkerKiller(interval_s=0.4, include_actor_workers=False, seed=0).start()
+    try:
+        refs = [slow_square.remote(i) for i in range(24)]
+        out = ray_tpu.get(refs, timeout=240)
+    finally:
+        killer.stop()
+    assert out == [i * i for i in range(24)]
+    assert killer.kills > 0, "chaos test never killed anything"
+
+
+def test_actor_restarts_under_kills(ray_start_regular):
+    """An actor with max_restarts=-1 keeps serving across repeated kills of
+    its dedicated worker."""
+
+    @ray_tpu.remote(max_restarts=-1)
+    class Echo:
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+    a = Echo.remote()
+    first_pid = ray_tpu.get(a.pid.remote(), timeout=120)
+
+    pids = {first_pid}
+    for _ in range(2):
+        # kill the actor's current worker out from under it
+        node = ray_tpu._private.worker.global_worker.node
+        with node.lock:
+            art = next(iter(node.actors.values()))
+            proc = art.worker.proc if art.worker else None
+        assert proc is not None
+        proc.kill()
+        # the restarted actor must serve again (retry while it restarts)
+        deadline = time.time() + 120
+        while True:
+            try:
+                pids.add(ray_tpu.get(a.pid.remote(), timeout=120))
+                break
+            except ray_tpu.exceptions.RayActorError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.5)
+    assert len(pids) == 3, f"expected 3 distinct worker pids, got {pids}"

@@ -1,0 +1,15 @@
+"""The ray_perf microbenchmark harness stays runnable (reference analog:
+release/microbenchmark/run_microbenchmark.py driving ray_perf.py)."""
+
+from ray_tpu._private.ray_perf import main
+
+
+def test_ray_perf_quick():
+    results = main(quick=True)
+    by_name = {r["metric"]: r["value"] for r in results}
+    assert len(results) >= 9
+    assert all(v > 0 for v in by_name.values())
+    # sanity floors: these ran thousands of ops/s in CI when written; a
+    # 10x regression should fail loudly
+    assert by_name["task_round_trip"] > 50
+    assert by_name["actor_call_round_trip"] > 100
