@@ -22,11 +22,18 @@ class CoreClient:
     def __init__(self, address: str, authkey: bytes, worker_id: Optional[bytes] = None, node_id: str = ""):
         from multiprocessing import AuthenticationError
 
+        # Address is a unix-socket path or "tcp://host:port" (remote
+        # workers joining the head's TCP control plane).
+        if isinstance(address, str) and address.startswith("tcp://"):
+            host, _, port = address[len("tcp://"):].rpartition(":")
+            target, family = (host, int(port)), "AF_INET"
+        else:
+            target, family = address, "AF_UNIX"
         # The handshake occasionally loses a challenge race when several
         # processes connect at once — retry, it is not a credentials problem.
         for attempt in range(5):
             try:
-                self.conn = MPClient(address, family="AF_UNIX", authkey=authkey)
+                self.conn = MPClient(target, family=family, authkey=authkey)
                 break
             except (AuthenticationError, OSError, EOFError):
                 if attempt == 4:

@@ -150,6 +150,18 @@ class NodeState:
     # tasks whose resources are held, waiting for an idle worker
     ready_queue: deque = field(default_factory=deque)
     alive: bool = True
+    # Real remote node (joined via node_agent): control connection to the
+    # agent and the address of its object server.  None/"" = emulated or
+    # head-local node.
+    agent_conn: Optional[Connection] = None
+    agent_send_lock: Optional[threading.Lock] = None
+    fetch_addr: Optional[tuple] = None
+
+    def agent_send(self, msg: dict) -> None:
+        if self.agent_conn is None:
+            raise OSError("node has no agent connection")
+        with self.agent_send_lock:
+            self.agent_conn.send(msg)
 
     def utilization(self) -> float:
         fracs = []
@@ -260,8 +272,28 @@ class Node:
 
         self._conn_locks: Dict[int, threading.Lock] = {}
         self._listener = Listener(self.address, family="AF_UNIX", authkey=self.authkey, backlog=64)
-        self._threads: List[threading.Thread] = []
+        # TCP control plane: real nodes (node_agent) and their workers join
+        # here — the gRPC server of the reference's GCS/raylet (SURVEY §5.8).
+        host = os.environ.get("RAY_TPU_HOST", "127.0.0.1")
+        self._tcp_listener = Listener((host, 0), family="AF_INET",
+                                      authkey=self.authkey, backlog=64)
+        self.tcp_address: tuple = self._tcp_listener.address
+        # Object-transfer plane: every node serves pulls of its local shm
+        # segments (ObjectManager analog).
+        from ray_tpu._private import object_transfer
+
+        object_transfer.configure(self.authkey)
+        self.object_server = object_transfer.ObjectServer(host, self.authkey)
+        self.nodes[self._head_node_id].fetch_addr = tuple(self.object_server.addr)
+        self.registry.broadcast_unlink = self._broadcast_unlink
+        self._threads = []
         t = threading.Thread(target=self._accept_loop, name="accept", daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(
+            target=self._accept_loop, args=(self._tcp_listener,),
+            name="accept-tcp", daemon=True,
+        )
         t.start()
         self._threads.append(t)
         t = threading.Thread(target=self._scheduler_loop, name="scheduler", daemon=True)
@@ -304,13 +336,25 @@ class Node:
             if ns is None:
                 return
             ns.alive = False
+            ns.agent_conn = None
             if node_id in self.gcs.nodes:
                 self.gcs.nodes[node_id].alive = False
+            # tasks staged on the dead node (resources held, waiting for a
+            # worker) go back to the cluster-wide pending queue — their
+            # held resources died with the node
+            staged = list(ns.ready_queue)
+            ns.ready_queue.clear()
+            for spec, _tpu_ids, _bundle in staged:
+                self.pending_tasks.append(spec)
             victims = [w for w in self.workers.values() if w.node_id == node_id and w.state != "dead"]
         for w in victims:
             try:
                 if w.proc:
                     w.proc.kill()
+                elif w.conn is not None:
+                    # remote worker orphaned by its agent's death: tell it
+                    # to exit (we cannot signal a process on another host)
+                    w.send({"type": "exit"})
             except Exception:
                 pass
             self._on_worker_death(w, reason=f"node {node_id} removed")
@@ -320,13 +364,14 @@ class Node:
     # ------------------------------------------------------------------
     # connection handling
     # ------------------------------------------------------------------
-    def _accept_loop(self) -> None:
+    def _accept_loop(self, listener: Optional[Listener] = None) -> None:
         from multiprocessing import AuthenticationError
 
+        listener = listener or self._listener
         failures = 0
         while not self._shutdown:
             try:
-                conn = self._listener.accept()
+                conn = listener.accept()
                 failures = 0
             except (AuthenticationError, OSError, EOFError):
                 # one peer dying mid-handshake (EOF/reset) or failing auth
@@ -344,6 +389,7 @@ class Node:
 
     def _reader_loop(self, conn: Connection) -> None:
         handle: Optional[WorkerHandle] = None
+        agent_node_id: Optional[str] = None
         is_client = False
         with self.lock:
             self._conn_locks[id(conn)] = threading.Lock()
@@ -358,13 +404,56 @@ class Node:
                     handle = self._on_register_worker(conn, msg)
                 elif mtype == "register_client":
                     is_client = True  # driver or external client connection
+                elif mtype == "register_node":
+                    agent_node_id = self._on_register_node(conn, msg)
+                elif mtype == "worker_exited":
+                    self._on_remote_worker_exited(msg)
+                elif mtype == "pong":
+                    pass
                 else:
                     self._handle_message(conn, handle, msg)
         finally:
             if handle is not None:
                 self._on_worker_death(handle, reason="connection closed")
+            elif agent_node_id is not None:
+                with self.lock:
+                    ns = self.nodes.get(agent_node_id)
+                    stale = ns is None or ns.agent_conn is not conn
+                if stale:
+                    # a newer incarnation of this node re-registered while
+                    # this connection lingered; don't kill the replacement
+                    pass
+                else:
+                    logger.warning("node %s lost (agent connection closed)", agent_node_id)
+                    self.remove_node_state(agent_node_id)
             elif is_client:
                 pass
+
+    def _on_register_node(self, conn: Connection, msg: dict) -> str:
+        """A node_agent joined over TCP (the raylet-registers-with-GCS path,
+        ``GcsNodeManager`` analog)."""
+        node_id = msg["node_id"]
+        self.add_node_state(node_id, msg["resources"], msg.get("tpu_ids"))
+        with self.lock:
+            ns = self.nodes[node_id]
+            ns.agent_conn = conn
+            ns.agent_send_lock = self._conn_lock(conn)
+            ns.fetch_addr = tuple(msg["fetch_addr"]) if msg.get("fetch_addr") else None
+            self.cond.notify_all()
+        logger.info("node %s joined with %s", node_id, msg["resources"])
+        return node_id
+
+    def _on_remote_worker_exited(self, msg: dict) -> None:
+        wid = bytes.fromhex(msg["worker_id"])
+        with self.lock:
+            h = self.workers.get(wid)
+        if h is not None and h.state != "dead":
+            rc = msg.get("returncode")
+            extra = f" ({msg['error']})" if msg.get("error") else ""
+            self._on_worker_death(
+                h, reason=f"exited with code {rc}{extra}"
+                          + ("" if h.conn else " before registering")
+            )
 
     def _conn_lock(self, conn: Connection) -> threading.Lock:
         with self.lock:
@@ -382,7 +471,8 @@ class Node:
         if mtype == "submit_task":
             self.submit_task(msg["spec"])
         elif mtype == "seal":
-            self.seal_object(msg["oid"], msg["loc"], msg.get("contained", []))
+            self.seal_object(msg["oid"], msg["loc"], msg.get("contained", []),
+                             sealer=worker)
         elif mtype == "get_locations":
             self._on_get_request(conn, msg, worker)
         elif mtype == "wait":
@@ -458,22 +548,58 @@ class Node:
             [sys.executable, "-m", "ray_tpu._private.worker"], env=env, cwd=cwd
         )
 
+    def _spawn_on_node(
+        self,
+        ns: NodeState,
+        worker_id: bytes,
+        runtime_env: Optional[dict],
+        extra_env: Optional[Dict[str, str]] = None,
+    ) -> Optional[subprocess.Popen]:
+        """Spawn a worker locally or delegate to the node's agent.  Returns
+        the Popen for local spawns, None for remote ones.  Raises OSError
+        when the spawn cannot happen on either path."""
+        if ns.agent_conn is not None:
+            env, cwd = self._remote_env_overrides(worker_id, runtime_env, extra_env)
+            ns.agent_send({"type": "spawn_worker", "worker_id": worker_id.hex(),
+                           "env_overrides": env, "cwd": cwd})
+            return None
+        return self._spawn_worker_process(ns, worker_id, runtime_env, extra_env)
+
+    def _remote_env_overrides(
+        self, worker_id: bytes, runtime_env: Optional[dict],
+        extra_env: Optional[Dict[str, str]] = None,
+    ) -> Tuple[Dict[str, str], Optional[str]]:
+        """Env overrides shipped to a node agent for a remote worker spawn.
+        User env_vars first; harness vars after so they always win (the
+        agent merges over its own os.environ and fixes node identity)."""
+        env: Dict[str, str] = {}
+        cwd = _apply_runtime_env(env, runtime_env)
+        env["RAY_TPU_ADDRESS"] = f"tcp://{self.tcp_address[0]}:{self.tcp_address[1]}"
+        env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
+        env["RAY_TPU_WORKER_ID"] = worker_id.hex()
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        if extra_env:
+            env.update(extra_env)
+        return env, cwd
+
     def _spawn_worker(self, ns: NodeState, runtime_env: Optional[dict] = None) -> None:
         """Fork/exec a language worker (WorkerPool::StartWorkerProcess analog).
 
         With a runtime_env, the worker is spawned inside that environment
         (env_vars + working_dir) and only ever serves tasks declaring the
-        identical env."""
+        identical env.  On a remote node the spawn is delegated to its
+        agent (the worker still connects straight back to the head)."""
         worker_id = os.urandom(8)
         key = _runtime_env_key(runtime_env)
         try:
-            proc = self._spawn_worker_process(ns, worker_id, runtime_env)
-        except OSError as e:  # e.g. runtime_env working_dir doesn't exist
+            proc = self._spawn_on_node(ns, worker_id, runtime_env)
+        except (OSError, ValueError) as e:
             logger.warning("worker spawn failed for env %r: %s", key, e)
-            if key is not None:
+            if ns.agent_conn is None and key is not None:
                 # trip the env's circuit breaker; plain (key=None) workers
                 # keep retrying — a transient fork failure must not
-                # permanently poison the default pool
+                # permanently poison the default pool (agent-side spawn
+                # failures come back as worker_exited messages instead)
                 with self.lock:
                     ns.spawn_failures[key] = ns.spawn_failures.get(key, 0) + 3
             return
@@ -596,7 +722,18 @@ class Node:
     # ------------------------------------------------------------------
     # objects
     # ------------------------------------------------------------------
-    def seal_object(self, oid: bytes, loc: ObjectLocation, contained: List[bytes]) -> None:
+    def seal_object(
+        self, oid: bytes, loc: ObjectLocation, contained: List[bytes],
+        sealer: Optional[WorkerHandle] = None,
+    ) -> None:
+        # annotate the location with its node + object-server address so
+        # any consumer anywhere can attach-or-pull ("" = head node)
+        if loc.shm_name:
+            node_id = sealer.node_id if sealer else self._head_node_id
+            loc.node_id = "" if node_id == self._head_node_id else node_id
+            with self.lock:
+                ns = self.nodes.get(node_id)
+            loc.fetch_addr = tuple(ns.fetch_addr) if ns and ns.fetch_addr else None
         # contained refs are counted (and remembered for cascade-decrement
         # when this object dies) inside the registry
         self.registry.seal(oid, loc, contained)
@@ -795,8 +932,26 @@ class Node:
         try:
             if w.proc is not None:
                 w.proc.kill()
+            else:
+                with self.lock:
+                    ns = self.nodes.get(w.node_id)
+                if ns is not None and ns.agent_conn is not None:
+                    ns.agent_send({"type": "kill_worker",
+                                   "worker_id": w.worker_id.hex()})
         except Exception:
             pass
+
+    def _broadcast_unlink(self, shm_name: str) -> None:
+        """Registry callback: a deleted object's segment (origin or pulled
+        replica) may live on any node — tell every agent to unlink."""
+        with self.lock:
+            agents = [ns for ns in self.nodes.values()
+                      if ns.alive and ns.agent_conn is not None]
+        for ns in agents:
+            try:
+                ns.agent_send({"type": "unlink", "name": shm_name})
+            except (OSError, ValueError):
+                pass
 
     def _schedule_once(self) -> None:
         self._schedule_pgs()
@@ -1041,10 +1196,10 @@ class Node:
                     if art.max_concurrency > 1:
                         extra_env["RAY_TPU_MAX_CONCURRENCY"] = str(art.max_concurrency)
                     try:
-                        proc = self._spawn_worker_process(
+                        proc = self._spawn_on_node(
                             ns, worker_id, spec.get("runtime_env"), extra_env
                         )
-                    except OSError as e:
+                    except (OSError, ValueError) as e:
                         # cannot even fork (bad working_dir, fd/memory
                         # pressure): give the resources back and fail the
                         # actor — re-acquiring every pass would drain the
@@ -1383,10 +1538,28 @@ class Node:
                         w.proc.kill()
                     except Exception:
                         pass
+        with self.lock:
+            agents = [ns for ns in self.nodes.values() if ns.agent_conn is not None]
+        for ns in agents:
+            try:
+                ns.agent_send({"type": "shutdown"})
+            except Exception:
+                pass
         try:
             self._listener.close()
         except Exception:
             pass
+        try:
+            self._tcp_listener.close()
+        except Exception:
+            pass
+        try:
+            self.object_server.close()
+        except Exception:
+            pass
+        from ray_tpu._private import object_transfer
+
+        object_transfer.reset()
         self.registry.shutdown()
         from ray_tpu._private import shm as shm_mod
 

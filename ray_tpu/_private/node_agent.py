@@ -1,0 +1,239 @@
+"""Per-node agent: joins a running cluster over TCP and manages this host.
+
+The raylet-join path of the reference (``ray start --address=<head>``:
+``python/ray/_private/services.py:1273`` launches a raylet that registers
+with the GCS and serves its node): the agent
+
+- registers a real ``NodeState`` with the head (resources + TPU chips),
+- spawns/kills worker processes on THIS host when the head asks (the
+  workers connect straight back to the head's TCP control plane),
+- serves object pulls from this node's private shm namespace through an
+  :class:`~ray_tpu._private.object_transfer.ObjectServer`,
+- reports pre-registration worker deaths (the head cannot poll a remote
+  process), and
+- unlinks local segments when the head evicts them.
+
+Run via ``python -m ray_tpu._private.node_agent --address host:port
+--authkey <hex>`` or through ``ray_tpu start`` / ``cluster_utils.Cluster``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from multiprocessing.connection import Client as MPClient
+from typing import Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def _worker_pythonpath(existing: str) -> str:
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    parts = [pkg_root]
+    if existing:
+        parts.append(existing)
+    return os.pathsep.join(parts)
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        address: str,
+        authkey: bytes,
+        num_cpus: Optional[int] = None,
+        num_tpus: Optional[int] = None,
+        resources: Optional[Dict[str, float]] = None,
+        node_id: Optional[str] = None,
+        shm_dir: Optional[str] = None,
+        host: str = "127.0.0.1",
+    ):
+        from ray_tpu._private import shm as shm_mod
+        from ray_tpu._private.object_transfer import ObjectServer, configure
+        from ray_tpu._private.resource_spec import autodetect_resources
+
+        self.node_id = node_id or f"node-{os.urandom(4).hex()}"
+        self.authkey = authkey
+        host_s, port_s = address.rsplit(":", 1)
+        self.head_addr = (host_s, int(port_s))
+
+        # Private shm namespace for this node: own directory (when given)
+        # and own session id, so same-host siblings can't short-circuit the
+        # object-transfer plane by attaching each other's segments.
+        if shm_dir:
+            os.makedirs(shm_dir, exist_ok=True)
+            os.environ[shm_mod._SHM_DIR_ENV] = shm_dir
+        session = os.environ.get(shm_mod._SESSION_ENV, "nosession")
+        self.session = f"{session}{self.node_id.replace('-', '')[-6:]}"
+        os.environ[shm_mod._SESSION_ENV] = self.session
+        shm_mod.sweep_orphaned_segments()
+        shm_mod.write_session_marker(self.session, os.getpid())
+
+        configure(authkey)
+        self.object_server = ObjectServer(host, authkey)
+
+        total, tpu_ids = autodetect_resources(num_cpus, num_tpus, resources)
+        self.procs: Dict[str, subprocess.Popen] = {}  # worker_id hex -> proc
+        self._lock = threading.Lock()
+        self._shutdown = False
+
+        self.conn = MPClient(self.head_addr, family="AF_INET", authkey=authkey)
+        self._send_lock = threading.Lock()
+        self._send({
+            "type": "register_node",
+            "node_id": self.node_id,
+            "resources": total,
+            "tpu_ids": tpu_ids,
+            "fetch_addr": tuple(self.object_server.addr),
+        })
+
+        self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
+                                         name="agent-monitor")
+        self._monitor.start()
+
+    # -- plumbing ---------------------------------------------------------
+    def _send(self, msg: dict) -> None:
+        with self._send_lock:
+            self.conn.send(msg)
+
+    # -- head message loop ------------------------------------------------
+    def serve_forever(self) -> None:
+        logger.info("node agent %s joined %s (object server %s)",
+                    self.node_id, self.head_addr, self.object_server.addr)
+        try:
+            while not self._shutdown:
+                try:
+                    msg = self.conn.recv()
+                except (EOFError, OSError):
+                    logger.warning("head connection lost; shutting down node")
+                    break
+                try:
+                    self._handle(msg)
+                except Exception:
+                    logger.exception("agent error handling %s", msg.get("type"))
+        finally:
+            self.shutdown()
+
+    def _handle(self, msg: dict) -> None:
+        mtype = msg["type"]
+        if mtype == "spawn_worker":
+            self._spawn_worker(msg)
+        elif mtype == "kill_worker":
+            self._kill_worker(msg["worker_id"])
+        elif mtype == "unlink":
+            from ray_tpu._private.shm import ShmSegment
+
+            ShmSegment.unlink(msg["name"])
+        elif mtype == "shutdown":
+            self._shutdown = True
+        elif mtype == "ping":
+            self._send({"type": "pong", "ts": msg.get("ts")})
+        else:
+            logger.warning("agent: unknown message %s", mtype)
+
+    # -- worker management ------------------------------------------------
+    def _spawn_worker(self, msg: dict) -> None:
+        env = dict(os.environ)
+        env.update(msg.get("env_overrides") or {})
+        # this node's namespace must win over anything inherited/overridden
+        from ray_tpu._private import shm as shm_mod
+
+        env[shm_mod._SESSION_ENV] = self.session
+        if os.environ.get(shm_mod._SHM_DIR_ENV):
+            env[shm_mod._SHM_DIR_ENV] = os.environ[shm_mod._SHM_DIR_ENV]
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
+        cwd = msg.get("cwd")
+        wid = msg["worker_id"]
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.worker"], env=env, cwd=cwd
+            )
+        except OSError as e:
+            self._send({"type": "worker_exited", "worker_id": wid,
+                        "returncode": -1, "error": str(e)})
+            return
+        with self._lock:
+            self.procs[wid] = proc
+
+    def _kill_worker(self, worker_id: str) -> None:
+        with self._lock:
+            proc = self.procs.pop(worker_id, None)
+        if proc is not None:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+
+    def _monitor_loop(self) -> None:
+        """Report worker processes that die (the head polls local procs
+        itself; remote ones are invisible to it)."""
+        while not self._shutdown:
+            time.sleep(0.2)
+            dead = []
+            with self._lock:
+                for wid, proc in list(self.procs.items()):
+                    rc = proc.poll()
+                    if rc is not None:
+                        dead.append((wid, rc))
+                        del self.procs[wid]
+            for wid, rc in dead:
+                try:
+                    self._send({"type": "worker_exited", "worker_id": wid,
+                                "returncode": rc})
+                except (OSError, ValueError):
+                    return
+
+    def shutdown(self) -> None:
+        from ray_tpu._private import shm as shm_mod
+
+        self._shutdown = True
+        with self._lock:
+            procs = list(self.procs.values())
+            self.procs.clear()
+        for p in procs:
+            try:
+                p.kill()
+            except Exception:
+                pass
+        self.object_server.close()
+        try:
+            self.conn.close()
+        except Exception:
+            pass
+        # reclaim this node's namespace
+        shm_mod.remove_session_marker(self.session)
+        shm_mod.sweep_orphaned_segments()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="ray_tpu node agent")
+    p.add_argument("--address", required=True, help="head host:port")
+    p.add_argument("--authkey", default=None, help="cluster authkey (hex); "
+                   "defaults to $RAY_TPU_AUTHKEY")
+    p.add_argument("--num-cpus", type=int, default=None)
+    p.add_argument("--num-tpus", type=int, default=None)
+    p.add_argument("--resources", default=None,
+                   help='extra custom resources as JSON, e.g. \'{"special": 1}\'')
+    p.add_argument("--node-id", default=None)
+    p.add_argument("--shm-dir", default=None)
+    args = p.parse_args()
+    authkey = bytes.fromhex(args.authkey or os.environ["RAY_TPU_AUTHKEY"])
+    logging.basicConfig(level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"))
+    import json
+
+    agent = NodeAgent(
+        args.address, authkey,
+        num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+        resources=json.loads(args.resources) if args.resources else None,
+        node_id=args.node_id, shm_dir=args.shm_dir,
+    )
+    agent.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,11 @@ class ObjectLocation:
     size: int = 0
     # Serialized error objects raise on get (RayTaskError analog).
     is_error: bool = False
+    # Which cluster node holds the shm segment ("" = head) and that node's
+    # object-server address — consumers on other nodes pull through it
+    # (the head fills fetch_addr when serving locations cross-node).
+    node_id: str = ""
+    fetch_addr: Optional[tuple] = None
 
     def __post_init__(self):
         if self.inline is not None:
@@ -78,10 +83,15 @@ class ObjectRegistry:
                  spill_dir: Optional[str] = None):
         self._lock = threading.Lock()
         self._objects: Dict[bytes, _Entry] = {}
-        self._bytes_used = 0  # shm bytes only (spilled/inline don't count)
+        self._bytes_used = 0  # head-local shm bytes (spilled/inline/remote don't count)
         self._capacity = capacity_bytes
         self._spill_dir = spill_dir
         self._num_spilled = 0
+        # set by the head Node: shm_name -> ask every node agent to unlink.
+        # Any node may hold the origin segment OR a pulled replica, so
+        # deletion broadcasts (the head's own copy/replica is unlinked
+        # locally either way).
+        self.broadcast_unlink = None
 
     # -- creation / sealing --------------------------------------------
     def create_pending(self, oid: bytes) -> None:
@@ -108,7 +118,7 @@ class ObjectRegistry:
                     ce = self._objects.get(c)
                     if ce is not None:
                         ce.ref_count += 1
-                if loc.shm_name:
+                if loc.shm_name and not loc.node_id:
                     self._bytes_used += loc.size
             e.sealed.set()
             if e.ref_count <= 0:
@@ -116,7 +126,7 @@ class ObjectRegistry:
                 # forget): reclaim immediately
                 self._delete_locked(oid, e, dead)
         if unlink:
-            ShmSegment.unlink(unlink)
+            self._reap([("shm", unlink)])
         self._reap(dead)
         self._maybe_spill()
 
@@ -170,15 +180,15 @@ class ObjectRegistry:
         if e.loc is not None:
             if e.loc.shm_name:
                 dead.append(("shm", e.loc.shm_name))
-                self._bytes_used -= e.loc.size
+                if not e.loc.node_id:
+                    self._bytes_used -= e.loc.size
             elif e.loc.spilled_path:
                 dead.append(("file", e.loc.spilled_path))
         del self._objects[oid]
         for c in e.contained:
             self._remove_ref_locked(c, 1, dead)
 
-    @staticmethod
-    def _reap(dead: List[tuple]) -> None:
+    def _reap(self, dead: List[tuple]) -> None:
         for kind, name in dead:
             if kind == "file":
                 try:
@@ -186,7 +196,10 @@ class ObjectRegistry:
                 except OSError:
                     pass
             else:
+                # origin copy or pulled replica in this process's namespace
                 ShmSegment.unlink(name)
+                if self.broadcast_unlink is not None:
+                    self.broadcast_unlink(name)
 
     # -- capacity / spilling -------------------------------------------
     def _maybe_spill(self) -> None:
@@ -204,6 +217,7 @@ class ObjectRegistry:
                     (e.last_access, oid, e)
                     for oid, e in self._objects.items()
                     if e.sealed.is_set() and e.loc is not None and e.loc.shm_name
+                    and not e.loc.node_id  # remote segments aren't local files
                     and now - e.last_access >= _SPILL_MIN_IDLE_S
                 ]
                 if not candidates:
@@ -297,7 +311,8 @@ def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[Obj
 
 def read_value(loc: ObjectLocation) -> Any:
     """Deserialize an object from its location (zero-copy for shm payloads;
-    spilled objects are read back from disk)."""
+    spilled objects are read back from disk; remote segments are pulled
+    into the local shm namespace first — ``ray.get`` step 3 in SURVEY §3.3)."""
     if loc.inline is not None:
         value = serialization.deserialize(memoryview(loc.inline))
     elif loc.spilled_path is not None:
@@ -306,9 +321,18 @@ def read_value(loc: ObjectLocation) -> Any:
     else:
         with _ATTACHED_LOCK:
             seg = _ATTACHED.get(loc.shm_name)
-            if seg is None:
+        if seg is None:
+            try:
                 seg = ShmSegment.attach(loc.shm_name, loc.size)
-                _ATTACHED[loc.shm_name] = seg
+            except FileNotFoundError:
+                if not loc.fetch_addr:
+                    raise
+                from ray_tpu._private import object_transfer
+
+                object_transfer.pull_object(loc.shm_name, loc.fetch_addr, loc.size)
+                seg = ShmSegment.attach(loc.shm_name, loc.size)
+            with _ATTACHED_LOCK:
+                seg = _ATTACHED.setdefault(loc.shm_name, seg)
         value = serialization.deserialize(seg.buf)
     if loc.is_error:
         raise value

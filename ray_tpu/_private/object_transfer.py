@@ -1,0 +1,207 @@
+"""Cross-node object transfer — the ObjectManager analog.
+
+The reference moves objects between nodes with chunked gRPC pushes between
+per-node ObjectManagers, located through an ownership-based directory
+(``src/ray/object_manager/object_manager.h:117``, ``pull_manager.h:48``,
+``ownership_based_object_directory.h:37``).  Here every node (head and
+agents) runs an :class:`ObjectServer` over its local shm directory, the
+head's registry is the location directory, and consumers pull with
+:func:`pull_object`: chunked transfer straight into a segment in the
+consumer's local shm namespace, attached zero-copy afterwards.
+
+Connections to remote servers are cached per address (the reference pools
+its gRPC channels the same way).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from multiprocessing.connection import Client as MPClient, Connection, Listener
+from typing import Dict, Optional, Tuple
+
+from ray_tpu._private.shm import ShmSegment, shm_dir
+
+logger = logging.getLogger(__name__)
+
+CHUNK = 8 << 20  # 8 MiB chunks (object_manager_default_chunk_size analog)
+
+Addr = Tuple[str, int]
+
+
+class ObjectServer:
+    """Serves local shm segments to remote pullers (PushManager analog)."""
+
+    def __init__(self, host: str, authkey: bytes):
+        self._listener = Listener((host, 0), family="AF_INET", authkey=authkey, backlog=16)
+        self.addr: Addr = self._listener.address
+        self._shutdown = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True,
+                                        name="object-server")
+        self._thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self._shutdown:
+            try:
+                conn = self._listener.accept()
+            except Exception:
+                if self._shutdown:
+                    return
+                continue
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: Connection) -> None:
+        try:
+            while not self._shutdown:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    return
+                name = msg.get("name", "")
+                # names are flat session-scoped identifiers; never serve a
+                # path outside the local shm dir
+                path = os.path.join(shm_dir(), os.path.basename(name))
+                try:
+                    fd = os.open(path, os.O_RDONLY)
+                except OSError:
+                    conn.send({"ok": False, "error": f"no such segment {name}"})
+                    continue
+                try:
+                    size = os.fstat(fd).st_size
+                    conn.send({"ok": True, "size": size})
+                    off = 0
+                    while off < size:
+                        data = os.pread(fd, min(CHUNK, size - off), off)
+                        conn.send_bytes(data)
+                        off += len(data)
+                finally:
+                    os.close(fd)
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._shutdown = True
+        try:
+            self._listener.close()
+        except Exception:
+            pass
+
+
+# -- pull client -----------------------------------------------------------
+
+# addr -> (connection, per-connection request lock).  The per-connection
+# lock serializes request/response pairs on one wire; pulls from different
+# nodes proceed concurrently.
+_conns: Dict[Addr, Tuple[Connection, threading.Lock]] = {}
+_conns_lock = threading.Lock()
+_authkey: Optional[bytes] = None
+
+
+def configure(authkey: bytes) -> None:
+    """Set the cluster authkey used when dialing remote object servers."""
+    global _authkey
+    _authkey = authkey
+
+
+def _connection(addr: Addr) -> Tuple[Connection, threading.Lock]:
+    import time
+    from multiprocessing import AuthenticationError
+
+    with _conns_lock:
+        entry = _conns.get(addr)
+        if entry is None:
+            # the mp handshake occasionally loses a challenge race when
+            # several processes dial one listener at once — retry, it is
+            # not a credentials problem (same guard as CoreClient)
+            for attempt in range(5):
+                try:
+                    conn = MPClient(tuple(addr), family="AF_INET", authkey=_authkey)
+                    break
+                except (AuthenticationError, OSError, EOFError):
+                    if attempt == 4:
+                        raise
+                    time.sleep(0.05 * (attempt + 1))
+            entry = (conn, threading.Lock())
+            _conns[addr] = entry
+        return entry
+
+
+def _evict(addr: Addr, conn: Connection) -> None:
+    """Drop a connection whose request/response stream may be desynced (a
+    failed mid-transfer pull leaves undrained chunks on the wire)."""
+    with _conns_lock:
+        entry = _conns.get(addr)
+        if entry is not None and entry[0] is conn:
+            del _conns[addr]
+    try:
+        conn.close()
+    except Exception:
+        pass
+
+
+def pull_object(name: str, addr: Addr, expected_size: int = -1) -> None:
+    """Fetch segment ``name`` from the object server at ``addr`` into the
+    local shm dir (PullManager analog: chunked transfer into local plasma).
+
+    Idempotent: if the local copy already exists, returns immediately.
+    """
+    addr = tuple(addr)
+    path = os.path.join(shm_dir(), name)
+    if os.path.exists(path):
+        return
+    tmp = f"{path}.pull.{os.getpid()}.{threading.get_ident()}.{os.urandom(2).hex()}"
+    conn, req_lock = _connection(addr)
+    fd = -1
+    try:
+        with req_lock:
+            conn.send({"name": name})
+            hdr = conn.recv()
+            if not hdr.get("ok"):
+                # clean protocol state — no chunks follow an error header
+                raise FileNotFoundError(hdr.get("error", f"pull of {name} failed"))
+            size = hdr["size"]
+            if expected_size >= 0 and size != expected_size:
+                _evict(addr, conn)  # chunks are in flight; wire is dirty
+                raise IOError(f"pull of {name}: size {size} != expected {expected_size}")
+            fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
+            off = 0
+            while off < size:
+                data = conn.recv_bytes()
+                os.write(fd, data)
+                off += len(data)
+    except (OSError, EOFError) as e:
+        if not isinstance(e, FileNotFoundError):
+            _evict(addr, conn)
+        if fd >= 0:
+            os.close(fd)
+            fd = -1
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+        raise
+    finally:
+        if fd >= 0:
+            os.close(fd)
+    try:
+        os.rename(tmp, path)  # atomic publish; concurrent pullers race benignly
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def reset() -> None:
+    """Drop cached connections (tests / shutdown)."""
+    with _conns_lock:
+        for conn, _ in _conns.values():
+            try:
+                conn.close()
+            except Exception:
+                pass
+        _conns.clear()

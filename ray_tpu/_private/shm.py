@@ -16,6 +16,15 @@ import mmap
 import os
 
 SHM_DIR = "/dev/shm"
+# Per-node override: a cluster node agent points its workers at a private
+# tmpfs subdirectory so that two nodes sharing one test host have honestly
+# disjoint object namespaces (a remote segment is only reachable through
+# the object-transfer plane, never by accidental same-host attach).
+_SHM_DIR_ENV = "RAY_TPU_SHM_DIR"
+
+
+def shm_dir() -> str:
+    return os.environ.get(_SHM_DIR_ENV, SHM_DIR)
 
 
 class ShmSegment:
@@ -24,7 +33,7 @@ class ShmSegment:
     def __init__(self, name: str, size: int, create: bool):
         self.name = name
         self.size = size
-        path = os.path.join(SHM_DIR, name)
+        path = os.path.join(shm_dir(), name)
         if create:
             fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
             try:
@@ -44,7 +53,7 @@ class ShmSegment:
 
     @staticmethod
     def path_for(name: str) -> str:
-        return os.path.join(SHM_DIR, name)
+        return os.path.join(shm_dir(), name)
 
     @classmethod
     def create(cls, name: str, size: int) -> "ShmSegment":
@@ -69,13 +78,13 @@ class ShmSegment:
     @staticmethod
     def unlink(name: str) -> None:
         try:
-            os.unlink(os.path.join(SHM_DIR, name))
+            os.unlink(os.path.join(shm_dir(), name))
         except FileNotFoundError:
             pass
 
     @staticmethod
     def exists(name: str) -> bool:
-        return os.path.exists(os.path.join(SHM_DIR, name))
+        return os.path.exists(os.path.join(shm_dir(), name))
 
 
 # ---------------------------------------------------------------------------
@@ -102,7 +111,7 @@ def session_shm_name(oid_hex: str) -> str:
 def write_session_marker(session_id: str, pid: int) -> None:
     from ray_tpu._private.config import get_config
 
-    path = os.path.join(SHM_DIR, f"{get_config().shm_prefix}-{session_id}-alive")
+    path = os.path.join(shm_dir(), f"{get_config().shm_prefix}-{session_id}-alive")
     with open(path, "w") as f:
         f.write(str(pid))
 
@@ -111,7 +120,7 @@ def remove_session_marker(session_id: str) -> None:
     from ray_tpu._private.config import get_config
 
     try:
-        os.unlink(os.path.join(SHM_DIR, f"{get_config().shm_prefix}-{session_id}-alive"))
+        os.unlink(os.path.join(shm_dir(), f"{get_config().shm_prefix}-{session_id}-alive"))
     except OSError:
         pass
 
@@ -125,7 +134,7 @@ def sweep_orphaned_segments() -> int:
 
     prefix = get_config().shm_prefix
     try:
-        names = os.listdir(SHM_DIR)
+        names = os.listdir(shm_dir())
     except OSError:
         return 0
     sessions: dict = {}
@@ -140,7 +149,7 @@ def sweep_orphaned_segments() -> int:
         marker = f"{prefix}-{sid}-alive"
         alive = False
         try:
-            with open(os.path.join(SHM_DIR, marker)) as f:
+            with open(os.path.join(shm_dir(), marker)) as f:
                 pid = int(f.read().strip() or "0")
             os.kill(pid, 0)  # raises if dead
             alive = True
@@ -150,7 +159,7 @@ def sweep_orphaned_segments() -> int:
             continue
         for n in segs:
             try:
-                os.unlink(os.path.join(SHM_DIR, n))
+                os.unlink(os.path.join(shm_dir(), n))
                 removed += 1
             except OSError:
                 pass

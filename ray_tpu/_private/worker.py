@@ -521,6 +521,9 @@ def main() -> None:
     w.mode = "worker"
     w.node_id = node_id
     w.worker_id = worker_id
+    from ray_tpu._private import object_transfer
+
+    object_transfer.configure(authkey)  # cross-node pulls (SURVEY §3.3)
     client = CoreClient(address, authkey, worker_id=worker_id, node_id=node_id)
     client._exec_queue = queue.Queue()
     w.client = client

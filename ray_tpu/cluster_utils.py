@@ -1,15 +1,27 @@
-"""Fake multi-node cluster for tests.
+"""Multi-node cluster harness for tests.
 
 Analog of the reference's single most load-bearing test asset
 (``python/ray/cluster_utils.py:99`` ``Cluster``, ``add_node`` at ``:165``):
-multiple raylet node-states with distinct ids/resources inside one head
-process, so scheduling spread, placement-group strategies, node affinity and
-node-death behavior are testable on one machine (SURVEY §4.2).
+
+- default mode: multiple raylet node-states with distinct ids/resources
+  inside one head process, so scheduling spread, placement-group
+  strategies, node affinity and node-death behavior are testable on one
+  machine (SURVEY §4.2);
+- ``real_processes=True``: each added node is a real
+  :mod:`ray_tpu._private.node_agent` subprocess joining over TCP with its
+  own worker pool and a private shm namespace — objects move between
+  nodes only through the object-transfer plane (the reference's
+  multi-raylet-per-host test topology).
 """
 
 from __future__ import annotations
 
 import itertools
+import os
+import subprocess
+import sys
+import tempfile
+import time
 from typing import Dict, List, Optional
 
 import ray_tpu
@@ -17,9 +29,17 @@ from ray_tpu._private.worker import global_worker
 
 
 class Cluster:
-    def __init__(self, initialize_head: bool = True, head_node_args: Optional[dict] = None):
+    def __init__(
+        self,
+        initialize_head: bool = True,
+        head_node_args: Optional[dict] = None,
+        real_processes: bool = False,
+    ):
         self._node_counter = itertools.count(1)
         self.node_ids: List[str] = []
+        self.real_processes = real_processes
+        self.agents: Dict[str, subprocess.Popen] = {}
+        self._agent_dirs: List[str] = []
         if initialize_head:
             args = dict(head_node_args or {})
             ray_tpu.init(**args)
@@ -31,18 +51,77 @@ class Cluster:
         num_tpus: int = 0,
         resources: Optional[Dict[str, float]] = None,
         env: Optional[Dict[str, str]] = None,
+        wait: bool = True,
     ) -> str:
         node = global_worker.node
         node_id = f"node-{next(self._node_counter)}"
-        total = dict(resources or {})
-        total["CPU"] = float(num_cpus)
-        total["TPU"] = float(num_tpus)
-        node.add_node_state(node_id, total, tpu_ids=list(range(num_tpus)), env=env)
+        if not self.real_processes:
+            total = dict(resources or {})
+            total["CPU"] = float(num_cpus)
+            total["TPU"] = float(num_tpus)
+            node.add_node_state(node_id, total, tpu_ids=list(range(num_tpus)), env=env)
+            self.node_ids.append(node_id)
+            return node_id
+
+        # real node: spawn an agent process that registers over TCP with a
+        # private shm directory (honest cross-node object transfer even on
+        # one test host)
+        shm_sub = tempfile.mkdtemp(prefix=f"rtpu-{node_id}-", dir="/dev/shm")
+        self._agent_dirs.append(shm_sub)
+        host, port = node.tcp_address
+        agent_env = dict(os.environ)
+        agent_env.update(env or {})
+        agent_env["RAY_TPU_AUTHKEY"] = node.authkey.hex()
+        cmd = [
+            sys.executable, "-m", "ray_tpu._private.node_agent",
+            "--address", f"{host}:{port}",
+            "--node-id", node_id,
+            "--num-cpus", str(num_cpus),
+            "--num-tpus", str(num_tpus),
+            "--shm-dir", shm_sub,
+        ]
+        if resources:
+            import json
+
+            cmd += ["--resources", json.dumps(resources)]
+        proc = subprocess.Popen(cmd, env=agent_env)
+        self.agents[node_id] = proc
+        if wait:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                with node.lock:
+                    if node_id in node.nodes and node.nodes[node_id].alive:
+                        break
+                time.sleep(0.05)
+            else:
+                raise TimeoutError(f"node agent {node_id} did not register")
         self.node_ids.append(node_id)
         return node_id
 
     def remove_node(self, node_id: str) -> None:
+        proc = self.agents.pop(node_id, None)
+        if proc is not None:
+            proc.kill()  # head notices the dropped agent connection
+            deadline = time.time() + 15
+            node = global_worker.node
+            while time.time() < deadline:
+                with node.lock:
+                    ns = node.nodes.get(node_id)
+                    if ns is None or not ns.alive:
+                        return
+                time.sleep(0.05)
+            return
         global_worker.node.remove_node_state(node_id)
 
     def shutdown(self) -> None:
         ray_tpu.shutdown()
+        for proc in self.agents.values():
+            try:
+                proc.kill()
+            except Exception:
+                pass
+        self.agents.clear()
+        import shutil
+
+        for d in self._agent_dirs:
+            shutil.rmtree(d, ignore_errors=True)
