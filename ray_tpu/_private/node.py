@@ -727,13 +727,21 @@ class Node:
         sealer: Optional[WorkerHandle] = None,
     ) -> None:
         # annotate the location with its node + object-server address so
-        # any consumer anywhere can attach-or-pull ("" = head node)
+        # any consumer anywhere can attach-or-pull ("" = head node).
+        # Workers on emulated (fake-cluster) nodes share the head's shm
+        # namespace, so only real agent nodes count as remote — otherwise
+        # their segments would silently escape capacity/spill accounting.
         if loc.shm_name:
             node_id = sealer.node_id if sealer else self._head_node_id
-            loc.node_id = "" if node_id == self._head_node_id else node_id
             with self.lock:
                 ns = self.nodes.get(node_id)
-            loc.fetch_addr = tuple(ns.fetch_addr) if ns and ns.fetch_addr else None
+            is_remote = ns is not None and ns.agent_conn is not None
+            loc.node_id = node_id if is_remote else ""
+            if is_remote:
+                loc.fetch_addr = tuple(ns.fetch_addr) if ns.fetch_addr else None
+            else:
+                head = self.nodes.get(self._head_node_id)
+                loc.fetch_addr = tuple(head.fetch_addr) if head and head.fetch_addr else None
         # contained refs are counted (and remembered for cascade-decrement
         # when this object dies) inside the registry
         self.registry.seal(oid, loc, contained)

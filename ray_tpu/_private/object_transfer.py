@@ -93,10 +93,10 @@ class ObjectServer:
 
 # -- pull client -----------------------------------------------------------
 
-# addr -> (connection, per-connection request lock).  The per-connection
-# lock serializes request/response pairs on one wire; pulls from different
-# nodes proceed concurrently.
-_conns: Dict[Addr, Tuple[Connection, threading.Lock]] = {}
+# addr -> [connection-or-None, per-connection lock].  The per-connection
+# lock serializes the dial and request/response pairs on one wire; pulls
+# from different nodes proceed concurrently.
+_conns: Dict[Addr, list] = {}
 _conns_lock = threading.Lock()
 _authkey: Optional[bytes] = None
 
@@ -111,23 +111,33 @@ def _connection(addr: Addr) -> Tuple[Connection, threading.Lock]:
     import time
     from multiprocessing import AuthenticationError
 
+    # the global lock only guards the dict; the (possibly slow) TCP dial
+    # happens under the per-address lock so an unreachable node can't
+    # stall pulls from healthy nodes
     with _conns_lock:
         entry = _conns.get(addr)
         if entry is None:
+            entry = [None, threading.Lock()]
+            _conns[addr] = entry
+    conn, lock = entry
+    if conn is not None:
+        return conn, lock
+    with lock:
+        if entry[0] is None:
             # the mp handshake occasionally loses a challenge race when
             # several processes dial one listener at once — retry, it is
             # not a credentials problem (same guard as CoreClient)
             for attempt in range(5):
                 try:
-                    conn = MPClient(tuple(addr), family="AF_INET", authkey=_authkey)
+                    entry[0] = MPClient(tuple(addr), family="AF_INET", authkey=_authkey)
                     break
                 except (AuthenticationError, OSError, EOFError):
                     if attempt == 4:
+                        with _conns_lock:
+                            _conns.pop(addr, None)  # next pull redials
                         raise
                     time.sleep(0.05 * (attempt + 1))
-            entry = (conn, threading.Lock())
-            _conns[addr] = entry
-        return entry
+        return entry[0], lock
 
 
 def _evict(addr: Addr, conn: Connection) -> None:
@@ -201,7 +211,8 @@ def reset() -> None:
     with _conns_lock:
         for conn, _ in _conns.values():
             try:
-                conn.close()
+                if conn is not None:
+                    conn.close()
             except Exception:
                 pass
         _conns.clear()

@@ -134,6 +134,23 @@ def main(quick: bool = False) -> List[Dict]:
         timeit("threaded_actor_call_throughput", actor_mc_wave, multiplier=wave,
                min_time_s=min_t, results=results)
 
+        # -------------------------------------------------- data ingest
+        from ray_tpu import data as rd
+
+        mb_data = 32 if quick else 128
+        arr2 = np.random.default_rng(1).standard_normal((mb_data << 20) // 8)
+        ds = rd.from_numpy(arr2, parallelism=8)
+        ds.materialize()
+        t0 = time.perf_counter()
+        seen = 0
+        for batch in ds.iter_batches(batch_size=1 << 16, prefetch_blocks=3):
+            seen += np.asarray(batch).nbytes
+        dt = time.perf_counter() - t0
+        rec = {"metric": f"data_iter_batches_{mb_data}mb_gbps",
+               "value": round(seen / (1 << 30) / dt, 3), "unit": "GiB/s"}
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+
         # -------------------------------------------------- wait
         refs = [noop.remote() for _ in range(8)]
         ray_tpu.get(refs, timeout=60)

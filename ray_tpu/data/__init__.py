@@ -6,8 +6,9 @@ pools over blocks, and ``iter_batches``/``split`` feed training workers.
 """
 
 from ray_tpu.data.block import Block, BlockAccessor
-from ray_tpu.data.dataset import ActorPoolStrategy, Dataset
+from ray_tpu.data.dataset import ActorPoolStrategy, Dataset, GroupedData
 from ray_tpu.data.dataset_pipeline import DatasetPipeline
+from ray_tpu.data.datasource import Datasource, FileBasedDatasource, ReadTask
 from ray_tpu.data.read_api import (
     from_items,
     from_numpy,
@@ -16,6 +17,7 @@ from ray_tpu.data.read_api import (
     range_tensor,
     read_binary_files,
     read_csv,
+    read_datasource,
     read_json,
     read_numpy,
     read_parquet,
@@ -26,6 +28,11 @@ __all__ = [
     "Dataset",
     "DatasetPipeline",
     "ActorPoolStrategy",
+    "GroupedData",
+    "Datasource",
+    "FileBasedDatasource",
+    "ReadTask",
+    "read_datasource",
     "Block",
     "BlockAccessor",
     "from_items",

@@ -1,25 +1,28 @@
-"""Dataset: distributed data over object-store blocks.
+"""Dataset: distributed data over object-store blocks, lazily planned.
 
-Analog of ``python/ray/data/dataset.py:139``: a Dataset is a list of
-object refs to blocks; transforms run as parallel tasks over blocks
-(``TaskPoolStrategy``, ``_internal/compute.py:58``) or through a pool of
-reusable actors (``ActorPoolStrategy``, ``:176``) for stateful/expensive
-setup (e.g. a jax model for batch inference).  Eager execution per stage —
-the reference's lazy ExecutionPlan optimizations (stage fusion) are
-deferred; on TPU the heavy compute belongs in jitted batch fns, so the
-per-stage overhead is the small part.
+Analog of ``python/ray/data/dataset.py:139``: a Dataset is an
+:class:`~ray_tpu.data.plan.ExecutionPlan` — input block refs plus
+recorded stages.  Transforms are lazy; chains of per-block stages fuse
+into one task per block (``_internal/plan.py:74``); global ops
+(``random_shuffle``/``sort``/``repartition``) run as distributed
+map-partition/reduce shuffles (``_internal/push_based_shuffle.py``) that
+never materialize rows on the driver.  Stateful batch transforms run on
+an actor pool (``ActorPoolStrategy``, ``_internal/compute.py:176``) —
+e.g. a jitted model on ``num_tpus=1`` actors for batch inference.
 """
 
 from __future__ import annotations
 
 import math
-import random
+import queue as queue_mod
+import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
 
 import ray_tpu
 from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.plan import ActorPoolStage, ExecutionPlan, OneToOneStage
 
 
 def _apply_batches(block: Block, fn: Callable, batch_size: Optional[int],
@@ -59,6 +62,19 @@ def _filter(block: Block, fn: Callable) -> Block:
     return [r for r in BlockAccessor(block).iter_rows() if fn(r)]
 
 
+def _partial_agg(block: Block, on: Optional[str]):
+    """Per-block partial aggregate: (count, sum, min, max, sumsq)."""
+    batch = BlockAccessor(block).to_batch()
+    if not batch:
+        return (0, 0.0, None, None, 0.0)
+    col = on or ("value" if "value" in batch else next(iter(batch)))
+    arr = np.asarray(batch[col], dtype=np.float64)
+    if arr.size == 0:
+        return (0, 0.0, None, None, 0.0)
+    return (int(arr.size), float(arr.sum()), float(arr.min()),
+            float(arr.max()), float((arr ** 2).sum()))
+
+
 class _BatchWorker:
     """ActorPoolStrategy worker: holds a callable-class instance."""
 
@@ -79,20 +95,40 @@ class ActorPoolStrategy:
 
 
 class Dataset:
-    def __init__(self, block_refs: List[Any], num_rows: Optional[List[int]] = None):
-        self._blocks = list(block_refs)
-        self._num_rows = num_rows
+    def __init__(self, blocks_or_plan, num_rows: Optional[List[int]] = None):
+        if isinstance(blocks_or_plan, ExecutionPlan):
+            self._plan = blocks_or_plan
+        else:
+            self._plan = ExecutionPlan(list(blocks_or_plan), num_rows)
+
+    # -- plan plumbing -------------------------------------------------
+    @property
+    def _blocks(self) -> List[Any]:
+        """Realized block refs (executes the plan)."""
+        return self._plan.execute()[0]
+
+    @property
+    def _counts(self) -> Optional[List[int]]:
+        return self._plan.execute()[1]
+
+    def _with_stage(self, stage) -> "Dataset":
+        return Dataset(self._plan.with_stage(stage))
+
+    def stats(self) -> List[Dict[str, Any]]:
+        """Per-stage execution stats (the _internal/stats.py analog)."""
+        return self._plan.stats()
 
     # -- basics --------------------------------------------------------
     def num_blocks(self) -> int:
         return len(self._blocks)
 
     def count(self) -> int:
-        if self._num_rows is None:
-            self._num_rows = [
-                BlockAccessor(b).num_rows() for b in ray_tpu.get(self._blocks)
-            ]
-        return sum(self._num_rows)
+        refs, counts = self._plan.execute()
+        if counts is None:
+            task = ray_tpu.remote(num_cpus=1)(lambda b: BlockAccessor(b).num_rows())
+            counts = ray_tpu.get([task.remote(r) for r in refs])
+            self._plan._out = (refs, counts)
+        return sum(counts)
 
     def schema(self) -> Optional[Dict[str, str]]:
         for b in self._blocks:
@@ -119,20 +155,15 @@ class Dataset:
         for row in self.take(limit):
             print(row)
 
-    # -- transforms (TaskPool by default) ------------------------------
-    def _transform(self, remote_fn: Callable, *args) -> "Dataset":
-        task = ray_tpu.remote(num_cpus=1)(remote_fn)
-        new_refs = [task.remote(ref, *args) for ref in self._blocks]
-        return Dataset(new_refs)
-
+    # -- transforms (lazy one-to-one stages; fused at execution) -------
     def map(self, fn: Callable) -> "Dataset":
-        return self._transform(_map_rows, fn)
+        return self._with_stage(OneToOneStage("map", lambda b, fn=fn: _map_rows(b, fn)))
 
     def flat_map(self, fn: Callable) -> "Dataset":
-        return self._transform(_flat_map, fn)
+        return self._with_stage(OneToOneStage("flat_map", lambda b, fn=fn: _flat_map(b, fn)))
 
     def filter(self, fn: Callable) -> "Dataset":
-        return self._transform(_filter, fn)
+        return self._with_stage(OneToOneStage("filter", lambda b, fn=fn: _filter(b, fn)))
 
     def map_batches(
         self,
@@ -156,58 +187,61 @@ class Dataset:
             opts = {"num_cpus": 1}
             if num_tpus:
                 opts["num_tpus"] = num_tpus
-            Worker = ray_tpu.remote(**opts)(_BatchWorker)
-            pool = [
-                Worker.remote(blob, fn_constructor_args, fn_constructor_kwargs or {})
-                for _ in range(min(compute.size, len(self._blocks) or 1))
-            ]
-            refs = [
-                pool[i % len(pool)].apply.remote(ref, batch_size, batch_format)
-                for i, ref in enumerate(self._blocks)
-            ]
-            return Dataset(refs)
-        return self._transform(_apply_batches, fn, batch_size, batch_format)
+            size = compute.size
+            ctor_args = (blob, fn_constructor_args, fn_constructor_kwargs or {})
 
-    # -- reorg ---------------------------------------------------------
+            def submit(refs: List[Any]) -> List[Any]:
+                Worker = ray_tpu.remote(**opts)(_BatchWorker)
+                pool = [Worker.remote(*ctor_args)
+                        for _ in range(min(size, len(refs) or 1))]
+                return [pool[i % len(pool)].apply.remote(ref, batch_size, batch_format)
+                        for i, ref in enumerate(refs)]
+
+            return self._with_stage(ActorPoolStage("map_batches(actors)", submit))
+        return self._with_stage(OneToOneStage(
+            "map_batches",
+            lambda b, fn=fn: _apply_batches(b, fn, batch_size, batch_format),
+        ))
+
+    # -- global reorgs (distributed shuffles; driver touches refs only) -
     def repartition(self, num_blocks: int) -> "Dataset":
-        rows = self.take_all()
-        per = math.ceil(len(rows) / num_blocks) if rows else 0
-        blocks = [rows[i * per:(i + 1) * per] for i in range(num_blocks)]
-        return Dataset([ray_tpu.put(b) for b in blocks],
-                       [len(b) for b in blocks])
+        from ray_tpu.data.shuffle import repartition_stage
 
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        """All-to-all shuffle (the reference's push-based shuffle collapses
-        to a local pass on the fake cluster)."""
-        rows = self.take_all()
-        random.Random(seed).shuffle(rows)
-        n = max(1, self.num_blocks())
-        per = math.ceil(len(rows) / n)
-        blocks = [rows[i * per:(i + 1) * per] for i in range(n)]
-        return Dataset([ray_tpu.put(b) for b in blocks], [len(b) for b in blocks])
+        return self._with_stage(repartition_stage(num_blocks))
 
-    def sort(self, key: Optional[Union[str, Callable]] = None, descending: bool = False) -> "Dataset":
-        rows = self.take_all()
-        if isinstance(key, str):
-            keyfn = lambda r: r[key]
-        else:
-            keyfn = key
-        rows.sort(key=keyfn, reverse=descending)
-        n = max(1, self.num_blocks())
-        per = math.ceil(len(rows) / n)
-        blocks = [rows[i * per:(i + 1) * per] for i in range(n)]
-        return Dataset([ray_tpu.put(b) for b in blocks], [len(b) for b in blocks])
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_blocks: Optional[int] = None) -> "Dataset":
+        from ray_tpu.data.shuffle import random_shuffle_stage
+
+        return self._with_stage(random_shuffle_stage(seed, num_blocks))
+
+    def sort(self, key: Optional[Union[str, Callable]] = None,
+             descending: bool = False) -> "Dataset":
+        from ray_tpu.data.shuffle import sort_stage
+
+        return self._with_stage(sort_stage(key, descending))
 
     def split(self, n: int, *, equal: bool = True) -> List["Dataset"]:
-        """n shards for n training workers (dataset.py:1017)."""
-        rows = self.take_all()
-        per = len(rows) // n
-        shards = []
-        for i in range(n):
-            end = (i + 1) * per if (equal or i < n - 1) else len(rows)
-            shard_rows = rows[i * per:end]
-            shards.append(Dataset([ray_tpu.put(shard_rows)], [len(shard_rows)]))
-        return shards
+        """n shards for n training workers (dataset.py:1017) — block-level
+        re-slicing through tasks; rows never surface on the driver."""
+        from ray_tpu.data.shuffle import _reduce_concat, compute_counts, range_partition
+
+        refs, counts = self._plan.execute()
+        if n == 1:
+            return [Dataset(refs, counts)]
+        counts = compute_counts(refs, counts)
+        total = sum(counts)
+        per = total // n
+        if equal:
+            bounds_all = [per * j for j in range(1, n)]
+            if per * n < total:
+                bounds_all.append(per * n)  # remainder goes to a dropped part
+        else:
+            base = [per + (1 if j < total % n else 0) for j in range(n)]
+            bounds_all = list(np.cumsum(base)[:-1])
+        parts = range_partition(refs, counts, bounds_all)
+        reducer = ray_tpu.remote(num_cpus=1)(_reduce_concat)
+        return [Dataset([reducer.remote(None, False, *parts[j])]) for j in range(n)]
 
     def split_at_indices(self, indices: Sequence[int]) -> List["Dataset"]:
         rows = self.take_all()
@@ -220,46 +254,88 @@ class Dataset:
 
     def union(self, *others: "Dataset") -> "Dataset":
         refs = list(self._blocks)
+        counts = self._counts
+        all_counts: Optional[List[int]] = list(counts) if counts is not None else None
         for o in others:
             refs.extend(o._blocks)
-        return Dataset(refs)
+            oc = o._counts
+            if all_counts is not None and oc is not None:
+                all_counts.extend(oc)
+            else:
+                all_counts = None
+        return Dataset(refs, all_counts)
 
     def zip(self, other: "Dataset") -> "Dataset":
-        a, b = self.take_all(), other.take_all()
-        rows = [
-            {**(x if isinstance(x, dict) else {"left": x}),
-             **({f"right_{k}" if k in (x if isinstance(x, dict) else {}) else k: v
-                 for k, v in (y if isinstance(y, dict) else {"right": y}).items()})}
-            for x, y in zip(a, b)
-        ]
-        return Dataset([ray_tpu.put(rows)], [len(rows)])
+        """Row-aligned zip: both datasets are sliced at the SAME global row
+        boundaries (truncated to the shorter), so row i always pairs with
+        row i regardless of each side's block layout."""
+        from ray_tpu.data.shuffle import _reduce_concat, compute_counts, range_partition
 
-    # -- aggregates ----------------------------------------------------
-    def _column(self, on: Optional[str]) -> np.ndarray:
-        vals: List[Any] = []
-        for ref in self._blocks:
-            batch = BlockAccessor(ray_tpu.get(ref)).to_batch()
-            if not batch:
-                continue
-            col = on or ("value" if "value" in batch else next(iter(batch)))
-            vals.append(np.asarray(batch[col]))
-        return np.concatenate(vals) if vals else np.asarray([])
+        a_refs, a_counts = self._plan.execute()
+        b_refs, b_counts = other._plan.execute()
+        a_counts = compute_counts(a_refs, a_counts)
+        b_counts = compute_counts(b_refs, b_counts)
+        total = min(sum(a_counts), sum(b_counts))
+        n = max(1, max(len(a_refs), len(b_refs)))
+        per = [total // n + (1 if j < total % n else 0) for j in range(n)]
+        bounds = list(np.cumsum(per)[:-1]) + ([total] if total < max(sum(a_counts), sum(b_counts)) else [])
+        reducer = ray_tpu.remote(num_cpus=1)(_reduce_concat)
+        a_parts = range_partition(a_refs, a_counts, bounds)
+        b_parts = range_partition(b_refs, b_counts, bounds)
+        a_refs = [reducer.remote(None, False, *a_parts[j]) for j in range(n)]
+        b_refs = [reducer.remote(None, False, *b_parts[j]) for j in range(n)]
+
+        def zip_blocks(x: Block, y: Block) -> Block:
+            rows = []
+            for rx, ry in zip(BlockAccessor(x).iter_rows(), BlockAccessor(y).iter_rows()):
+                dx = rx if isinstance(rx, dict) else {"left": rx}
+                dy = ry if isinstance(ry, dict) else {"right": ry}
+                rows.append({**dx, **{(f"right_{k}" if k in dx else k): v
+                                      for k, v in dy.items()}})
+            return rows
+
+        task = ray_tpu.remote(num_cpus=1)(zip_blocks)
+        return Dataset([task.remote(x, y) for x, y in zip(a_refs, b_refs)])
+
+    # -- aggregates (per-block partials; only scalars reach the driver) -
+    def _agg(self, on: Optional[str]):
+        task = ray_tpu.remote(num_cpus=1)(_partial_agg)
+        parts = ray_tpu.get([task.remote(r, on) for r in self._blocks])
+        count = sum(p[0] for p in parts)
+        if count == 0:
+            return None
+        total = sum(p[1] for p in parts)
+        mn = min(p[2] for p in parts if p[0])
+        mx = max(p[3] for p in parts if p[0])
+        sumsq = sum(p[4] for p in parts)
+        return count, total, mn, mx, sumsq
+
+    def _agg_nonempty(self, on: Optional[str], op: str):
+        agg = self._agg(on)
+        if agg is None:
+            raise ValueError(f"cannot compute {op}() of an empty dataset")
+        return agg
 
     def sum(self, on: Optional[str] = None):
-        col = self._column(on)
-        return col.sum().item() if col.size else 0
+        agg = self._agg(on)
+        return agg[1] if agg else 0
 
     def min(self, on: Optional[str] = None):
-        return self._column(on).min().item()
+        return self._agg_nonempty(on, "min")[2]
 
     def max(self, on: Optional[str] = None):
-        return self._column(on).max().item()
+        return self._agg_nonempty(on, "max")[3]
 
     def mean(self, on: Optional[str] = None):
-        return self._column(on).mean().item()
+        count, total, *_ = self._agg_nonempty(on, "mean")
+        return total / count
 
     def std(self, on: Optional[str] = None):
-        return self._column(on).std().item()
+        count, total, _, _, sumsq = self._agg_nonempty(on, "std")
+        return float(np.sqrt(max(0.0, sumsq / count - (total / count) ** 2)))
+
+    def groupby(self, key: Union[str, Callable]) -> "GroupedData":
+        return GroupedData(self, key)
 
     # -- consumption ---------------------------------------------------
     def iter_rows(self) -> Iterator[Any]:
@@ -268,25 +344,68 @@ class Dataset:
 
     def iter_batches(
         self, *, batch_size: int = 256, batch_format: str = "numpy",
-        drop_last: bool = False,
+        drop_last: bool = False, prefetch_blocks: int = 2,
     ) -> Iterator[Any]:
-        """Stream batches (dataset.py:2624); block fetches overlap consumption
-        by prefetching the next block ref."""
-        carry: List[Any] = []
-        for ref in self._blocks:
-            rows = BlockAccessor(ray_tpu.get(ref)).to_rows()
-            carry.extend(rows)
-            while len(carry) >= batch_size:
-                chunk, carry = carry[:batch_size], carry[batch_size:]
-                yield self._format_batch(chunk, batch_format)
-        if carry and not drop_last:
-            yield self._format_batch(carry, batch_format)
+        """Stream batches (dataset.py:2624).  A background thread keeps up
+        to ``prefetch_blocks`` blocks materialized ahead of consumption, so
+        object fetch (incl. cross-node pulls) overlaps compute."""
+        refs = self._blocks
+        if not refs:
+            return
+        q: "queue_mod.Queue" = queue_mod.Queue(maxsize=max(1, prefetch_blocks))
+        SENTINEL = object()
+        stop = threading.Event()
+
+        def fetcher():
+            try:
+                for ref in refs:
+                    block = ray_tpu.get(ref)
+                    while not stop.is_set():
+                        try:
+                            q.put(block, timeout=0.2)
+                            break
+                        except queue_mod.Full:
+                            continue
+                    if stop.is_set():
+                        return  # consumer abandoned the iterator
+            except BaseException as e:  # surfaced on the consumer side
+                q.put(e)
+                return
+            q.put(SENTINEL)
+
+        t = threading.Thread(target=fetcher, daemon=True, name="iter-batches-prefetch")
+        t.start()
+        try:
+            # the carry and all slicing stay columnar for table blocks —
+            # numpy views, no per-row python objects on the hot path
+            carry: Optional[Block] = None
+            while True:
+                item = q.get()
+                if item is SENTINEL:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                block = item if carry is None else BlockAccessor.concat([carry, item])
+                carry = None
+                acc = BlockAccessor(block)
+                n, pos = acc.num_rows(), 0
+                while n - pos >= batch_size:
+                    yield self._format_batch(acc.slice(pos, pos + batch_size), batch_format)
+                    pos += batch_size
+                if pos < n:
+                    carry = acc.slice(pos, n)
+            if carry is not None and BlockAccessor(carry).num_rows() and not drop_last:
+                yield self._format_batch(carry, batch_format)
+        finally:
+            # unblocks (and ends) the fetcher if the consumer broke early
+            stop.set()
 
     @staticmethod
-    def _format_batch(rows: List[Any], batch_format: str):
+    def _format_batch(block: Block, batch_format: str):
+        acc = BlockAccessor(block)
         if batch_format == "rows":
-            return rows
-        batch = BlockAccessor(rows).to_batch()
+            return acc.to_rows()
+        batch = acc.to_batch()
         if batch_format == "numpy":
             if set(batch) == {"value"}:
                 return batch["value"]
@@ -294,11 +413,18 @@ class Dataset:
         if batch_format == "pandas":
             import pandas as pd
 
-            return pd.DataFrame(rows)
+            return pd.DataFrame(acc.to_rows())
         raise ValueError(f"unknown batch_format {batch_format!r}")
 
     def to_numpy(self, column: Optional[str] = None) -> np.ndarray:
-        return self._column(column)
+        vals: List[np.ndarray] = []
+        for ref in self._blocks:
+            batch = BlockAccessor(ray_tpu.get(ref)).to_batch()
+            if not batch:
+                continue
+            col = column or ("value" if "value" in batch else next(iter(batch)))
+            vals.append(np.asarray(batch[col]))
+        return np.concatenate(vals) if vals else np.asarray([])
 
     def to_pandas(self):
         import pandas as pd
@@ -321,15 +447,90 @@ class Dataset:
 
         return DatasetPipeline.from_dataset(self, self.num_blocks() or 1, repeat=times)
 
-    # -- io ------------------------------------------------------------
-    def write_csv(self, path: str) -> None:
-        self.to_pandas().to_csv(path, index=False)
+    # -- io (one write task per block -> part files) -------------------
+    def _write(self, datasource_cls, path: str, **kw) -> List[str]:
+        import os
 
-    def write_json(self, path: str) -> None:
-        self.to_pandas().to_json(path, orient="records", lines=True)
+        os.makedirs(path, exist_ok=True)
+        ds = datasource_cls([])
 
-    def write_parquet(self, path: str) -> None:
-        self.to_pandas().to_parquet(path)
+        def write_one(block: Block, index: int) -> str:
+            return ds.write_block(block, path, index, **kw)
+
+        task = ray_tpu.remote(num_cpus=1)(write_one)
+        return ray_tpu.get([task.remote(r, i) for i, r in enumerate(self._blocks)])
+
+    def write_csv(self, path: str) -> List[str]:
+        from ray_tpu.data.datasource import CSVDatasource
+
+        return self._write(CSVDatasource, path)
+
+    def write_json(self, path: str) -> List[str]:
+        from ray_tpu.data.datasource import JSONDatasource
+
+        return self._write(JSONDatasource, path)
+
+    def write_parquet(self, path: str) -> List[str]:
+        from ray_tpu.data.datasource import ParquetDatasource
+
+        return self._write(ParquetDatasource, path)
+
+    def write_numpy(self, path: str) -> List[str]:
+        from ray_tpu.data.datasource import NumpyDatasource
+
+        return self._write(NumpyDatasource, path)
 
     def __repr__(self):
+        n_stages = len(self._plan.stages)
+        if self._plan._out is None and n_stages:
+            return f"Dataset(num_stages={n_stages}, unexecuted)"
         return f"Dataset(num_blocks={self.num_blocks()}, num_rows={self.count()})"
+
+
+def _group_block(block: Block, key) -> Dict[Any, List[Any]]:
+    from ray_tpu.data.shuffle import _key_fn
+
+    kf = _key_fn(key)
+    out: Dict[Any, List[Any]] = {}
+    for r in BlockAccessor(block).iter_rows():
+        out.setdefault(kf(r), []).append(r)
+    return out
+
+
+class GroupedData:
+    """Minimal groupby: count/sum/mean over a key (reference
+    ``grouped_dataset.py``); per-block grouping tasks + driver combine of
+    the (small) per-key partials."""
+
+    def __init__(self, ds: Dataset, key):
+        self._ds = ds
+        self._key = key
+
+    def _partials(self, value_of: Callable[[List[Any]], Any]) -> Dict[Any, Any]:
+        task = ray_tpu.remote(num_cpus=1)(_group_block)
+        merged: Dict[Any, List[Any]] = {}
+        for part in ray_tpu.get([task.remote(r, self._key) for r in self._ds._blocks]):
+            for k, rows in part.items():
+                merged.setdefault(k, []).append(value_of(rows))
+        return merged
+
+    def count(self) -> Dataset:
+        merged = self._partials(len)
+        rows = [{"key": k, "count": sum(v)} for k, v in sorted(merged.items())]
+        return Dataset([ray_tpu.put(rows)], [len(rows)])
+
+    def sum(self, on: str) -> Dataset:
+        merged = self._partials(lambda rows: sum(r[on] for r in rows))
+        rows = [{"key": k, "sum": sum(v)} for k, v in sorted(merged.items())]
+        return Dataset([ray_tpu.put(rows)], [len(rows)])
+
+    def mean(self, on: str) -> Dataset:
+        task = ray_tpu.remote(num_cpus=1)(_group_block)
+        sums: Dict[Any, float] = {}
+        counts: Dict[Any, int] = {}
+        for part in ray_tpu.get([task.remote(r, self._key) for r in self._ds._blocks]):
+            for k, rows in part.items():
+                sums[k] = sums.get(k, 0.0) + sum(r[on] for r in rows)
+                counts[k] = counts.get(k, 0) + len(rows)
+        rows = [{"key": k, "mean": sums[k] / counts[k]} for k in sorted(sums)]
+        return Dataset([ray_tpu.put(rows)], [len(rows)])

@@ -1,18 +1,42 @@
-"""Dataset creation / read connectors (``python/ray/data/read_api.py``)."""
+"""Dataset creation / read connectors (``python/ray/data/read_api.py``).
+
+All file/range reads go through :func:`read_datasource`
+(``read_api.py:233``): the datasource splits into ReadTasks, each runs as
+one remote task producing one block.
+"""
 
 from __future__ import annotations
 
 import math
-import os
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, List, Optional, Sequence, Union
 
 import numpy as np
 
 import ray_tpu
-from ray_tpu.data.block import BlockAccessor
 from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.datasource import (
+    BinaryDatasource,
+    CSVDatasource,
+    Datasource,
+    JSONDatasource,
+    NumpyDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    TextDatasource,
+)
 
 DEFAULT_BLOCKS = 4
+
+
+def read_datasource(datasource: Datasource, *, parallelism: int = DEFAULT_BLOCKS,
+                    **read_args) -> Dataset:
+    """One remote task per ReadTask; returns a lazy Dataset over the
+    resulting blocks."""
+    tasks = datasource.prepare_read(parallelism, **read_args)
+    runner = ray_tpu.remote(num_cpus=1)(lambda t: t())
+    refs = [runner.remote(t) for t in tasks]
+    counts = [t.num_rows for t in tasks]
+    return Dataset(refs, None if any(c is None for c in counts) else counts)
 
 
 def _put_blocks(rows: List[Any], parallelism: int) -> Dataset:
@@ -31,34 +55,11 @@ def from_items(items: Sequence[Any], *, parallelism: int = DEFAULT_BLOCKS) -> Da
 
 
 def range(n: int, *, parallelism: int = DEFAULT_BLOCKS) -> Dataset:  # noqa: A001
-    import builtins
-
-    parallelism = max(1, min(parallelism, n or 1))
-    per = math.ceil(n / parallelism)
-    refs, counts = [], []
-    for i in builtins.range(parallelism):
-        lo, hi = i * per, min((i + 1) * per, n)
-        if lo >= hi:
-            continue
-        refs.append(ray_tpu.put({"value": np.arange(lo, hi)}))
-        counts.append(hi - lo)
-    return Dataset(refs, counts)
+    return read_datasource(RangeDatasource(n), parallelism=parallelism)
 
 
 def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = DEFAULT_BLOCKS) -> Dataset:
-    import builtins
-
-    parallelism = max(1, min(parallelism, n or 1))
-    per = math.ceil(n / parallelism)
-    refs, counts = [], []
-    for i in builtins.range(parallelism):
-        lo, hi = i * per, min((i + 1) * per, n)
-        if lo >= hi:
-            continue
-        data = np.arange(lo, hi).reshape(-1, *([1] * len(shape))) * np.ones(shape)
-        refs.append(ray_tpu.put({"data": data}))
-        counts.append(hi - lo)
-    return Dataset(refs, counts)
+    return read_datasource(RangeDatasource(n, tensor_shape=shape), parallelism=parallelism)
 
 
 def from_numpy(arr: Union[np.ndarray, List[np.ndarray]], *,
@@ -76,75 +77,25 @@ def from_pandas(df) -> Dataset:
     return Dataset([ray_tpu.put(block)], [len(df)])
 
 
-def _expand_paths(paths: Union[str, List[str]], suffix: Optional[str] = None) -> List[str]:
-    if isinstance(paths, str):
-        paths = [paths]
-    out: List[str] = []
-    for p in paths:
-        if os.path.isdir(p):
-            for name in sorted(os.listdir(p)):
-                if suffix is None or name.endswith(suffix):
-                    out.append(os.path.join(p, name))
-        else:
-            out.append(p)
-    return out
+def read_csv(paths: Union[str, List[str]], *, parallelism: int = DEFAULT_BLOCKS, **kw) -> Dataset:
+    return read_datasource(CSVDatasource(paths, **kw), parallelism=parallelism)
 
 
-def _read_files(paths: List[str], reader) -> Dataset:
-    """One read task per file — parallel IO (read_api.py:233 pattern)."""
-    task = ray_tpu.remote(num_cpus=1)(reader)
-    refs = [task.remote(p) for p in paths]
-    return Dataset(refs)
+def read_json(paths: Union[str, List[str]], *, parallelism: int = DEFAULT_BLOCKS, **kw) -> Dataset:
+    return read_datasource(JSONDatasource(paths, **kw), parallelism=parallelism)
 
 
-def read_csv(paths: Union[str, List[str]], **kw) -> Dataset:
-    def reader(path):
-        import pandas as pd
-
-        df = pd.read_csv(path, **kw)
-        return {c: df[c].to_numpy() for c in df.columns}
-
-    return _read_files(_expand_paths(paths, ".csv"), reader)
+def read_parquet(paths: Union[str, List[str]], *, parallelism: int = DEFAULT_BLOCKS, **kw) -> Dataset:
+    return read_datasource(ParquetDatasource(paths, **kw), parallelism=parallelism)
 
 
-def read_json(paths: Union[str, List[str]], **kw) -> Dataset:
-    def reader(path):
-        import pandas as pd
-
-        df = pd.read_json(path, orient="records", lines=True, **kw)
-        return {c: df[c].to_numpy() for c in df.columns}
-
-    return _read_files(_expand_paths(paths, ".json"), reader)
+def read_numpy(paths: Union[str, List[str]], *, parallelism: int = DEFAULT_BLOCKS) -> Dataset:
+    return read_datasource(NumpyDatasource(paths), parallelism=parallelism)
 
 
-def read_parquet(paths: Union[str, List[str]], **kw) -> Dataset:
-    def reader(path):
-        import pandas as pd
-
-        df = pd.read_parquet(path, **kw)
-        return {c: df[c].to_numpy() for c in df.columns}
-
-    return _read_files(_expand_paths(paths, ".parquet"), reader)
+def read_text(paths: Union[str, List[str]], *, parallelism: int = DEFAULT_BLOCKS) -> Dataset:
+    return read_datasource(TextDatasource(paths), parallelism=parallelism)
 
 
-def read_numpy(paths: Union[str, List[str]]) -> Dataset:
-    def reader(path):
-        return {"value": np.load(path)}
-
-    return _read_files(_expand_paths(paths, ".npy"), reader)
-
-
-def read_text(paths: Union[str, List[str]]) -> Dataset:
-    def reader(path):
-        with open(path) as f:
-            return [line.rstrip("\n") for line in f]
-
-    return _read_files(_expand_paths(paths), reader)
-
-
-def read_binary_files(paths: Union[str, List[str]]) -> Dataset:
-    def reader(path):
-        with open(path, "rb") as f:
-            return [{"path": path, "bytes": f.read()}]
-
-    return _read_files(_expand_paths(paths), reader)
+def read_binary_files(paths: Union[str, List[str]], *, parallelism: int = DEFAULT_BLOCKS) -> Dataset:
+    return read_datasource(BinaryDatasource(paths), parallelism=parallelism)

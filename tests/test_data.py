@@ -90,8 +90,9 @@ def test_csv_roundtrip(ray_start_regular, tmp_path):
     assert ds.count() == 3
     assert set(ds.schema()) == {"a", "b"}
     out = str(tmp_path / "out.csv")
-    ds.write_csv(out)
-    pd.testing.assert_frame_equal(pd.read_csv(out), df)
+    parts = ds.write_csv(out)  # directory of part files, one per block
+    back = pd.concat([pd.read_csv(f) for f in sorted(parts)], ignore_index=True)
+    pd.testing.assert_frame_equal(back, df)
 
 
 def test_pipeline_window_repeat(ray_start_regular):
@@ -124,3 +125,132 @@ def test_dataset_feeds_trainer_shards(ray_start_regular):
     result = trainer.fit()
     assert result.error is None
     assert result.metrics["rows"] == 4
+
+
+def test_lazy_plan_and_fusion(ray_start_regular):
+    """Transforms record stages; chains of per-block stages fuse into one
+    task per block at execution (plan.py analog of _internal/plan.py:74)."""
+    ds = rd.range(64, parallelism=4)
+    out = ds.map(lambda x: {"x": x * 2}).filter(lambda r: r["x"] % 4 == 0).map(
+        lambda r: {"x": r["x"] + 1}
+    )
+    # nothing executed yet
+    assert out._plan._out is None
+    assert len(out._plan.stages) == 3
+    vals = sorted(r["x"] for r in out.take_all())
+    assert vals == [x * 2 + 1 for x in range(64) if (x * 2) % 4 == 0]
+    # the three one-to-one stages ran as ONE fused stage
+    stats = out.stats()
+    assert len(stats) == 1 and "map" in stats[0]["stage"] and "filter" in stats[0]["stage"]
+
+
+def test_distributed_shuffle_no_driver_materialization(ray_start_regular):
+    ds = rd.range(1000, parallelism=8)
+    shuffled = ds.random_shuffle(seed=7)
+    vals = sorted(shuffled.to_numpy().tolist())
+    assert vals == list(range(1000))
+    # actually shuffled
+    first = rd.range(1000, parallelism=8).random_shuffle(seed=7).take(20)
+    assert [r for r in first] != list(range(20))
+
+
+def test_distributed_sort_by_key(ray_start_regular):
+    import random as pyrandom
+
+    rows = [{"k": pyrandom.Random(1).randint(0, 10_000), "i": i} for i in range(500)]
+    pyrandom.Random(2).shuffle(rows)
+    ds = rd.from_items(rows, parallelism=6).sort(key="k")
+    out = [r["k"] for r in ds.take_all()]
+    assert out == sorted(out)
+    desc = rd.from_items(rows, parallelism=6).sort(key="k", descending=True)
+    out_d = [r["k"] for r in desc.take_all()]
+    assert out_d == sorted(out_d, reverse=True)
+
+
+def test_repartition_counts(ray_start_regular):
+    ds = rd.range(100, parallelism=3).repartition(5)
+    assert ds.num_blocks() == 5
+    assert ds.count() == 100
+    assert sorted(ds.to_numpy().tolist()) == list(range(100))
+
+
+def test_groupby(ray_start_regular):
+    rows = [{"k": i % 3, "v": i} for i in range(30)]
+    ds = rd.from_items(rows, parallelism=4)
+    counts = {r["key"]: r["count"] for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 10, 1: 10, 2: 10}
+    sums = {r["key"]: r["sum"] for r in ds.groupby("k").sum("v").take_all()}
+    assert sums[0] == sum(i for i in range(30) if i % 3 == 0)
+    means = {r["key"]: r["mean"] for r in ds.groupby("k").mean("v").take_all()}
+    assert means[1] == sum(i for i in range(30) if i % 3 == 1) / 10
+
+
+def test_custom_datasource(ray_start_regular):
+    from ray_tpu.data import Datasource, ReadTask, read_datasource
+
+    class SquaresSource(Datasource):
+        def prepare_read(self, parallelism, **_):
+            import numpy as np
+
+            per = 10
+            return [
+                ReadTask(lambda lo=i * per: {"value": np.arange(lo, lo + per) ** 2},
+                         num_rows=per)
+                for i in range(parallelism)
+            ]
+
+    ds = read_datasource(SquaresSource(), parallelism=3)
+    assert ds.count() == 30
+    assert ds.max() == 29 ** 2
+
+
+def test_iter_batches_prefetch(ray_start_regular):
+    ds = rd.range(100, parallelism=5)
+    batches = list(ds.iter_batches(batch_size=7, prefetch_blocks=3))
+    flat = [v for b in batches for v in (b.tolist() if hasattr(b, "tolist") else b)]
+    assert flat == list(range(100))
+
+
+def test_stats_recorded(ray_start_regular):
+    ds = rd.range(50, parallelism=2).map(lambda x: {"v": x}).random_shuffle(seed=0)
+    ds.count()
+    names = [s["stage"] for s in ds.stats()]
+    assert any("map" in n for n in names) and any("shuffle" in n for n in names)
+
+
+def test_zip_alignment_unequal_blocks(ray_start_regular):
+    """zip pairs row i with row i even when block layouts differ."""
+    a = rd.from_items([{"a": i} for i in range(10)], parallelism=2)
+    b = rd.from_items([{"b": i * 10} for i in range(8)], parallelism=3)
+    rows = a.zip(b).take_all()
+    assert len(rows) == 8
+    assert all(r["b"] == r["a"] * 10 for r in rows)
+
+
+def test_empty_dataset_aggregates(ray_start_regular):
+    ds = rd.from_items([])
+    assert ds.sum() == 0
+    with pytest.raises(ValueError, match="empty"):
+        ds.min()
+    with pytest.raises(ValueError, match="empty"):
+        ds.mean()
+
+
+def test_iter_batches_early_break(ray_start_regular):
+    """Abandoning the iterator mid-epoch must not wedge the prefetcher."""
+    import threading as _t
+
+    def prefetchers():
+        return [t for t in _t.enumerate() if t.name == "iter-batches-prefetch"]
+
+    for _ in range(5):
+        for batch in rd.range(100, parallelism=10).iter_batches(batch_size=5):
+            break  # consumer stops after the first batch
+    import gc
+    import time as _time
+
+    gc.collect()  # close abandoned generators -> stop flags set
+    deadline = _time.time() + 5
+    while prefetchers() and _time.time() < deadline:
+        _time.sleep(0.1)
+    assert not prefetchers(), f"leaked prefetch threads: {prefetchers()}"
