@@ -30,33 +30,80 @@ _init_lock = threading.Lock()
 
 
 def init(
+    address: Optional[str] = None,
     num_cpus: Optional[int] = None,
     num_tpus: Optional[int] = None,
     resources: Optional[Dict[str, float]] = None,
     ignore_reinit_error: bool = True,
+    _authkey: Optional[bytes] = None,
     **_kwargs,
 ) -> None:
-    """Start the head runtime in this process and connect as the driver.
+    """Start (or join) a cluster and connect as the driver.
 
-    Analog of ``ray.init`` head-node bootstrap (reference ``worker.py:1031`` →
-    ``node.py:1083 start_ray_processes``): here GCS/raylet/object directory
-    run as threads of the driver process and workers are spawned on demand.
+    With no ``address``, boots the head runtime in this process — the
+    ``ray.init`` head-node path (reference ``worker.py:1031`` →
+    ``node.py:1083 start_ray_processes``): GCS/raylet/object directory run
+    as threads of the driver process, workers spawn on demand.
+
+    With ``address="tcp://host:port"`` (or ``"auto"`` to read the session
+    file a running head wrote), joins an existing cluster as an external
+    driver — the ``ray.init(address=...)`` path.  The authkey comes from
+    ``$RAY_TPU_AUTHKEY`` unless passed.
     """
     from ray_tpu._private.client import CoreClient
     from ray_tpu._private.node import Node
 
+    import os as _os
+
+    if address is None and _os.environ.get("RAY_TPU_ADDRESS", "").startswith("tcp://"):
+        # submitted jobs join the cluster that launched them (the
+        # reference's $RAY_ADDRESS behavior)
+        address = _os.environ["RAY_TPU_ADDRESS"]
     with _init_lock:
         if global_worker.connected:
             if ignore_reinit_error:
                 return
             raise RuntimeError("ray_tpu.init() called twice")
-        node = Node(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
-        client = CoreClient(node.address, node.authkey)
+        if address is not None:
+            import json
+            import os
+
+            if address == "auto":
+                with open("/tmp/ray_tpu/last_session.json") as f:
+                    sess = json.load(f)
+                address = sess["address"]
+                authkey = bytes.fromhex(sess["authkey"])
+                if sess.get("session_id"):
+                    # adopt the head's shm namespace so this driver's puts
+                    # live (and are swept) with the session they belong to
+                    from ray_tpu._private import shm as _shm
+
+                    os.environ[_shm._SESSION_ENV] = sess["session_id"]
+            else:
+                authkey = _authkey or bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
+            from ray_tpu._private import object_transfer
+
+            object_transfer.configure(authkey)
+            node = None
+            client = CoreClient(address, authkey)
+            from ray_tpu._private import shm as _shm
+
+            if _shm._SESSION_ENV not in os.environ:
+                # adopt the head's shm namespace so this driver's puts are
+                # swept with the session they belong to
+                try:
+                    sess_id = client.request({"type": "whoami"}, timeout=30)["value"]
+                    os.environ[_shm._SESSION_ENV] = sess_id["session_id"]
+                except Exception:
+                    pass
+        else:
+            node = Node(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
+            client = CoreClient(node.address, node.authkey)
         client.register_client()
         global_worker.mode = "driver"
         global_worker.node = node
         global_worker.client = client
-        global_worker.node_id = node._head_node_id
+        global_worker.node_id = node._head_node_id if node else "node-head"
         atexit.register(shutdown)
 
 

@@ -1,0 +1,5 @@
+"""``python -m ray_tpu`` — the CLI entry point (``ray`` command analog)."""
+
+from ray_tpu.scripts.cli import main
+
+main()

@@ -302,10 +302,50 @@ class Node:
         t = threading.Thread(target=self._timeout_loop, name="timeouts", daemon=True)
         t.start()
         self._threads.append(t)
+        # Dashboard + merged worker metrics (DashboardHead analog); port -1
+        # disables, 0 picks an ephemeral port.
+        from ray_tpu._private.job_manager import JobManager
+        from ray_tpu.util import metrics as metrics_mod
+
+        self.job_manager = JobManager(self)
+        self.worker_metrics_registry = metrics_mod._Registry()
+        self.dashboard = None
+        dash_port = int(os.environ.get("RAY_TPU_DASHBOARD_PORT", "0"))
+        if dash_port >= 0:
+            try:
+                from ray_tpu.dashboard import Dashboard
+
+                self.dashboard = Dashboard(self, host=host, port=dash_port)
+                logger.info("dashboard at http://%s:%d", *self.dashboard.address)
+            except Exception:
+                logger.warning("dashboard failed to start:\n%s", traceback.format_exc())
+        # session discovery for `ray_tpu.init(address="auto")` / the CLI
+        self._write_session_file()
         # Prestart one warm worker (WorkerPool prestart analog) to hide
         # interpreter boot latency on first task.
         with self.lock:
             self._spawn_worker(self.nodes[self._head_node_id])
+
+    def _write_session_file(self) -> None:
+        """Discovery record for address="auto" drivers and the CLI (the
+        reference's /tmp/ray/ray_current_cluster analog)."""
+        import json
+
+        path = "/tmp/ray_tpu/last_session.json"
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        host, port = self.tcp_address
+        payload = {
+            "address": f"tcp://{host}:{port}",
+            "authkey": self.authkey.hex(),
+            "session_dir": self.session_dir,
+            "session_id": self.session_id,
+            "pid": os.getpid(),
+            "dashboard": list(self.dashboard.address) if self.dashboard else None,
+        }
+        fd = os.open(path + ".tmp", os.O_CREAT | os.O_TRUNC | os.O_WRONLY, 0o600)
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f)
+        os.replace(path + ".tmp", path)
 
     # ------------------------------------------------------------------
     # topology
@@ -512,6 +552,29 @@ class Node:
                                "value": (aid, info.creation_spec.get("class_blob_id") if info else None)})
         elif mtype == "state_snapshot":
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"], "value": self._state_snapshot()})
+        elif mtype == "whoami":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": {"session_id": self.session_id,
+                                         "head_node_id": self._head_node_id}})
+        elif mtype == "submit_job":
+            jid = self.job_manager.submit(
+                msg["entrypoint"], msg.get("runtime_env"), msg.get("job_id"),
+                msg.get("metadata"))
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"], "value": jid})
+        elif mtype == "job_info":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self.job_manager.info(msg["job_id"])})
+        elif mtype == "job_logs":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self.job_manager.logs(msg["job_id"])})
+        elif mtype == "stop_job":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self.job_manager.stop(msg["job_id"])})
+        elif mtype == "list_state":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self._list_state(msg["what"], msg.get("limit", 1000))})
+        elif mtype == "metrics_report":
+            self.worker_metrics_registry.merge(msg["origin"], msg["metrics"])
         elif mtype == "log":
             logging_utils.emit_worker_log(msg)
         else:
@@ -1162,7 +1225,7 @@ class Node:
             info = ActorInfo(
                 actor_id=spec["actor_id"],
                 name=spec.get("actor_name"),
-                class_name=spec.get("name", "Actor"),
+                class_name=spec.get("name", "Actor").removesuffix(".__init__"),
                 max_restarts=spec.get("max_restarts", 0),
                 creation_spec=spec,
             )
@@ -1511,6 +1574,46 @@ class Node:
     # ------------------------------------------------------------------
     # introspection
     # ------------------------------------------------------------------
+    def _list_state(self, what: str, limit: int = 1000) -> List[dict]:
+        """State API backend (experimental/state/api.py:729-1333 analog)."""
+
+        def rows(items):
+            out = []
+            for it in list(items)[:limit]:
+                d = {}
+                for k in it.__dataclass_fields__:
+                    if k == "creation_spec":  # big blobs; not introspection data
+                        continue
+                    v = getattr(it, k)
+                    d[k] = v.hex() if isinstance(v, bytes) else v
+                out.append(d)
+            return out
+
+
+        with self.gcs.lock:
+            if what == "actors":
+                return rows(self.gcs.actors.values())
+            if what == "nodes":
+                return rows(self.gcs.nodes.values())
+            if what == "tasks":
+                return rows(self.gcs.tasks.values())
+            if what == "placement_groups":
+                return rows(self.gcs.placement_groups.values())
+        if what == "objects":
+            return self.registry.list_objects(limit)
+        if what == "workers":
+            with self.lock:
+                return [
+                    {"worker_id": w.worker_id.hex(), "node_id": w.node_id,
+                     "state": w.state, "is_actor_worker": w.is_actor_worker,
+                     "pid": w.proc.pid if w.proc else None}
+                    for w in list(self.workers.values())[:limit]
+                ]
+        if what == "jobs":
+            mgr = getattr(self, "job_manager", None)
+            return mgr.list_jobs() if mgr else []
+        raise ValueError(f"unknown state table {what!r}")
+
     def _state_snapshot(self) -> dict:
         snap = self.gcs.snapshot()
         snap["object_store"] = self.registry.stats()
@@ -1559,6 +1662,15 @@ class Node:
             pass
         try:
             self._tcp_listener.close()
+        except Exception:
+            pass
+        try:
+            if self.dashboard is not None:
+                self.dashboard.close()
+        except Exception:
+            pass
+        try:
+            self.job_manager.shutdown()
         except Exception:
             pass
         try:

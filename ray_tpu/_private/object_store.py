@@ -251,6 +251,31 @@ class ObjectRegistry:
             ShmSegment.unlink(shm_name)
 
     # -- admin ---------------------------------------------------------
+    def list_objects(self, limit: int = 1000) -> List[dict]:
+        """State-API view of the object directory (list_objects analog)."""
+        import itertools
+
+        out = []
+        with self._lock:
+            for oid, e in itertools.islice(self._objects.items(), limit):
+                loc = e.loc
+                if loc is None:
+                    where = "pending"
+                elif loc.inline is not None:
+                    where = "inline"
+                elif loc.spilled_path:
+                    where = "spilled"
+                else:
+                    where = loc.node_id or "head"
+                out.append({
+                    "object_id": oid.hex(),
+                    "sealed": e.sealed.is_set(),
+                    "ref_count": e.ref_count,
+                    "size": loc.size if loc else None,
+                    "where": where,
+                })
+        return out
+
     def stats(self) -> dict:
         with self._lock:
             return {

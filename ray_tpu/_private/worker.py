@@ -529,6 +529,11 @@ def main() -> None:
     w.client = client
     client.register_worker()
 
+    # app metrics recorded in this worker flow to the head's /metrics
+    from ray_tpu.util.metrics import MetricsPusher
+
+    _metrics_pusher = MetricsPusher(client.send, origin=worker_id.hex()).start()
+
     # Threaded/async actor support: with max_concurrency > 1 the head
     # pipelines up to N methods at us; a BoundedExecutor-analog pool runs
     # them concurrently (creation always runs inline, before any method).

@@ -1,0 +1,14 @@
+"""ray_tpu.autoscaler — demand-driven cluster scaling.
+
+Analog of ``python/ray/autoscaler``: ``StandardAutoscaler`` reconcile loop
+(``_private/autoscaler.py:167``) over pluggable ``NodeProvider``s
+(``autoscaler/node_provider.py:13``), including a local provider (real
+node_agent subprocesses) and a GCP TPU provider skeleton mirroring the
+reference's ``GCPTPUNode`` (``_private/gcp/node.py:187``).
+"""
+
+from ray_tpu.autoscaler.autoscaler import Monitor, StandardAutoscaler
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.autoscaler.local_node_provider import LocalNodeProvider
+
+__all__ = ["StandardAutoscaler", "Monitor", "NodeProvider", "LocalNodeProvider"]

@@ -1,0 +1,156 @@
+"""StandardAutoscaler: the demand-driven reconcile loop.
+
+Analog of ``python/ray/autoscaler/_private/autoscaler.py:167`` +
+``ResourceDemandScheduler`` (``resource_demand_scheduler.py:103``) +
+``Monitor`` (``monitor.py:126``): each pass reads the head's pending
+resource demand and per-node utilization, bin-packs unmet demand onto the
+worker node type, launches up to ``max_workers`` nodes through the
+provider, and terminates nodes idle past ``idle_timeout_s``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class AutoscalingConfig:
+    min_workers: int = 0
+    max_workers: int = 2
+    idle_timeout_s: float = 30.0
+    # resources of one worker node (the single node-type config)
+    worker_node: Dict[str, float] = field(default_factory=lambda: {"num_cpus": 1})
+    upscaling_speed: int = 2  # max launches per pass
+
+
+def _fits(req: Dict[str, float], avail: Dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
+
+
+class StandardAutoscaler:
+    def __init__(self, head_node, provider: NodeProvider,
+                 config: Optional[AutoscalingConfig] = None):
+        self.head = head_node
+        self.provider = provider
+        self.config = config or AutoscalingConfig()
+        self._idle_since: Dict[str, float] = {}
+
+    # -- demand / utilization views ------------------------------------
+    def pending_demand(self) -> List[Dict[str, float]]:
+        """Resource requests with no node that can fit them now (the
+        LoadMetrics pending-demand feed)."""
+        head = self.head
+        demands: List[Dict[str, float]] = []
+        with head.lock:
+            avail = {nid: dict(ns.available) for nid, ns in head.nodes.items()
+                     if ns.alive}
+            for spec in list(head.pending_tasks):
+                demands.append(dict(spec.get("resources", {})))
+            for art in head.actors.values():
+                if art.info.state == "PENDING_CREATION" and art.worker is None:
+                    demands.append(dict(art.info.creation_spec.get("resources", {})))
+        unmet = []
+        for req in demands:
+            placed = False
+            for nid, a in avail.items():
+                if _fits(req, a):
+                    for k, v in req.items():
+                        a[k] = a.get(k, 0.0) - v
+                    placed = True
+                    break
+            if not placed:
+                unmet.append(req)
+        return unmet
+
+    def _node_is_idle(self, node_id: str) -> bool:
+        head = self.head
+        with head.lock:
+            ns = head.nodes.get(node_id)
+            if ns is None or not ns.alive:
+                return True
+            if ns.ready_queue:
+                return False
+            if any(abs(ns.available.get(k, 0.0) - v) > 1e-9
+                   for k, v in ns.total.items()):
+                return False
+            return True
+
+    # -- one reconcile pass --------------------------------------------
+    def update(self) -> None:
+        cfg = self.config
+        nodes = self.provider.non_terminated_nodes()
+
+        # scale up: unmet demand -> bin-pack onto new worker nodes
+        unmet = self.pending_demand()
+        to_launch = 0
+        if unmet:
+            node_res = {
+                "CPU": float(cfg.worker_node.get("num_cpus", 1)),
+                "TPU": float(cfg.worker_node.get("num_tpus", 0)),
+            }
+            cap: Dict[str, float] = {}
+            for req in unmet:
+                if not _fits(req, cap):
+                    to_launch += 1
+                    for k, v in node_res.items():
+                        cap[k] = cap.get(k, 0.0) + v
+                for k, v in req.items():
+                    cap[k] = cap.get(k, 0.0) - v
+        want = max(cfg.min_workers - len(nodes), 0)
+        to_launch = max(to_launch, want)
+        to_launch = min(to_launch, cfg.upscaling_speed,
+                        cfg.max_workers - len(nodes))
+        if to_launch > 0:
+            logger.info("autoscaler: launching %d worker node(s) for %d unmet "
+                        "demands", to_launch, len(unmet))
+            self.provider.create_node(dict(cfg.worker_node), to_launch)
+
+        # scale down: nodes idle past the timeout (never below min_workers)
+        now = time.time()
+        removable = len(nodes) - cfg.min_workers
+        for nid in nodes:
+            if not self._node_is_idle(nid):
+                self._idle_since.pop(nid, None)
+                continue
+            first = self._idle_since.setdefault(nid, now)
+            if removable > 0 and now - first >= cfg.idle_timeout_s:
+                logger.info("autoscaler: terminating idle node %s", nid)
+                self.provider.terminate_node(nid)
+                self._idle_since.pop(nid, None)
+                removable -= 1
+
+
+class Monitor:
+    """Background reconcile loop (``_private/monitor.py:126`` analog)."""
+
+    def __init__(self, autoscaler: StandardAutoscaler, interval_s: float = 5.0):
+        self.autoscaler = autoscaler
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "Monitor":
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="autoscaler-monitor")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.autoscaler.update()
+            except Exception:
+                logger.exception("autoscaler pass failed")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)

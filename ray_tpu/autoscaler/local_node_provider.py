@@ -1,0 +1,71 @@
+"""Local provider: worker "nodes" are node_agent subprocesses on this host.
+
+The testing role of the reference's ``FakeMultiNodeProvider``
+(``autoscaler/_private/fake_multi_node/node_provider.py:237``) — but the
+nodes are *real* processes joining over TCP with private shm namespaces,
+so the whole autoscaler loop runs against the production join path.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Dict, List
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+
+class LocalNodeProvider(NodeProvider):
+    def __init__(self, head_node, provider_config=None, cluster_name="default"):
+        super().__init__(provider_config, cluster_name)
+        self.head = head_node
+        self._counter = itertools.count(1)
+        self.procs: Dict[str, subprocess.Popen] = {}
+        self._dirs: List[str] = []
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [nid for nid, p in self.procs.items() if p.poll() is None]
+
+    def is_running(self, node_id: str) -> bool:
+        p = self.procs.get(node_id)
+        return p is not None and p.poll() is None
+
+    def create_node(self, node_config: Dict, count: int = 1) -> List[str]:
+        out = []
+        host, port = self.head.tcp_address
+        for _ in range(count):
+            node_id = f"auto-{self.cluster_name}-{next(self._counter)}"
+            shm_sub = tempfile.mkdtemp(prefix=f"rtpu-{node_id}-", dir="/dev/shm")
+            self._dirs.append(shm_sub)
+            env = dict(os.environ)
+            env["RAY_TPU_AUTHKEY"] = self.head.authkey.hex()
+            cmd = [
+                sys.executable, "-m", "ray_tpu._private.node_agent",
+                "--address", f"{host}:{port}",
+                "--node-id", node_id,
+                "--num-cpus", str(int(node_config.get("num_cpus", 1))),
+                "--num-tpus", str(int(node_config.get("num_tpus", 0))),
+                "--shm-dir", shm_sub,
+            ]
+            self.procs[node_id] = subprocess.Popen(cmd, env=env)
+            out.append(node_id)
+        return out
+
+    def terminate_node(self, node_id: str) -> None:
+        p = self.procs.pop(node_id, None)
+        if p is not None:
+            try:
+                p.kill()
+            except Exception:
+                pass
+
+    def shutdown(self) -> None:
+        super().shutdown()
+        import shutil
+
+        for d in self._dirs:
+            shutil.rmtree(d, ignore_errors=True)
+        self._dirs.clear()

@@ -1,0 +1,35 @@
+"""NodeProvider interface (``python/ray/autoscaler/node_provider.py:13``).
+
+A provider owns the lifecycle of worker nodes for one cluster: create,
+terminate, enumerate.  Providers are dumb — all scaling *decisions* live
+in :class:`~ray_tpu.autoscaler.autoscaler.StandardAutoscaler`.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    def __init__(self, provider_config: Optional[dict] = None,
+                 cluster_name: str = "default"):
+        self.provider_config = provider_config or {}
+        self.cluster_name = cluster_name
+
+    def non_terminated_nodes(self) -> List[str]:
+        """IDs of nodes that are launching or running."""
+        raise NotImplementedError
+
+    def is_running(self, node_id: str) -> bool:
+        raise NotImplementedError
+
+    def create_node(self, node_config: Dict, count: int = 1) -> List[str]:
+        """Launch ``count`` nodes; returns their ids (async startup)."""
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: str) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        for nid in list(self.non_terminated_nodes()):
+            self.terminate_node(nid)

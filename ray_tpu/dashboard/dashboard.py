@@ -1,0 +1,176 @@
+"""Head-process dashboard: JSON state API + Prometheus metrics over HTTP.
+
+The reference runs an aiohttp ``DashboardHead`` (``dashboard/head.py:69``)
+with per-module routes (actor/node/job/metrics/state —
+``dashboard/modules/*``) and a Prometheus exporter on the metrics agent
+(``python/ray/_private/metrics_agent.py``).  This serves the same
+surface from a stdlib ThreadingHTTPServer inside the head process:
+
+- ``/``                    tiny HTML cluster summary
+- ``/api/cluster_status``  resources, node/actor/task/object counts
+- ``/api/nodes|actors|tasks|placement_groups|workers|objects``
+- ``/api/jobs``            submitted jobs (job_submission)
+- ``/metrics``             Prometheus text format (runtime + app metrics)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from ray_tpu.util import metrics as metrics_mod
+
+
+def _jsonable(obj):
+    if isinstance(obj, bytes):
+        return obj.hex()
+    if isinstance(obj, dict):
+        return {str(_jsonable(k)): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if hasattr(obj, "__dataclass_fields__"):
+        return {k: _jsonable(getattr(obj, k)) for k in obj.__dataclass_fields__}
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return repr(obj)
+
+
+_INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title></head>
+<body><h2>ray_tpu cluster</h2><pre id="s">loading...</pre>
+<script>fetch('/api/cluster_status').then(r=>r.json()).then(
+ d=>document.getElementById('s').textContent=JSON.stringify(d,null,2));</script>
+<p>endpoints: /api/cluster_status /api/nodes /api/actors /api/tasks
+/api/placement_groups /api/workers /api/objects /api/jobs /metrics</p>
+</body></html>"""
+
+
+class Dashboard:
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+        self.node = node
+
+        dash = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(self):
+                try:
+                    dash._route(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.address = self._server.server_address
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="dashboard")
+        self._thread.start()
+
+    # -- routing -----------------------------------------------------------
+    def _route(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urlparse(req.path)
+        path = parsed.rstrip("/") if isinstance(parsed, str) else parsed.path.rstrip("/")
+        qs = parse_qs(parsed.query)
+        limit = int(qs.get("limit", ["1000"])[0])
+        if path in ("", "/"):
+            self._send(req, _INDEX, ctype="text/html")
+            return
+        if path == "/metrics":
+            self._send(req, self._metrics_text(), ctype="text/plain; version=0.0.4")
+            return
+        if path.startswith("/api/"):
+            payload = self._api(path[len("/api/"):], limit)
+            if payload is None:
+                req.send_response(404)
+                req.end_headers()
+                return
+            self._send(req, json.dumps(payload), ctype="application/json")
+            return
+        req.send_response(404)
+        req.end_headers()
+
+    @staticmethod
+    def _send(req, body: str, ctype: str = "application/json") -> None:
+        data = body.encode()
+        req.send_response(200)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)
+
+    # -- payloads ----------------------------------------------------------
+    def _api(self, what: str, limit: int):
+        node = self.node
+        if what == "cluster_status":
+            snap = node._state_snapshot()
+            return _jsonable({
+                "cluster_resources": snap["cluster_resources"],
+                "available_resources": snap["available_resources"],
+                "object_store": snap["object_store"],
+                "num_nodes": len(snap["nodes"]),
+                "num_actors": len(snap["actors"]),
+                "num_tasks": len(snap["tasks"]),
+                "num_workers": len([w for w in node.workers.values()
+                                    if w.state != "dead"]),
+            })
+        if what == "nodes":
+            return _jsonable(list(node.gcs.nodes.values())[:limit])
+        if what == "actors":
+            return _jsonable(list(node.gcs.actors.values())[:limit])
+        if what == "tasks":
+            return _jsonable(list(node.gcs.tasks.values())[:limit])
+        if what == "placement_groups":
+            return _jsonable(list(node.gcs.placement_groups.values())[:limit])
+        if what == "workers":
+            with node.lock:
+                return [
+                    {"worker_id": w.worker_id.hex(), "node_id": w.node_id,
+                     "state": w.state, "is_actor_worker": w.is_actor_worker,
+                     "pid": w.proc.pid if w.proc else None}
+                    for w in list(node.workers.values())[:limit]
+                ]
+        if what == "objects":
+            return _jsonable(node.registry.list_objects(limit))
+        if what == "jobs":
+            mgr = getattr(node, "job_manager", None)
+            return _jsonable(mgr.list_jobs() if mgr else [])
+        return None
+
+    def _metrics_text(self) -> str:
+        node = self.node
+        from ray_tpu.util.metrics import Gauge
+
+        # refresh runtime gauges at scrape time (metric_defs.cc analog)
+        g = Gauge("ray_tpu_objects_in_store", "objects tracked by the registry")
+        stats = node.registry.stats()
+        g.set(stats["num_objects"])
+        Gauge("ray_tpu_object_store_bytes", "head-local shm bytes").set(stats["bytes_used"])
+        Gauge("ray_tpu_num_workers", "live workers").set(
+            len([w for w in node.workers.values() if w.state != "dead"])
+        )
+        Gauge("ray_tpu_num_nodes", "alive nodes").set(
+            len([ns for ns in node.nodes.values() if ns.alive])
+        )
+        with node.gcs.lock:
+            for state in ("PENDING", "RUNNING", "FINISHED", "FAILED"):
+                n = sum(1 for t in node.gcs.tasks.values() if t.state == state)
+                Gauge("ray_tpu_tasks", "tasks by state").set(n, tags={"state": state})
+        merged = metrics_mod.merge_snapshots(
+            metrics_mod.registry().snapshot(),
+            node.worker_metrics_registry.snapshot(),
+        )
+        return metrics_mod.prometheus_text(merged)
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass

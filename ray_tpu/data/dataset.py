@@ -123,10 +123,11 @@ class Dataset:
         return len(self._blocks)
 
     def count(self) -> int:
+        from ray_tpu.data.shuffle import compute_counts
+
         refs, counts = self._plan.execute()
         if counts is None:
-            task = ray_tpu.remote(num_cpus=1)(lambda b: BlockAccessor(b).num_rows())
-            counts = ray_tpu.get([task.remote(r) for r in refs])
+            counts = compute_counts(refs, None)
             self._plan._out = (refs, counts)
         return sum(counts)
 
@@ -356,22 +357,26 @@ class Dataset:
         SENTINEL = object()
         stop = threading.Event()
 
+        def put_or_stop(item) -> bool:
+            """Stop-aware put; True if delivered."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue_mod.Full:
+                    continue
+            return False
+
         def fetcher():
             try:
                 for ref in refs:
                     block = ray_tpu.get(ref)
-                    while not stop.is_set():
-                        try:
-                            q.put(block, timeout=0.2)
-                            break
-                        except queue_mod.Full:
-                            continue
-                    if stop.is_set():
+                    if not put_or_stop(block):
                         return  # consumer abandoned the iterator
             except BaseException as e:  # surfaced on the consumer side
-                q.put(e)
+                put_or_stop(e)
                 return
-            q.put(SENTINEL)
+            put_or_stop(SENTINEL)
 
         t = threading.Thread(target=fetcher, daemon=True, name="iter-batches-prefetch")
         t.start()

@@ -1,0 +1,15 @@
+from ray_tpu.experimental.state.api import (
+    list_actors,
+    list_jobs,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    list_workers,
+    summarize_tasks,
+)
+
+__all__ = [
+    "list_actors", "list_nodes", "list_tasks", "list_objects",
+    "list_placement_groups", "list_workers", "list_jobs", "summarize_tasks",
+]

@@ -1,0 +1,69 @@
+"""Cluster state API — ``ray.experimental.state.api`` analog.
+
+``list_actors``/``list_tasks``/``list_objects``/``summarize_*``
+(reference ``python/ray/experimental/state/api.py:729,952,996,1269-1333``,
+aggregated by ``dashboard/state_aggregator.py``): live introspection of
+the control plane, served by the head's ``list_state`` RPC and also over
+HTTP by the dashboard (``/api/...``).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Dict, List, Optional
+
+
+def _client():
+    from ray_tpu._private.worker import global_worker
+
+    if not global_worker.connected:
+        raise RuntimeError("ray_tpu.init() must run before the state API")
+    return global_worker.client
+
+
+def _list(what: str, limit: int) -> List[dict]:
+    reply = _client().request({"type": "list_state", "what": what, "limit": limit})
+    return reply["value"]
+
+
+def list_actors(limit: int = 1000) -> List[dict]:
+    return _list("actors", limit)
+
+
+def list_nodes(limit: int = 1000) -> List[dict]:
+    return _list("nodes", limit)
+
+
+def list_tasks(limit: int = 1000) -> List[dict]:
+    return _list("tasks", limit)
+
+
+def list_objects(limit: int = 1000) -> List[dict]:
+    return _list("objects", limit)
+
+
+def list_placement_groups(limit: int = 1000) -> List[dict]:
+    return _list("placement_groups", limit)
+
+
+def list_workers(limit: int = 1000) -> List[dict]:
+    return _list("workers", limit)
+
+
+def list_jobs(limit: int = 1000) -> List[dict]:
+    return _list("jobs", limit)
+
+
+def summarize_tasks() -> Dict[str, Dict[str, int]]:
+    """Task counts grouped by name and state (summarize_tasks analog)."""
+    by_name: Dict[str, Counter] = {}
+    for t in list_tasks(limit=100_000):
+        by_name.setdefault(t["name"], Counter())[t["state"]] += 1
+    return {name: dict(states) for name, states in by_name.items()}
+
+
+def summarize_actors() -> Dict[str, Dict[str, int]]:
+    by_cls: Dict[str, Counter] = {}
+    for a in list_actors(limit=100_000):
+        by_cls.setdefault(a["class_name"], Counter())[a["state"]] += 1
+    return {cls: dict(states) for cls, states in by_cls.items()}

@@ -1,0 +1,203 @@
+"""ray_tpu CLI — ``ray start/stop/status/...`` analog.
+
+Reference: ``python/ray/scripts/scripts.py`` (cluster lifecycle) and
+``dashboard/modules/job/cli.py`` (job commands).  Run as
+``python -m ray_tpu <command>``:
+
+    start --head [--num-cpus N --num-tpus N]   run a head in the foreground
+    start --address host:port [--authkey HEX]  join as a worker node agent
+    stop                                       kill the last started head
+    status                                     cluster resources/state
+    list {actors,tasks,nodes,objects,workers,placement_groups,jobs}
+    submit -- <entrypoint...>                  submit a job
+    job-logs <job_id> / job-stop <job_id>
+    timeline [--out FILE]                      chrome-trace of task events
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+SESSION_FILE = "/tmp/ray_tpu/last_session.json"
+
+
+def _session() -> dict:
+    try:
+        with open(SESSION_FILE) as f:
+            return json.load(f)
+    except OSError:
+        raise SystemExit("no running ray_tpu session found (start one with "
+                         "`python -m ray_tpu start --head`)")
+
+
+def _connect():
+    import ray_tpu
+
+    ray_tpu.init(address="auto")
+    return ray_tpu
+
+
+def cmd_start(args) -> None:
+    if args.head:
+        import ray_tpu
+
+        ray_tpu.init(num_cpus=args.num_cpus, num_tpus=args.num_tpus)
+        from ray_tpu._private.worker import global_worker
+
+        node = global_worker.node
+        host, port = node.tcp_address
+        print(f"ray_tpu head running: tcp://{host}:{port}")
+        print(f"authkey: {node.authkey.hex()}")
+        if node.dashboard:
+            print("dashboard: http://%s:%d" % tuple(node.dashboard.address))
+        print("join with: python -m ray_tpu start "
+              f"--address {host}:{port} --authkey {node.authkey.hex()}")
+        print("Ctrl-C to stop.")
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            ray_tpu.shutdown()
+    elif args.address:
+        from ray_tpu._private.node_agent import NodeAgent
+
+        authkey = bytes.fromhex(args.authkey or os.environ["RAY_TPU_AUTHKEY"])
+        agent = NodeAgent(
+            args.address, authkey, num_cpus=args.num_cpus,
+            num_tpus=args.num_tpus, shm_dir=args.shm_dir,
+        )
+        agent.serve_forever()
+    else:
+        raise SystemExit("start needs --head or --address")
+
+
+def cmd_stop(_args) -> None:
+    sess = _session()
+    pid = sess.get("pid")
+    try:
+        os.kill(pid, signal.SIGTERM)
+        print(f"sent SIGTERM to head pid {pid}")
+    except OSError as e:
+        print(f"head pid {pid}: {e}")
+
+
+def cmd_status(_args) -> None:
+    rt = _connect()
+    snap = rt._private.worker.global_worker.client.request(
+        {"type": "state_snapshot"})["value"]
+    print(json.dumps({
+        "cluster_resources": snap["cluster_resources"],
+        "available_resources": snap["available_resources"],
+        "object_store": snap["object_store"],
+        "nodes": len(snap["nodes"]),
+        "actors": len(snap["actors"]),
+        "tasks": len(snap["tasks"]),
+    }, indent=2, default=repr))
+
+
+def cmd_list(args) -> None:
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    rows = getattr(state, f"list_{args.what}")(limit=args.limit)
+    print(json.dumps(rows, indent=2, default=repr))
+
+
+def cmd_submit(args) -> None:
+    sess = _session()
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(sess["address"],
+                                 authkey=bytes.fromhex(sess["authkey"]))
+    import shlex
+
+    parts = args.entrypoint
+    if parts and parts[0] == "--":  # argparse.REMAINDER keeps the separator
+        parts = parts[1:]
+    entry = shlex.join(parts)  # preserve each argv token through the shell
+    job_id = client.submit_job(entrypoint=entry)
+    print(f"submitted {job_id}: {entry}")
+    if args.wait:
+        status = client.wait_until_finish(job_id, timeout=args.timeout)
+        print(client.get_job_logs(job_id), end="")
+        print(f"job {job_id}: {status}")
+        sys.exit(0 if status == "SUCCEEDED" else 1)
+
+
+def cmd_job_logs(args) -> None:
+    sess = _session()
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(sess["address"], authkey=bytes.fromhex(sess["authkey"]))
+    print(client.get_job_logs(args.job_id), end="")
+
+
+def cmd_job_stop(args) -> None:
+    sess = _session()
+    from ray_tpu.job_submission import JobSubmissionClient
+
+    client = JobSubmissionClient(sess["address"], authkey=bytes.fromhex(sess["authkey"]))
+    print("stopped" if client.stop_job(args.job_id) else "not running")
+
+
+def cmd_timeline(args) -> None:
+    _connect()
+    from ray_tpu.util.timeline import timeline_dump
+
+    path = timeline_dump(args.out)
+    print(f"wrote chrome trace to {path} (open in chrome://tracing)")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(prog="ray_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("start", help="start a head or join as a node")
+    s.add_argument("--head", action="store_true")
+    s.add_argument("--address", default=None, help="head host:port to join")
+    s.add_argument("--authkey", default=None)
+    s.add_argument("--num-cpus", type=int, default=None)
+    s.add_argument("--num-tpus", type=int, default=None)
+    s.add_argument("--shm-dir", default=None)
+    s.set_defaults(fn=cmd_start)
+
+    sub.add_parser("stop", help="stop the last started head").set_defaults(fn=cmd_stop)
+    sub.add_parser("status", help="cluster summary").set_defaults(fn=cmd_status)
+
+    s = sub.add_parser("list", help="state API tables")
+    s.add_argument("what", choices=["actors", "tasks", "nodes", "objects",
+                                    "workers", "placement_groups", "jobs"])
+    s.add_argument("--limit", type=int, default=100)
+    s.set_defaults(fn=cmd_list)
+
+    s = sub.add_parser("submit", help="submit a job entrypoint")
+    s.add_argument("--wait", action="store_true")
+    s.add_argument("--timeout", type=float, default=600.0)
+    s.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    s.set_defaults(fn=cmd_submit)
+
+    s = sub.add_parser("job-logs")
+    s.add_argument("job_id")
+    s.set_defaults(fn=cmd_job_logs)
+
+    s = sub.add_parser("job-stop")
+    s.add_argument("job_id")
+    s.set_defaults(fn=cmd_job_stop)
+
+    s = sub.add_parser("timeline", help="dump chrome-trace task timeline")
+    s.add_argument("--out", default=None)
+    s.set_defaults(fn=cmd_timeline)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,194 @@
+"""Application + runtime metrics (Counter/Gauge/Histogram).
+
+Analog of ``ray.util.metrics`` (``python/ray/util/metrics.py``) over the
+reference's OpenCensus pipeline (``src/ray/stats/metric.h:103-206``,
+exported through the node metrics agent to Prometheus).  Here every
+process keeps a local registry; workers ship periodic snapshots to the
+head over their control connection, and the head's dashboard serves the
+merged registry in Prometheus text exposition format at ``/metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+class _Registry:
+    def __init__(self):
+        self.lock = threading.Lock()
+        # name -> {"type", "help", "values": {labelkey: value-or-histogram}}
+        self.metrics: Dict[str, dict] = {}
+
+    def register(self, name: str, mtype: str, help_: str) -> dict:
+        with self.lock:
+            m = self.metrics.setdefault(
+                name, {"type": mtype, "help": help_, "values": {}}
+            )
+            if m["type"] != mtype:
+                raise ValueError(f"metric {name} already registered as {m['type']}")
+            return m
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self.lock:
+            return {
+                name: {"type": m["type"], "help": m["help"],
+                       "values": dict(m["values"])}
+                for name, m in self.metrics.items()
+            }
+
+    def merge(self, origin: str, snap: Dict[str, dict]) -> None:
+        """Fold a remote process's snapshot in, labeled by origin."""
+        with self.lock:
+            for name, m in snap.items():
+                cur = self.metrics.setdefault(
+                    name, {"type": m["type"], "help": m["help"], "values": {}}
+                )
+                for key, value in m["values"].items():
+                    cur["values"][tuple(key) + (("origin", origin),)] = value
+
+
+_global = _Registry()
+
+
+def _labelkey(tags: Optional[Dict[str, str]]) -> LabelKey:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        self._name = name
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: Dict[str, str] = {}
+        self._m = _global.register(name, self._TYPE, description)
+
+    def set_default_tags(self, tags: Dict[str, str]) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[Dict[str, str]]) -> LabelKey:
+        merged = dict(self._default_tags)
+        merged.update(tags or {})
+        return _labelkey(merged)
+
+
+class Counter(Metric):
+    _TYPE = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(tags)
+        with _global.lock:
+            vals = self._m["values"]
+            vals[key] = vals.get(key, 0.0) + value
+
+
+class Gauge(Metric):
+    _TYPE = "gauge"
+
+    def set(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        with _global.lock:
+            self._m["values"][self._key(tags)] = float(value)
+
+
+DEFAULT_BOUNDARIES = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 60)
+
+
+class Histogram(Metric):
+    _TYPE = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[List[float]] = None,
+                 tag_keys: Optional[Tuple[str, ...]] = None):
+        self.boundaries = tuple(boundaries or DEFAULT_BOUNDARIES)
+        super().__init__(name, description, tag_keys)
+
+    def observe(self, value: float, tags: Optional[Dict[str, str]] = None) -> None:
+        key = self._key(tags)
+        with _global.lock:
+            vals = self._m["values"]
+            h = vals.get(key)
+            if h is None:
+                h = {"buckets": [0] * (len(self.boundaries) + 1),
+                     "bounds": self.boundaries, "sum": 0.0, "count": 0}
+                vals[key] = h
+            import bisect
+
+            h["buckets"][bisect.bisect_left(self.boundaries, value)] += 1
+            h["sum"] += value
+            h["count"] += 1
+
+
+def registry() -> _Registry:
+    return _global
+
+
+def merge_snapshots(*snaps: Dict[str, dict]) -> Dict[str, dict]:
+    """Combine registry snapshots (head + worker-reported) for exposition."""
+    out: Dict[str, dict] = {}
+    for snap in snaps:
+        for name, m in snap.items():
+            cur = out.setdefault(
+                name, {"type": m["type"], "help": m["help"], "values": {}}
+            )
+            cur["values"].update(m["values"])
+    return out
+
+
+def prometheus_text(snap: Optional[Dict[str, dict]] = None) -> str:
+    """Render a registry snapshot in Prometheus exposition format (the
+    ``prometheus_exporter.py`` analog)."""
+    snap = snap if snap is not None else _global.snapshot()
+    out: List[str] = []
+    for name, m in sorted(snap.items()):
+        if m["help"]:
+            out.append(f"# HELP {name} {m['help']}")
+        out.append(f"# TYPE {name} {m['type']}")
+        for key, value in sorted(m["values"].items()):
+            labels = ",".join(f'{k}="{v}"' for k, v in key)
+            suffix = f"{{{labels}}}" if labels else ""
+            if m["type"] == "histogram" and isinstance(value, dict):
+                acc = 0
+                for bound, cnt in zip(list(value["bounds"]) + ["+Inf"], value["buckets"]):
+                    acc += cnt
+                    lb = (labels + "," if labels else "") + f'le="{bound}"'
+                    out.append(f"{name}_bucket{{{lb}}} {acc}")
+                out.append(f"{name}_sum{suffix} {value['sum']}")
+                out.append(f"{name}_count{suffix} {value['count']}")
+            else:
+                out.append(f"{name}{suffix} {value}")
+    return "\n".join(out) + "\n"
+
+
+class MetricsPusher:
+    """Background thread shipping this process's registry to the head
+    (the per-node metrics-agent push path)."""
+
+    def __init__(self, send_fn, origin: str, interval_s: float = 5.0):
+        self._send = send_fn
+        self._origin = origin
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="metrics-pusher")
+
+    def start(self) -> "MetricsPusher":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._interval):
+            snap = _global.snapshot()
+            if not snap:
+                continue
+            try:
+                self._send({"type": "metrics_report", "origin": self._origin,
+                            "metrics": snap})
+            except Exception:
+                return
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,51 @@
+"""Chrome-trace timeline of task execution — ``ray timeline`` analog.
+
+The reference batches per-task profile events to the GCS and dumps
+chrome-trace JSON (``python/ray/_private/state.py:414``
+``chrome_tracing_dump``, ``:829 timeline``; worker-side ``Profiler``
+``src/ray/core_worker/profiling.h:30``).  Here the head's task table
+carries begin/end/node for every task; this renders it in the trace-event
+format that chrome://tracing / Perfetto load directly.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import List, Optional
+
+
+def timeline_events() -> List[dict]:
+    from ray_tpu._private.worker import global_worker
+
+    tasks = global_worker.client.request(
+        {"type": "list_state", "what": "tasks", "limit": 100_000}
+    )["value"]
+    events: List[dict] = []
+    now = time.time()
+    for t in tasks:
+        start = t.get("start_time")
+        if start is None:
+            continue
+        end = t.get("end_time") or now
+        events.append({
+            "name": t.get("name", "task"),
+            "cat": "task",
+            "ph": "X",  # complete event
+            "ts": start * 1e6,
+            "dur": max(0.0, (end - start) * 1e6),
+            "pid": t.get("node_id") or "pending",
+            "tid": (t.get("task_id") or "")[:8],
+            "args": {"state": t.get("state"), "task_id": t.get("task_id")},
+        })
+    return events
+
+
+def timeline_dump(path: Optional[str] = None) -> str:
+    path = path or f"/tmp/ray_tpu/timeline-{int(time.time())}.json"
+    import os
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(timeline_events(), f)
+    return path
