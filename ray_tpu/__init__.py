@@ -36,6 +36,7 @@ def init(
     resources: Optional[Dict[str, float]] = None,
     ignore_reinit_error: bool = True,
     _authkey: Optional[bytes] = None,
+    _gcs_persistence_path: Optional[str] = None,
     **_kwargs,
 ) -> None:
     """Start (or join) a cluster and connect as the driver.
@@ -97,7 +98,8 @@ def init(
                 except Exception:
                     pass
         else:
-            node = Node(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
+            node = Node(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+                        gcs_persistence_path=_gcs_persistence_path)
             client = CoreClient(node.address, node.authkey)
         client.register_client()
         global_worker.mode = "driver"

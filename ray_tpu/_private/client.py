@@ -44,6 +44,8 @@ class CoreClient:
         self._pending: Dict[int, dict] = {}
         self._pending_lock = threading.Lock()
         self._exec_queue: "queue.Queue[dict]" = None  # set by worker loop
+        self._subscriptions: Dict[str, list] = {}  # channel -> callbacks
+        self._pubsub_queue = None  # created on first subscribe
         self.worker_id = worker_id
         self.node_id = node_id
         self.closed = False
@@ -75,6 +77,11 @@ class CoreClient:
                 if slot is not None:
                     slot["reply"] = msg
                     slot["event"].set()
+            elif msg.get("type") == "pubsub":
+                # dispatch on a side thread: a callback that itself issues
+                # a request must not block the only thread that can ever
+                # deliver that request's reply
+                self._pubsub_dispatch(msg)
             elif self._exec_queue is not None:
                 self._exec_queue.put(msg)
 
@@ -97,6 +104,50 @@ class CoreClient:
     # -- API ---------------------------------------------------------------
     def register_client(self) -> None:
         self.send({"type": "register_client"})
+
+    def _pubsub_dispatch(self, msg: dict) -> None:
+        q = self._pubsub_queue
+        if q is not None:
+            q.put(msg)
+
+    def _pubsub_loop(self) -> None:
+        while not self.closed:
+            msg = self._pubsub_queue.get()
+            if msg is None:
+                return
+            for cb in list(self._subscriptions.get(msg["channel"], [])):
+                try:
+                    cb(msg["data"])
+                except Exception:
+                    pass
+
+    def subscribe(self, channel: str, callback) -> None:
+        """Register a callback for a pubsub channel (Subscriber analog).
+        Callbacks run on a dedicated dispatcher thread and may use the
+        full client API."""
+        if self._pubsub_queue is None:
+            import queue as _queue
+
+            self._pubsub_queue = _queue.Queue()
+            threading.Thread(target=self._pubsub_loop, daemon=True,
+                             name="pubsub-dispatch").start()
+        first = channel not in self._subscriptions
+        self._subscriptions.setdefault(channel, []).append(callback)
+        if first:
+            self.send({"type": "subscribe", "channel": channel})
+
+    def unsubscribe(self, channel: str, callback=None) -> None:
+        cbs = self._subscriptions.get(channel, [])
+        if callback is None:
+            cbs.clear()
+        elif callback in cbs:
+            cbs.remove(callback)
+        if not cbs:
+            self._subscriptions.pop(channel, None)
+            self.send({"type": "unsubscribe", "channel": channel})
+
+    def publish(self, channel: str, data) -> None:
+        self.send({"type": "publish", "channel": channel, "data": data})
 
     def register_worker(self) -> None:
         self.send({
@@ -169,6 +220,8 @@ class CoreClient:
 
     def close(self) -> None:
         self.closed = True
+        if self._pubsub_queue is not None:
+            self._pubsub_queue.put(None)  # end the dispatcher thread
         try:
             self.conn.close()
         except Exception:

@@ -44,13 +44,14 @@ class Config:
     maximum_startup_concurrency: int = 8
     # Seconds an idle worker is kept before being reaped.
     idle_worker_killing_time_threshold_s: float = 300.0
+    # Agent liveness probing (GcsHealthCheckManager analog): ping period
+    # and the silence window after which a node is declared dead.
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 15.0
 
     # -- fault tolerance ---------------------------------------------------
     task_max_retries: int = 3
     actor_max_restarts: int = 0
-    # Health-check cadence for worker processes (GcsHealthCheckManager analog).
-    health_check_period_s: float = 1.0
-
     # -- timeouts ----------------------------------------------------------
     get_timeout_warning_s: float = 60.0
     worker_register_timeout_s: float = 30.0

@@ -156,6 +156,9 @@ class NodeState:
     agent_conn: Optional[Connection] = None
     agent_send_lock: Optional[threading.Lock] = None
     fetch_addr: Optional[tuple] = None
+    # health checking (GcsHealthCheckManager analog)
+    last_heartbeat: float = field(default_factory=time.time)
+    last_ping: float = 0.0
 
     def agent_send(self, msg: dict) -> None:
         if self.agent_conn is None:
@@ -224,6 +227,7 @@ class Node:
         num_tpus: Optional[int] = None,
         resources: Optional[Dict[str, float]] = None,
         session_dir: Optional[str] = None,
+        gcs_persistence_path: Optional[str] = None,
     ):
         from ray_tpu._private.resource_spec import autodetect_resources
 
@@ -254,6 +258,21 @@ class Node:
         )
         self.gcs = GcsTables()
 
+        # GCS fault tolerance: with a persistent store, replay the prior
+        # head's metadata (GcsInitData analog) and flush periodically
+        self.gcs_store = None
+        persist = gcs_persistence_path or os.environ.get("RAY_TPU_GCS_PERSISTENCE")
+        if persist:
+            from ray_tpu._private.gcs_storage import SqliteStoreClient
+
+            existed = os.path.exists(persist)
+            self.gcs_store = SqliteStoreClient(persist)
+            if existed:
+                self.gcs.replay(self.gcs_store)
+                logger.info("replayed GCS state from %s (%d kv namespaces, "
+                            "%d historical actors)", persist,
+                            len(self.gcs.kv), len(self.gcs.actors))
+
         self.nodes: Dict[str, NodeState] = {}
         self.actors: Dict[bytes, ActorRuntime] = {}
         self.pgs: Dict[bytes, PGRuntime] = {}
@@ -262,6 +281,9 @@ class Node:
         self.running: Dict[bytes, dict] = {}  # task_id -> {spec, worker, node_id, held, tpu_ids}
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.pending_gets: List[_PendingGet] = []
+        # pubsub channels: long-poll publisher/subscriber analog
+        # (src/ray/pubsub/ — node_change/error/log + app channels)
+        self.subscribers: Dict[str, List[Connection]] = {}
         self._req_counter = 0
         self._shutdown = False
         self._head_node_id: str
@@ -398,6 +420,7 @@ class Node:
             except Exception:
                 pass
             self._on_worker_death(w, reason=f"node {node_id} removed")
+        self.publish("node_change", {"node_id": node_id, "alive": False})
         with self.lock:
             self.cond.notify_all()
 
@@ -449,7 +472,11 @@ class Node:
                 elif mtype == "worker_exited":
                     self._on_remote_worker_exited(msg)
                 elif mtype == "pong":
-                    pass
+                    if agent_node_id is not None:
+                        with self.lock:
+                            ns = self.nodes.get(agent_node_id)
+                            if ns is not None:
+                                ns.last_heartbeat = time.time()
                 else:
                     self._handle_message(conn, handle, msg)
         finally:
@@ -481,6 +508,8 @@ class Node:
             ns.fetch_addr = tuple(msg["fetch_addr"]) if msg.get("fetch_addr") else None
             self.cond.notify_all()
         logger.info("node %s joined with %s", node_id, msg["resources"])
+        self.publish("node_change", {"node_id": node_id, "alive": True,
+                                     "resources": msg["resources"]})
         return node_id
 
     def _on_remote_worker_exited(self, msg: dict) -> None:
@@ -552,6 +581,18 @@ class Node:
                                "value": (aid, info.creation_spec.get("class_blob_id") if info else None)})
         elif mtype == "state_snapshot":
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"], "value": self._state_snapshot()})
+        elif mtype == "subscribe":
+            with self.lock:
+                subs = self.subscribers.setdefault(msg["channel"], [])
+                if conn not in subs:
+                    subs.append(conn)
+        elif mtype == "unsubscribe":
+            with self.lock:
+                subs = self.subscribers.get(msg["channel"], [])
+                if conn in subs:
+                    subs.remove(conn)
+        elif mtype == "publish":
+            self.publish(msg["channel"], msg["data"])
         elif mtype == "whoami":
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
                                "value": {"session_id": self.session_id,
@@ -881,9 +922,16 @@ class Node:
             pg.conn_send(reply)
 
     def _timeout_loop(self) -> None:
+        ticks = 0
         while not self._shutdown:
             time.sleep(0.05)
             self._service_pending_gets()
+            ticks += 1
+            if self.gcs_store is not None and ticks % 40 == 0:  # every ~2s
+                try:
+                    self.gcs.flush(self.gcs_store)
+                except Exception:
+                    logger.warning("gcs flush failed:\n%s", traceback.format_exc())
 
     # ------------------------------------------------------------------
     # tasks
@@ -907,6 +955,9 @@ class Node:
         for oid in spec["return_ids"]:
             loc, _ = store_value(ObjectRef(oid), err, is_error=True)
             self.registry.seal(oid, loc)
+        self.publish("error", {"task": spec.get("name"),
+                               "task_id": spec["task_id"].hex(),
+                               "error": str(err)})
         with self.lock:
             ti = self.gcs.tasks.get(spec["task_id"])
             if ti:
@@ -997,6 +1048,34 @@ class Node:
             )
         for w in reap:
             self._kill_worker(w, reason="idle runtime_env worker reaped")
+        self._health_check(now)
+
+    def _health_check(self, now: float) -> None:
+        """Active agent liveness probing (GcsHealthCheckManager analog,
+        ``gcs_health_check_manager.h:39``): a hung agent whose TCP
+        connection stays open is detected by missed pongs, not only by a
+        connection close."""
+        period = self.cfg.health_check_period_s
+        timeout = self.cfg.health_check_timeout_s
+        ping_nodes, dead_nodes = [], []
+        with self.lock:
+            for ns in self.nodes.values():
+                if not ns.alive or ns.agent_conn is None:
+                    continue
+                if now - ns.last_heartbeat > timeout:
+                    dead_nodes.append(ns.node_id)
+                elif now - ns.last_ping >= period:
+                    ns.last_ping = now
+                    ping_nodes.append(ns)
+        for ns in ping_nodes:
+            try:
+                ns.agent_send({"type": "ping", "ts": now})
+            except (OSError, ValueError):
+                pass  # conn-close path will reap it
+        for node_id in dead_nodes:
+            logger.warning("node %s failed health check (%.0fs without a pong)",
+                           node_id, timeout)
+            self.remove_node_state(node_id)
 
     def _kill_worker(self, w: WorkerHandle, reason: str) -> None:
         self._on_worker_death(w, reason=reason)
@@ -1011,6 +1090,26 @@ class Node:
                                    "worker_id": w.worker_id.hex()})
         except Exception:
             pass
+
+    def publish(self, channel: str, data) -> None:
+        """Fan a message out to every subscriber of ``channel`` (the
+        Publisher half of src/ray/pubsub/; dead conns are pruned)."""
+        with self.lock:
+            subs = list(self.subscribers.get(channel, []))
+        dead = []
+        for conn in subs:
+            lock = self._conn_lock(conn)
+            try:
+                with lock:
+                    conn.send({"type": "pubsub", "channel": channel, "data": data})
+            except (OSError, ValueError):
+                dead.append(conn)
+        if dead:
+            with self.lock:
+                subs = self.subscribers.get(channel, [])
+                for conn in dead:
+                    if conn in subs:
+                        subs.remove(conn)
 
     def _broadcast_unlink(self, shm_name: str) -> None:
         """Registry callback: a deleted object's segment (origin or pulled
@@ -1193,6 +1292,9 @@ class Node:
         # specs keep their pins — they are re-dispatched on restart.
         if full_spec is not None and not spec.get("is_actor_creation"):
             self._release_spec_pins(full_spec)
+        if msg.get("failed"):
+            self.publish("error", {"task": spec.get("name"), "task_id": tid.hex(),
+                                   "error": msg.get("error_str")})
         with self.lock:
             ti = self.gcs.tasks.get(tid)
             if ti:
@@ -1680,6 +1782,12 @@ class Node:
         from ray_tpu._private import object_transfer
 
         object_transfer.reset()
+        if self.gcs_store is not None:
+            try:
+                self.gcs.flush(self.gcs_store)
+                self.gcs_store.close()
+            except Exception:
+                pass
         self.registry.shutdown()
         from ray_tpu._private import shm as shm_mod
 

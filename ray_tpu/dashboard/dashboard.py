@@ -76,10 +76,10 @@ class Dashboard:
     # -- routing -----------------------------------------------------------
     def _route(self, req: BaseHTTPRequestHandler) -> None:
         parsed = urlparse(req.path)
-        path = parsed.rstrip("/") if isinstance(parsed, str) else parsed.path.rstrip("/")
+        path = parsed.path.rstrip("/")
         qs = parse_qs(parsed.query)
         limit = int(qs.get("limit", ["1000"])[0])
-        if path in ("", "/"):
+        if path == "":
             self._send(req, _INDEX, ctype="text/html")
             return
         if path == "/metrics":
@@ -110,6 +110,9 @@ class Dashboard:
         node = self.node
         if what == "cluster_status":
             snap = node._state_snapshot()
+            with node.lock:
+                num_workers = len([w for w in node.workers.values()
+                                   if w.state != "dead"])
             return _jsonable({
                 "cluster_resources": snap["cluster_resources"],
                 "available_resources": snap["available_resources"],
@@ -117,31 +120,13 @@ class Dashboard:
                 "num_nodes": len(snap["nodes"]),
                 "num_actors": len(snap["actors"]),
                 "num_tasks": len(snap["tasks"]),
-                "num_workers": len([w for w in node.workers.values()
-                                    if w.state != "dead"]),
+                "num_workers": num_workers,
             })
-        if what == "nodes":
-            return _jsonable(list(node.gcs.nodes.values())[:limit])
-        if what == "actors":
-            return _jsonable(list(node.gcs.actors.values())[:limit])
-        if what == "tasks":
-            return _jsonable(list(node.gcs.tasks.values())[:limit])
-        if what == "placement_groups":
-            return _jsonable(list(node.gcs.placement_groups.values())[:limit])
-        if what == "workers":
-            with node.lock:
-                return [
-                    {"worker_id": w.worker_id.hex(), "node_id": w.node_id,
-                     "state": w.state, "is_actor_worker": w.is_actor_worker,
-                     "pid": w.proc.pid if w.proc else None}
-                    for w in list(node.workers.values())[:limit]
-                ]
-        if what == "objects":
-            return _jsonable(node.registry.list_objects(limit))
-        if what == "jobs":
-            mgr = getattr(node, "job_manager", None)
-            return _jsonable(mgr.list_jobs() if mgr else [])
-        return None
+        try:
+            # the state-API backend takes the right locks and strips blobs
+            return _jsonable(node._list_state(what, limit))
+        except ValueError:
+            return None
 
     def _metrics_text(self) -> str:
         node = self.node
@@ -152,12 +137,11 @@ class Dashboard:
         stats = node.registry.stats()
         g.set(stats["num_objects"])
         Gauge("ray_tpu_object_store_bytes", "head-local shm bytes").set(stats["bytes_used"])
-        Gauge("ray_tpu_num_workers", "live workers").set(
-            len([w for w in node.workers.values() if w.state != "dead"])
-        )
-        Gauge("ray_tpu_num_nodes", "alive nodes").set(
-            len([ns for ns in node.nodes.values() if ns.alive])
-        )
+        with node.lock:
+            n_workers = len([w for w in node.workers.values() if w.state != "dead"])
+            n_nodes = len([ns for ns in node.nodes.values() if ns.alive])
+        Gauge("ray_tpu_num_workers", "live workers").set(n_workers)
+        Gauge("ray_tpu_num_nodes", "alive nodes").set(n_nodes)
         with node.gcs.lock:
             for state in ("PENDING", "RUNNING", "FINISHED", "FAILED"):
                 n = sum(1 for t in node.gcs.tasks.values() if t.state == state)

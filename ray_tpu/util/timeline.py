@@ -45,7 +45,9 @@ def timeline_dump(path: Optional[str] = None) -> str:
     path = path or f"/tmp/ray_tpu/timeline-{int(time.time())}.json"
     import os
 
-    os.makedirs(os.path.dirname(path), exist_ok=True)
+    parent = os.path.dirname(path)
+    if parent:
+        os.makedirs(parent, exist_ok=True)
     with open(path, "w") as f:
         json.dump(timeline_events(), f)
     return path
