@@ -46,6 +46,7 @@ class CoreClient:
         self._exec_queue: "queue.Queue[dict]" = None  # set by worker loop
         self._subscriptions: Dict[str, list] = {}  # channel -> callbacks
         self._pubsub_queue = None  # created on first subscribe
+        self._pubsub_lock = threading.Lock()
         self.worker_id = worker_id
         self.node_id = node_id
         self.closed = False
@@ -111,6 +112,9 @@ class CoreClient:
             q.put(msg)
 
     def _pubsub_loop(self) -> None:
+        import logging
+
+        log = logging.getLogger(__name__)
         while not self.closed:
             msg = self._pubsub_queue.get()
             if msg is None:
@@ -119,20 +123,22 @@ class CoreClient:
                 try:
                     cb(msg["data"])
                 except Exception:
-                    pass
+                    log.exception("pubsub callback for channel %r failed",
+                                  msg["channel"])
 
     def subscribe(self, channel: str, callback) -> None:
         """Register a callback for a pubsub channel (Subscriber analog).
         Callbacks run on a dedicated dispatcher thread and may use the
         full client API."""
-        if self._pubsub_queue is None:
-            import queue as _queue
+        with self._pubsub_lock:
+            if self._pubsub_queue is None:
+                import queue as _queue
 
-            self._pubsub_queue = _queue.Queue()
-            threading.Thread(target=self._pubsub_loop, daemon=True,
-                             name="pubsub-dispatch").start()
-        first = channel not in self._subscriptions
-        self._subscriptions.setdefault(channel, []).append(callback)
+                self._pubsub_queue = _queue.Queue()
+                threading.Thread(target=self._pubsub_loop, daemon=True,
+                                 name="pubsub-dispatch").start()
+            first = channel not in self._subscriptions
+            self._subscriptions.setdefault(channel, []).append(callback)
         if first:
             self.send({"type": "subscribe", "channel": channel})
 

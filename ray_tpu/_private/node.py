@@ -284,6 +284,9 @@ class Node:
         # pubsub channels: long-poll publisher/subscriber analog
         # (src/ray/pubsub/ — node_change/error/log + app channels)
         self.subscribers: Dict[str, List[Connection]] = {}
+        import queue as _queue
+
+        self._pub_queue: "_queue.Queue" = _queue.Queue()
         self._req_counter = 0
         self._shutdown = False
         self._head_node_id: str
@@ -322,6 +325,12 @@ class Node:
         t.start()
         self._threads.append(t)
         t = threading.Thread(target=self._timeout_loop, name="timeouts", daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._publisher_loop, name="publisher", daemon=True)
+        t.start()
+        self._threads.append(t)
+        t = threading.Thread(target=self._gcs_flush_loop, name="gcs-flush", daemon=True)
         t.start()
         self._threads.append(t)
         # Dashboard + merged worker metrics (DashboardHead analog); port -1
@@ -480,6 +489,11 @@ class Node:
                 else:
                     self._handle_message(conn, handle, msg)
         finally:
+            with self.lock:
+                # a disconnected peer's pubsub subscriptions die with it
+                for subs in self.subscribers.values():
+                    if conn in subs:
+                        subs.remove(conn)
             if handle is not None:
                 self._on_worker_death(handle, reason="connection closed")
             elif agent_node_id is not None:
@@ -922,16 +936,39 @@ class Node:
             pg.conn_send(reply)
 
     def _timeout_loop(self) -> None:
-        ticks = 0
         while not self._shutdown:
             time.sleep(0.05)
             self._service_pending_gets()
-            ticks += 1
-            if self.gcs_store is not None and ticks % 40 == 0:  # every ~2s
-                try:
-                    self.gcs.flush(self.gcs_store)
-                except Exception:
-                    logger.warning("gcs flush failed:\n%s", traceback.format_exc())
+
+    def _gcs_flush_loop(self) -> None:
+        """Periodic persistence on its own thread (never in the path of
+        pending-get servicing); prunes old terminal task records so the
+        flush (and the table) stays bounded on long-lived heads."""
+        while not self._shutdown:
+            time.sleep(2.0)
+            self._prune_task_history()
+            if self.gcs_store is None:
+                continue
+            try:
+                self.gcs.flush(self.gcs_store)
+            except Exception:
+                logger.warning("gcs flush failed:\n%s", traceback.format_exc())
+
+    _MAX_TASK_HISTORY = 10_000
+
+    def _prune_task_history(self) -> None:
+        with self.gcs.lock:
+            if len(self.gcs.tasks) <= self._MAX_TASK_HISTORY:
+                return
+            terminal = [
+                (ti.end_time or 0.0, tid)
+                for tid, ti in self.gcs.tasks.items()
+                if ti.state in ("FINISHED", "FAILED")
+            ]
+            excess = len(self.gcs.tasks) - self._MAX_TASK_HISTORY
+            terminal.sort()
+            for _, tid in terminal[:excess]:
+                del self.gcs.tasks[tid]
 
     # ------------------------------------------------------------------
     # tasks
@@ -1092,24 +1129,37 @@ class Node:
             pass
 
     def publish(self, channel: str, data) -> None:
-        """Fan a message out to every subscriber of ``channel`` (the
-        Publisher half of src/ray/pubsub/; dead conns are pruned)."""
-        with self.lock:
-            subs = list(self.subscribers.get(channel, []))
-        dead = []
-        for conn in subs:
-            lock = self._conn_lock(conn)
-            try:
-                with lock:
-                    conn.send({"type": "pubsub", "channel": channel, "data": data})
-            except (OSError, ValueError):
-                dead.append(conn)
-        if dead:
+        """Queue a message for fan-out to ``channel`` subscribers (the
+        Publisher half of src/ray/pubsub/).  Enqueue-only: core threads
+        (scheduler, client-serving) must never block on a slow
+        subscriber's pipe.  Messages drop when the publisher falls 1000
+        behind (pubsub is best-effort, like the reference's long-poll)."""
+        if self._pub_queue.qsize() > 1000:
+            return
+        self._pub_queue.put((channel, data))
+
+    def _publisher_loop(self) -> None:
+        while not self._shutdown:
+            item = self._pub_queue.get()
+            if item is None:
+                return
+            channel, data = item
             with self.lock:
-                subs = self.subscribers.get(channel, [])
-                for conn in dead:
-                    if conn in subs:
-                        subs.remove(conn)
+                subs = list(self.subscribers.get(channel, []))
+            dead = []
+            for conn in subs:
+                lock = self._conn_lock(conn)
+                try:
+                    with lock:
+                        conn.send({"type": "pubsub", "channel": channel, "data": data})
+                except (OSError, ValueError):
+                    dead.append(conn)
+            if dead:
+                with self.lock:
+                    cur = self.subscribers.get(channel, [])
+                    for conn in dead:
+                        if conn in cur:
+                            cur.remove(conn)
 
     def _broadcast_unlink(self, shm_name: str) -> None:
         """Registry callback: a deleted object's segment (origin or pulled
@@ -1733,6 +1783,10 @@ class Node:
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
         self._shutdown = True
+        try:
+            self._pub_queue.put(None)  # end the publisher thread
+        except Exception:
+            pass
         with self.lock:
             workers = list(self.workers.values())
         for w in workers:

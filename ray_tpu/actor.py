@@ -101,6 +101,12 @@ class ActorClass:
     def remote(self, *args, **kwargs) -> ActorHandle:
         return self._remote(args, kwargs, self._default_options)
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy actor DAG node (``ray.dag`` ClassNode)."""
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self, args, kwargs)
+
     def _remote(self, args, kwargs, options: Dict[str, Any]) -> ActorHandle:
         from ray_tpu.remote_function import _strategy_to_dict
 
@@ -153,6 +159,11 @@ class _ActorClassWrapper:
 
     def remote(self, *args, **kwargs) -> ActorHandle:
         return self._ac._remote(args, kwargs, self._options)
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import ClassNode
+
+        return ClassNode(self._ac, args, kwargs, self._options)
 
 
 def get_actor(name: str) -> ActorHandle:

@@ -1,0 +1,17 @@
+"""Lazy task/actor DAGs — the ``ray.dag`` analog.
+
+Reference: ``python/ray/dag/`` (``dag_node.py``, ``function_node.py``,
+``class_node.py``, ``input_node.py``) — the substrate of Serve deployment
+graphs.  ``fn.bind(...)`` builds nodes instead of submitting; ``execute``
+walks the graph, submits every task once, and returns the root's ref.
+"""
+
+from ray_tpu.dag.dag_node import (
+    ClassMethodNode,
+    ClassNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+)
+
+__all__ = ["DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode", "InputNode"]

@@ -39,6 +39,12 @@ class RemoteFunction:
     def remote(self, *args, **kwargs):
         return self._remote(args, kwargs, self._default_options)
 
+    def bind(self, *args, **kwargs):
+        """Build a lazy DAG node instead of submitting (``ray.dag``)."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def _remote(self, args, kwargs, options: Dict[str, Any]):
         w = global_worker
         if not w.connected:
@@ -75,6 +81,11 @@ class _RemoteFunctionWrapper:
 
     def remote(self, *args, **kwargs):
         return self._rf._remote(args, kwargs, self._options)
+
+    def bind(self, *args, **kwargs):
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self._rf, args, kwargs, self._options)
 
 
 def _strategy_to_dict(strategy) -> Optional[dict]:

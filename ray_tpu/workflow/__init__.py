@@ -1,0 +1,31 @@
+"""Durable workflows — the ``ray.workflow`` analog.
+
+Reference: ``python/ray/workflow/`` (``workflow_executor.py``,
+``workflow_state_from_dag.py``, ``workflow_storage.py``): a task DAG runs
+with every step's result checkpointed to storage, so a crashed run
+resumes from the last completed step instead of starting over.
+
+    from ray_tpu import workflow
+
+    @ray_tpu.remote
+    def a(): ...
+    @ray_tpu.remote
+    def b(x): ...
+
+    result = workflow.run(b.bind(a.bind()), workflow_id="my-flow")
+    # after a crash:
+    result = workflow.resume("my-flow")
+"""
+
+from ray_tpu.workflow.api import (
+    delete,
+    get_output,
+    get_status,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+
+__all__ = ["run", "run_async", "resume", "get_status", "get_output",
+           "list_all", "delete"]

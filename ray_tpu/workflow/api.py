@@ -1,0 +1,238 @@
+"""Workflow execution + storage.
+
+Storage layout (``workflow_storage.py`` analog), one directory per
+workflow under ``$RAY_TPU_WORKFLOW_STORAGE`` (default
+``/tmp/ray_tpu/workflows``)::
+
+    <id>/meta.json        status + timestamps
+    <id>/dag.pkl          the bound DAG (for resume)
+    <id>/steps/<sid>.pkl  checkpointed step results
+    <id>/output.pkl       final result
+
+Step ids are deterministic (topological index + function name), so a
+resumed run maps steps onto their prior checkpoints.  Steps run as
+cluster tasks; their *values* are checkpointed (results must be
+picklable — the durability contract of the reference).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import cloudpickle
+
+from ray_tpu.dag import ClassNode, DAGNode, FunctionNode, InputNode
+
+
+def _root() -> str:
+    return os.environ.get("RAY_TPU_WORKFLOW_STORAGE", "/tmp/ray_tpu/workflows")
+
+
+class WorkflowStorage:
+    def __init__(self, workflow_id: str):
+        self.workflow_id = workflow_id
+        self.dir = os.path.join(_root(), workflow_id)
+        os.makedirs(os.path.join(self.dir, "steps"), exist_ok=True)
+
+    # -- meta ----------------------------------------------------------
+    def _meta_path(self) -> str:
+        return os.path.join(self.dir, "meta.json")
+
+    def write_meta(self, **updates) -> None:
+        meta = self.read_meta() or {"workflow_id": self.workflow_id,
+                                    "created": time.time()}
+        meta.update(updates)
+        tmp = self._meta_path() + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmp, self._meta_path())
+
+    def read_meta(self) -> Optional[dict]:
+        try:
+            with open(self._meta_path()) as f:
+                return json.load(f)
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    # -- dag / steps / output -----------------------------------------
+    def save_dag(self, dag: DAGNode) -> None:
+        with open(os.path.join(self.dir, "dag.pkl"), "wb") as f:
+            cloudpickle.dump(dag, f)
+
+    def load_dag(self) -> DAGNode:
+        with open(os.path.join(self.dir, "dag.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+    def step_path(self, step_id: str) -> str:
+        return os.path.join(self.dir, "steps", f"{step_id}.pkl")
+
+    def has_step(self, step_id: str) -> bool:
+        return os.path.exists(self.step_path(step_id))
+
+    def save_step(self, step_id: str, value: Any) -> None:
+        tmp = self.step_path(step_id) + ".tmp"
+        with open(tmp, "wb") as f:
+            cloudpickle.dump(value, f)
+        os.replace(tmp, self.step_path(step_id))
+
+    def load_step(self, step_id: str) -> Any:
+        with open(self.step_path(step_id), "rb") as f:
+            return cloudpickle.load(f)
+
+    def save_output(self, value: Any) -> None:
+        with open(os.path.join(self.dir, "output.pkl"), "wb") as f:
+            cloudpickle.dump(value, f)
+
+    def load_output(self) -> Any:
+        with open(os.path.join(self.dir, "output.pkl"), "rb") as f:
+            return cloudpickle.load(f)
+
+    def has_output(self) -> bool:
+        return os.path.exists(os.path.join(self.dir, "output.pkl"))
+
+
+def _step_ids(dag: DAGNode) -> Dict[int, str]:
+    """Deterministic step ids over the topological order."""
+    ids: Dict[int, str] = {}
+    for i, node in enumerate(dag.topological()):
+        if isinstance(node, FunctionNode):
+            name = getattr(node._remote_fn, "__name__", "step")
+            ids[id(node)] = f"{i:04d}-{name}"
+    return ids
+
+
+def _check_task_dag(dag: DAGNode) -> None:
+    if any(isinstance(n, ClassNode) for n in dag.topological()):
+        raise TypeError("workflows support task DAGs only (no actor nodes)")
+
+
+def _execute_durably(dag: DAGNode, storage: WorkflowStorage,
+                     input_args: tuple, input_kwargs: dict) -> Any:
+    import ray_tpu
+
+    _check_task_dag(dag)
+    ids = _step_ids(dag)
+    results: Dict[int, Any] = {}
+    for node in dag.topological():
+        if isinstance(node, InputNode):
+            results[id(node)] = (input_args[0]
+                                 if len(input_args) == 1 and not input_kwargs
+                                 else (input_args, input_kwargs))
+            continue
+        sid = ids[id(node)]
+        if storage.has_step(sid):
+            results[id(node)] = storage.load_step(sid)
+            continue
+        args = tuple(node._resolve(a, results) for a in node._bound_args)
+        kwargs = {k: node._resolve(v, results)
+                  for k, v in node._bound_kwargs.items()}
+        ref = node._execute_impl(args, kwargs)
+        value = ray_tpu.get(ref)
+        storage.save_step(sid, value)
+        results[id(node)] = value
+    return results[id(dag)]
+
+
+def _run_sync(dag: DAGNode, storage: WorkflowStorage,
+              args: tuple, kwargs: dict) -> Any:
+    storage.write_meta(status="RUNNING", started=time.time())
+    try:
+        out = _execute_durably(dag, storage, args, kwargs)
+    except BaseException as e:
+        storage.write_meta(status="FAILED", error=str(e), ended=time.time())
+        raise
+    storage.save_output(out)
+    storage.write_meta(status="SUCCEEDED", ended=time.time())
+    return out
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
+        args: tuple = (), kwargs: Optional[dict] = None) -> Any:
+    """Run a DAG durably; blocks and returns the final result."""
+    _check_task_dag(dag)
+    workflow_id = workflow_id or f"wf-{os.urandom(4).hex()}"
+    storage = WorkflowStorage(workflow_id)
+    storage.save_dag(dag)
+    return _run_sync(dag, storage, args, kwargs or {})
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
+              args: tuple = (), kwargs: Optional[dict] = None):
+    """Run in a background thread; returns a handle with .result()."""
+    _check_task_dag(dag)
+    workflow_id = workflow_id or f"wf-{os.urandom(4).hex()}"
+    storage = WorkflowStorage(workflow_id)
+    storage.save_dag(dag)
+
+    class _Handle:
+        def __init__(self):
+            self.workflow_id = workflow_id
+            self._value = None
+            self._error: Optional[BaseException] = None
+            self._done = threading.Event()
+
+        def result(self, timeout: Optional[float] = None):
+            if not self._done.wait(timeout):
+                raise TimeoutError(f"workflow {workflow_id} still running")
+            if self._error is not None:
+                raise self._error
+            return self._value
+
+    h = _Handle()
+
+    def runner():
+        try:
+            h._value = _run_sync(dag, storage, args, kwargs or {})
+        except BaseException as e:  # noqa: BLE001
+            h._error = e
+        finally:
+            h._done.set()
+
+    threading.Thread(target=runner, daemon=True,
+                     name=f"workflow-{workflow_id}").start()
+    return h
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a workflow; completed steps load from their checkpoints."""
+    storage = WorkflowStorage(workflow_id)
+    if storage.has_output():
+        return storage.load_output()
+    dag = storage.load_dag()
+    return _run_sync(dag, storage, (), {})
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    meta = WorkflowStorage(workflow_id).read_meta()
+    return meta.get("status") if meta else None
+
+
+def get_output(workflow_id: str) -> Any:
+    storage = WorkflowStorage(workflow_id)
+    if not storage.has_output():
+        raise ValueError(f"workflow {workflow_id} has no output "
+                         f"(status={get_status(workflow_id)})")
+    return storage.load_output()
+
+
+def list_all() -> List[Dict[str, Any]]:
+    out = []
+    try:
+        ids = sorted(os.listdir(_root()))
+    except OSError:
+        return out
+    for wid in ids:
+        meta = WorkflowStorage(wid).read_meta()
+        if meta:
+            out.append(meta)
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    import shutil
+
+    shutil.rmtree(os.path.join(_root(), workflow_id), ignore_errors=True)

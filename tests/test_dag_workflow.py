@@ -1,0 +1,144 @@
+"""DAG nodes (``ray.dag``) + durable workflows (``ray.workflow``).
+
+Reference: ``python/ray/dag/`` lazy nodes and ``python/ray/workflow/``
+storage-backed recovery.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+from ray_tpu.dag import InputNode
+
+
+@pytest.fixture(autouse=True)
+def _wf_storage(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path / "wf"))
+
+
+def test_function_dag(ray_start_regular):
+    @ray_tpu.remote
+    def a():
+        return 2
+
+    @ray_tpu.remote
+    def b(x):
+        return x * 3
+
+    @ray_tpu.remote
+    def c(x, y):
+        return x + y
+
+    # diamond: a feeds both b and c; a must run once
+    an = a.bind()
+    dag = c.bind(b.bind(an), an)
+    assert ray_tpu.get(dag.execute(), timeout=60) == 8
+
+
+def test_dag_with_input(ray_start_regular):
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def add1(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = add1.bind(double.bind(inp))
+    assert ray_tpu.get(dag.execute(5), timeout=60) == 11
+    assert ray_tpu.get(dag.execute(10), timeout=60) == 21
+
+
+def test_actor_dag(ray_start_regular):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start):
+            self.v = start
+
+        def add(self, x):
+            self.v += x
+            return self.v
+
+    counter = Counter.bind(100)
+    dag = counter.add.bind(5)
+    assert ray_tpu.get(dag.execute(), timeout=60) == 105
+    # same ClassNode -> same actor instance across executions
+    assert ray_tpu.get(dag.execute(), timeout=60) == 110
+
+
+def test_workflow_run_and_output(ray_start_regular):
+    @ray_tpu.remote
+    def fetch():
+        return [1, 2, 3]
+
+    @ray_tpu.remote
+    def total(xs):
+        return sum(xs)
+
+    result = workflow.run(total.bind(fetch.bind()), workflow_id="sum-flow")
+    assert result == 6
+    assert workflow.get_status("sum-flow") == "SUCCEEDED"
+    assert workflow.get_output("sum-flow") == 6
+    assert any(m["workflow_id"] == "sum-flow" for m in workflow.list_all())
+
+
+def test_workflow_resume_skips_completed_steps(ray_start_regular, tmp_path):
+    """A step that fails mid-flow: resume() re-runs only the failed step —
+    completed steps load from their checkpoints (the crash-recovery
+    contract of workflow_storage.py)."""
+    marker = tmp_path / "fail-once"
+    marker.write_text("arm")
+    counter_file = tmp_path / "a-runs"
+    counter_file.write_text("0")
+
+    @ray_tpu.remote
+    def step_a():
+        # count executions to prove resume doesn't re-run this step
+        n = int(open(str(counter_file)).read()) + 1
+        open(str(counter_file), "w").write(str(n))
+        return 10
+
+    @ray_tpu.remote
+    def step_b(x, marker_path):
+        if os.path.exists(marker_path):
+            os.unlink(marker_path)
+            raise RuntimeError("transient failure")
+        return x + 1
+
+    dag = step_b.bind(step_a.bind(), str(marker))
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="flaky")
+    assert workflow.get_status("flaky") == "FAILED"
+    assert open(str(counter_file)).read() == "1"
+
+    result = workflow.resume("flaky")
+    assert result == 11
+    assert workflow.get_status("flaky") == "SUCCEEDED"
+    # step_a was NOT re-executed — its checkpoint was reused
+    assert open(str(counter_file)).read() == "1"
+
+
+def test_workflow_run_async(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        import time
+
+        time.sleep(0.5)
+        return "done"
+
+    h = workflow.run_async(slow.bind(), workflow_id="async-flow")
+    assert h.result(timeout=120) == "done"
+    assert workflow.get_status("async-flow") == "SUCCEEDED"
+
+
+def test_workflow_rejects_actor_nodes(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def go(self):
+            return 1
+
+    with pytest.raises(TypeError, match="task DAGs"):
+        workflow.run(A.bind().go.bind(), workflow_id="bad")

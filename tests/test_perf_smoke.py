@@ -9,7 +9,8 @@ def test_ray_perf_quick():
     by_name = {r["metric"]: r["value"] for r in results}
     assert len(results) >= 9
     assert all(v > 0 for v in by_name.values())
-    # sanity floors: these ran thousands of ops/s in CI when written; a
-    # 10x regression should fail loudly
-    assert by_name["task_round_trip"] > 50
-    assert by_name["actor_call_round_trip"] > 100
+    # sanity floors: these run ~1000+ ops/s standalone; the generous
+    # floors only catch order-of-magnitude regressions without flaking
+    # when this runs late in the suite on a loaded 1-core CI box
+    assert by_name["task_round_trip"] > 20
+    assert by_name["actor_call_round_trip"] > 40
