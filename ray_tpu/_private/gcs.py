@@ -31,6 +31,8 @@ class ActorInfo:
     worker_id: Optional[bytes] = None
     max_restarts: int = 0
     num_restarts: int = 0
+    # in-flight method retries across a restart (at-most-once by default)
+    max_task_retries: int = 0
     creation_spec: Optional[dict] = None  # kept for restart (lineage)
     death_cause: Optional[str] = None
 
@@ -49,8 +51,12 @@ class TaskInfo:
     name: str
     state: str = "PENDING"  # PENDING/RUNNING/FINISHED/FAILED
     node_id: Optional[str] = None
-    start_time: float = field(default_factory=time.time)
+    start_time: float = field(default_factory=time.time)  # submission
     end_time: Optional[float] = None
+    # worker-reported execution window + pid (profile events)
+    exec_start: Optional[float] = None
+    exec_end: Optional[float] = None
+    worker_pid: Optional[int] = None
 
 
 @dataclass

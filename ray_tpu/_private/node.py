@@ -1349,6 +1349,9 @@ class Node:
             ti = self.gcs.tasks.get(tid)
             if ti:
                 ti.state = "FAILED" if msg.get("failed") else "FINISHED"
+                ti.exec_start = msg.get("exec_start")
+                ti.exec_end = msg.get("exec_end")
+                ti.worker_pid = msg.get("worker_pid")
                 ti.end_time = time.time()
         if rt is not None:
             self._release_task_resources(rt)
@@ -1379,6 +1382,7 @@ class Node:
                 name=spec.get("actor_name"),
                 class_name=spec.get("name", "Actor").removesuffix(".__init__"),
                 max_restarts=spec.get("max_restarts", 0),
+                max_task_retries=spec.get("max_task_retries", 0),
                 creation_spec=spec,
             )
             self.gcs.actors[spec["actor_id"]] = info
@@ -1538,7 +1542,28 @@ class Node:
             if art is None:
                 return
             info = art.info
-            failed_specs = list(art.inflight.values())
+            will_restart = (info.state != "DEAD"
+                            and (info.num_restarts < info.max_restarts
+                                 or info.max_restarts == -1))
+            # At-most-once by default: methods that were EXECUTING fail
+            # with RayActorError.  With max_task_retries they requeue and
+            # re-run on the restarted instance (never-started queued
+            # methods always survive a restart — they haven't run yet).
+            failed_specs = []
+            retried = []
+            for spec in art.inflight.values():  # dict order = dispatch order
+                attempts = spec.get("_actor_task_attempts", 0)
+                if will_restart and (
+                    info.max_task_retries == -1
+                    or attempts < info.max_task_retries
+                ):
+                    spec["_actor_task_attempts"] = attempts + 1
+                    retried.append(spec)
+                else:
+                    failed_specs.append(spec)
+            # extendleft reverses, so feed it the reversed list to put the
+            # retried methods back at the front IN their dispatch order
+            art.queue.extendleft(reversed(retried))
             art.inflight.clear()
             art.worker = None
             # release resources (skip CPUs a blocked method already gave

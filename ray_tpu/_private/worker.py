@@ -239,6 +239,7 @@ class Worker:
         method_name: Optional[str] = None,
         is_actor_creation: bool = False,
         max_restarts: int = 0,
+        max_task_retries: int = 0,
         actor_name: Optional[str] = None,
         runtime_env: Optional[dict] = None,
         max_concurrency: int = 1,
@@ -298,6 +299,7 @@ class Worker:
             "method_name": method_name,
             "is_actor_creation": is_actor_creation,
             "max_restarts": max_restarts,
+            "max_task_retries": max_task_retries,
             "actor_name": actor_name,
             "runtime_env": runtime_env,
             "max_concurrency": max_concurrency,
@@ -387,6 +389,7 @@ def _execute_task(msg: dict) -> None:
         os.environ.pop("TPU_VISIBLE_CHIPS", None)
         os.environ.pop("RAY_TPU_ASSIGNED_TPUS", None)
     w.current_task_id = spec["task_id"]
+    exec_start = time.time()  # profile event (core_worker profiling.h:30)
     failed = False
     error_str = None
     try:
@@ -422,7 +425,7 @@ def _execute_task(msg: dict) -> None:
                         _ensure_coro(out), _get_async_loop()
                     )
 
-                    def _complete(f, spec=spec):
+                    def _complete(f, spec=spec, exec_start=exec_start):
                         # runs on the loop thread: compute the outcome only,
                         # then seal on a side thread — result serialization
                         # must never stall the other in-flight coroutines
@@ -437,7 +440,8 @@ def _execute_task(msg: dict) -> None:
                             res = [err] * spec["num_returns"]
                             failed_, err_str = True, f"{type(e).__name__}: {e}"
                         _completion_executor().submit(
-                            _seal_and_report, w, spec, res, failed_, err_str
+                            _seal_and_report, w, spec, res, failed_, err_str,
+                            exec_start,
                         )
 
                     fut.add_done_callback(_complete)
@@ -465,11 +469,12 @@ def _execute_task(msg: dict) -> None:
             f"Task {spec.get('name')} failed:\n{tb}", cause=e
         )
         results = [err] * spec["num_returns"]
-    _seal_and_report(w, spec, results, failed, error_str)
+    _seal_and_report(w, spec, results, failed, error_str, exec_start)
 
 
 def _seal_and_report(w, spec: dict, results: List[Any], failed: bool,
-                     error_str: Optional[str]) -> None:
+                     error_str: Optional[str],
+                     exec_start: Optional[float] = None) -> None:
     """Seal the return objects and tell the head the task finished.  Runs on
     the executing thread for sync tasks and on the event-loop thread (via
     add_done_callback) for async actor methods."""
@@ -496,6 +501,11 @@ def _seal_and_report(w, spec: dict, results: List[Any], failed: bool,
         },
         "failed": failed,
         "error_str": error_str,
+        # profile event window (Profiler/ProfileEvent analog) — the head
+        # stores it on TaskInfo for `ray_tpu timeline`
+        "exec_start": exec_start,
+        "exec_end": time.time(),
+        "worker_pid": os.getpid(),
     })
     w.current_task_id = None
 

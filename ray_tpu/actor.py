@@ -144,6 +144,7 @@ class ActorClass:
             actor_id=actor_id,
             is_actor_creation=True,
             max_restarts=options.get("max_restarts", 0),
+            max_task_retries=options.get("max_task_retries", 0),
             actor_name=options.get("name"),
             runtime_env=options.get("runtime_env"),
             max_concurrency=max_concurrency,

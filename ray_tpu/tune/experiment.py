@@ -27,6 +27,8 @@ class Trial:
     # runtime handles (not persisted)
     actor: Any = None
     future: Any = None
+    # wall time the in-flight train() future was armed (deadline tracking)
+    future_started: Optional[float] = None
 
     @property
     def is_finished(self) -> bool:

@@ -10,6 +10,7 @@ checkpoints/stops per its decisions.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, List, Optional
 
 import cloudpickle
@@ -64,6 +65,7 @@ class TrialRunner:
         resources_per_trial: Optional[Dict[str, float]] = None,
         max_failures: int = 0,
         stop: Optional[Dict[str, Any]] = None,
+        trial_timeout_s: Optional[float] = None,
     ):
         self.trainable_blob = cloudpickle.dumps(trainable_cls)
         self.trials = trials
@@ -72,6 +74,9 @@ class TrialRunner:
         self.resources = resources_per_trial or {"CPU": 1.0}
         self.max_failures = max_failures
         self.stop_criteria = stop or {}
+        # a train() iteration exceeding this is a failure (hung-trial
+        # deadline — without it one wedged trial stalls the experiment)
+        self.trial_timeout_s = trial_timeout_s
 
     # -- scheduler support services -----------------------------------
     def get_trial(self, trial_id: str) -> Optional[T.Trial]:
@@ -103,24 +108,28 @@ class TrialRunner:
         if trial.checkpoint is not None:
             ray_tpu.get(trial.actor.restore.remote(trial.checkpoint), timeout=300)
         trial.future = trial.actor.train.remote()
+        trial.future_started = time.time()
         trial.status = T.RUNNING
 
-    def _stop_trial(self, trial: T.Trial, status: str, save: bool = True) -> None:
+    def _stop_trial(self, trial: T.Trial, status: str, save: bool = True,
+                    graceful: bool = True) -> None:
         if trial.actor is not None:
-            try:
-                if save:
-                    ckpt = ray_tpu.get(trial.actor.save.remote(), timeout=120)
-                    if ckpt is not None:
-                        trial.checkpoint = ckpt
-                ray_tpu.get(trial.actor.stop.remote(), timeout=60)
-            except Exception:
-                pass
+            if graceful:  # a hung trial gets no goodbye round-trips
+                try:
+                    if save:
+                        ckpt = ray_tpu.get(trial.actor.save.remote(), timeout=120)
+                        if ckpt is not None:
+                            trial.checkpoint = ckpt
+                    ray_tpu.get(trial.actor.stop.remote(), timeout=60)
+                except Exception:
+                    pass
             try:
                 ray_tpu.kill(trial.actor)
             except Exception:
                 pass
         trial.actor = None
         trial.future = None
+        trial.future_started = None
         trial.status = status
 
     def _should_stop(self, result: Dict[str, Any]) -> bool:
@@ -145,7 +154,27 @@ class TrialRunner:
             return False
 
         futures = {t.future: t for t in running if t.future is not None}
-        ready, _ = ray_tpu.wait(list(futures), num_returns=1, timeout=120.0)
+        wait_timeout = 120.0 if self.trial_timeout_s is None else min(
+            120.0, max(1.0, self.trial_timeout_s / 4)
+        )
+        ready, _ = ray_tpu.wait(list(futures), num_returns=1, timeout=wait_timeout)
+        if self.trial_timeout_s is not None:
+            # enforce the per-iteration deadline EVERY turn — a wedged
+            # trial must not survive behind other trials' progress
+            now = time.time()
+            for trial in running:
+                if trial.future in ready or trial.future is None:
+                    continue
+                if (trial.future_started is not None
+                        and now - trial.future_started > self.trial_timeout_s):
+                    trial.num_failures += 1
+                    logger.warning("trial %s exceeded trial_timeout_s=%.0f; killing",
+                                   trial.trial_id, self.trial_timeout_s)
+                    if trial.num_failures > self.max_failures:
+                        trial.error = f"trial timed out after {self.trial_timeout_s}s"
+                        self._stop_trial(trial, T.ERROR, save=False, graceful=False)
+                    else:
+                        self._stop_trial(trial, T.PENDING, save=False, graceful=False)
         for fut in ready:
             trial = futures[fut]
             try:
@@ -170,6 +199,7 @@ class TrialRunner:
                 self._stop_trial(trial, T.TERMINATED)
             else:
                 trial.future = trial.actor.train.remote()
+                trial.future_started = time.time()
         return True
 
     def run(self) -> List[T.Trial]:

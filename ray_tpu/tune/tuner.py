@@ -36,6 +36,9 @@ class TuneConfig:
     resources_per_trial: Optional[Dict[str, float]] = None
     max_failures: int = 0
     stop: Optional[Dict[str, Any]] = None  # e.g. {"training_iteration": 10}
+    # kill a trial whose single train() iteration exceeds this (a hung
+    # trial must not stall the experiment); None = no deadline
+    trial_timeout_s: Optional[float] = None
 
 
 class Tuner:
@@ -93,6 +96,7 @@ class Tuner:
             resources_per_trial=tc.resources_per_trial,
             max_failures=tc.max_failures,
             stop=tc.stop,
+            trial_timeout_s=tc.trial_timeout_s,
         )
         exp_dir = self._exp_dir()
         try:

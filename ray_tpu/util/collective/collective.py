@@ -3,17 +3,19 @@
 API surface of the reference's ``ray.util.collective``
 (``python/ray/util/collective/collective.py:120-615`` —
 ``init_collective_group / allreduce / allgather / reducescatter /
-broadcast / send / recv``), re-based for TPU clusters:
+broadcast / send / recv``) with two backends:
 
-- **Device tensors never travel this path.**  On-TPU reductions belong in
-  jit via :mod:`ray_tpu.parallel.collective` (XLA lowers them onto ICI).
-- This module moves *host* arrays between workers — the role gloo plays in
-  the reference (``gloo_collective_group.py:184``) — through the
-  shared-memory object store, rendezvoused by a named coordinator actor.
-
-Each group op is a barriered round: every rank contributes its array,
-rank 0's coordinator computes the reduction once, and all ranks fetch the
-result as a zero-copy object-store read.
+- ``backend="shm"`` (default, gloo's role): host arrays rendezvous
+  through an **async coordinator actor** — every rank's single
+  ``collect`` call parks on the actor's event loop until the round
+  completes, so a round costs one actor round-trip per rank (no
+  polling), with array payloads moving through the shared-memory object
+  store.
+- ``backend="xla"`` (nccl's role, SURVEY §5.8): ops ride the jax
+  runtime's own collectives — each rank must be a jax process in one
+  initialized ``jax.distributed`` runtime (the Train worker-gang setup);
+  cross-process movement lowers onto ICI/DCN, never through Python.
+  In-jit code should use :mod:`ray_tpu.parallel.collective` directly.
 """
 
 from __future__ import annotations
@@ -26,55 +28,81 @@ import ray_tpu
 
 # Process-global: a worker joins a group once and may drive it from any
 # thread (train loops run on their own thread inside the hosting actor).
-_GROUPS: Dict[str, "_GroupHandle"] = {}
+_GROUPS: Dict[str, object] = {}
 
 
-def _groups() -> Dict[str, "_GroupHandle"]:
+def _groups() -> Dict[str, object]:
     return _GROUPS
 
 
 class _Coordinator:
-    """Named actor performing the gather/reduce/scatter rendezvous.
+    """Async rendezvous actor: one ``collect`` per rank per round.
 
-    One instance per group; lives on the head node.  Analog of the NCCL
-    communicator bootstrap store (``nccl_collective_group.py:127``), but it
-    also executes the host-side reduction itself.
-    """
+    Analog of the NCCL communicator bootstrap store
+    (``nccl_collective_group.py:127``), but it also executes the
+    host-side reduction.  Async methods multiplex on the actor's event
+    loop, so all ranks of a round park here concurrently and return the
+    moment the last one arrives — no poll loops, no separate fetch."""
 
     def __init__(self, world_size: int):
         self.world_size = world_size
         self.rounds: Dict[int, dict] = {}
         # (src, dst) -> fifo of in-flight point-to-point tensors
         self.mailbox: Dict[tuple, list] = {}
+        self.mailbox_events: Dict[tuple, object] = {}
 
-    def p2p_put(self, src: int, dst: int, value) -> None:
-        self.mailbox.setdefault((src, dst), []).append(value)
+    async def collect(self, round_id: int, rank: int, value, op: str):
+        import asyncio
 
-    def p2p_take(self, src: int, dst: int):
-        q = self.mailbox.get((src, dst))
-        if not q:
-            return False, None
-        return True, q.pop(0)
-
-    def contribute(self, round_id: int, rank: int, value, op: str):
-        """Blocks (by repeated polling from caller) until all ranks arrive."""
-        r = self.rounds.setdefault(round_id, {"parts": {}, "op": op, "result": None})
+        r = self.rounds.setdefault(
+            round_id,
+            {"parts": {}, "op": op, "result": None,
+             "event": asyncio.Event(), "fetched": set()},
+        )
         r["parts"][rank] = value
         if len(r["parts"]) == self.world_size:
             r["result"] = self._finish(r)
-        return r["result"] is not None
-
-    def fetch(self, round_id: int, rank: int):
-        r = self.rounds.get(round_id)
-        if r is None or r["result"] is None:
-            return False, None
+            r["event"].set()
+        else:
+            await r["event"].wait()
         out = r["result"]
-        r.setdefault("fetched", set()).add(rank)
+        r["fetched"].add(rank)
         if len(r["fetched"]) == self.world_size:
-            del self.rounds[round_id]
-        if isinstance(out, dict):  # per-rank outputs (reducescatter / recv)
-            return True, out[rank]
-        return True, out
+            self.rounds.pop(round_id, None)
+        if isinstance(out, dict):  # per-rank outputs (reducescatter)
+            return out[rank]
+        return out
+
+    async def p2p_put(self, src: int, dst: int, value) -> None:
+        import asyncio
+
+        key = (src, dst)
+        self.mailbox.setdefault(key, []).append(value)
+        ev = self.mailbox_events.setdefault(key, asyncio.Event())
+        ev.set()
+
+    async def p2p_take(self, src: int, dst: int, timeout: float = 60.0):
+        """Returns (ok, value).  The deadline lives SERVER-side so a
+        timed-out receive leaves no orphaned waiter that would steal the
+        next message for this (src, dst) pair."""
+        import asyncio
+
+        key = (src, dst)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        while True:
+            q = self.mailbox.get(key)
+            if q:
+                return True, q.pop(0)
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return False, None
+            ev = self.mailbox_events.setdefault(key, asyncio.Event())
+            ev.clear()
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                return False, None
 
     def _finish(self, r: dict):
         op = r["op"]
@@ -99,6 +127,8 @@ class _Coordinator:
 
 
 class _GroupHandle:
+    backend = "shm"
+
     def __init__(self, name: str, world_size: int, rank: int, coordinator):
         import threading
 
@@ -110,19 +140,91 @@ class _GroupHandle:
         self._round_lock = threading.Lock()
 
     def _run(self, value, op: str, timeout: float = 120.0):
-        import time
-
         with self._round_lock:
             rid = self.round_id
             self.round_id += 1
-        self.coordinator.contribute.remote(rid, self.rank, value, op)
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            done, out = ray_tpu.get(self.coordinator.fetch.remote(rid, self.rank))
-            if done:
-                return out
-            time.sleep(0.005)
-        raise TimeoutError(f"collective {op} round {rid} timed out in group {self.name}")
+        return ray_tpu.get(
+            self.coordinator.collect.remote(rid, self.rank, value, op),
+            timeout=timeout,
+        )
+
+    def send(self, tensor, dst_rank: int) -> None:
+        ray_tpu.get(self.coordinator.p2p_put.remote(self.rank, dst_rank, tensor))
+
+    def recv(self, src_rank: int, timeout: float = 120.0):
+        ok, val = ray_tpu.get(
+            self.coordinator.p2p_take.remote(src_rank, self.rank, timeout),
+            timeout=timeout + 30,  # server-side deadline fires first
+        )
+        if not ok:
+            raise TimeoutError(
+                f"recv from rank {src_rank} timed out after {timeout}s"
+            )
+        return val
+
+
+class _XlaGroup:
+    """Collectives over the jax runtime (the "nccl" slot on TPU).
+
+    Every rank must be a jax process of one ``jax.distributed`` runtime
+    (the JaxConfig Train backend arranges exactly this); world_size must
+    equal ``jax.process_count()``.  Ops use cross-process gathers whose
+    transfers XLA lowers onto ICI/DCN — the coordinator-actor data path
+    is never touched."""
+
+    backend = "xla"
+
+    def __init__(self, name: str, world_size: int, rank: int):
+        import jax
+
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        if world_size != jax.process_count():
+            raise ValueError(
+                f"xla backend groups span jax processes: world_size="
+                f"{world_size} != jax.process_count()={jax.process_count()} "
+                "(initialize the gang with jax.distributed / JaxConfig first)"
+            )
+
+    def _gather(self, tensor) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(np.asarray(tensor), tiled=False)
+        )
+
+    def _run(self, value, op: str, timeout: float = 120.0):
+        from jax.experimental import multihost_utils
+
+        if op == "barrier":
+            multihost_utils.sync_global_devices(f"rtpu-collective-{self.name}")
+            return True
+        if op == "broadcast":
+            root, tensor = value
+            out = multihost_utils.broadcast_one_to_all(
+                np.asarray(tensor), is_source=self.rank == root
+            )
+            return np.asarray(out)
+        stacked = self._gather(value)  # [world, ...]
+        if op in ("sum", "mean", "max", "min", "product"):
+            fn = {"sum": np.sum, "mean": np.mean, "max": np.max,
+                  "min": np.min, "product": np.prod}[op]
+            return fn(stacked, axis=0)
+        if op == "allgather":
+            return [stacked[i] for i in range(self.world_size)]
+        if op == "reducescatter":
+            acc = stacked.sum(axis=0)
+            return np.array_split(acc, self.world_size, axis=0)[self.rank]
+        raise ValueError(f"unknown op {op}")
+
+    def send(self, tensor, dst_rank: int) -> None:
+        raise NotImplementedError(
+            "xla backend has no host-level p2p; use ppermute/send_recv inside "
+            "jit (ray_tpu.parallel.collective) or the shm backend"
+        )
+
+    recv = send
 
 
 def init_collective_group(
@@ -130,9 +232,12 @@ def init_collective_group(
 ) -> None:
     """Join a collective group from inside a task/actor (collective.py:120).
 
-    Rank 0 creates the coordinator; other ranks poll for it — a
-    deterministic rendezvous with no named-actor creation race.
-    """
+    shm backend: rank 0 creates the coordinator; other ranks poll for it —
+    a deterministic rendezvous with no named-actor creation race.
+    xla backend: the jax runtime is the rendezvous."""
+    if backend in ("xla", "nccl"):
+        _groups()[group_name] = _XlaGroup(group_name, world_size, rank)
+        return
     if rank == 0:
         coord = _get_or_create_coordinator(group_name, world_size)
     else:
@@ -182,7 +287,7 @@ def get_collective_group_size(group_name: str = "default") -> int:
     return g.world_size if g else -1
 
 
-def _group(group_name: str) -> _GroupHandle:
+def _group(group_name: str):
     g = _groups().get(group_name)
     if g is None:
         raise RuntimeError(
@@ -224,23 +329,14 @@ def broadcast(tensor: np.ndarray, src_rank: int = 0, group_name: str = "default"
 def send(tensor: np.ndarray, dst_rank: int, group_name: str = "default") -> None:
     """Point-to-point send via the coordinator mailbox — NOT a group round,
     so only the (src, dst) pair participates (collective.py:531)."""
-    g = _group(group_name)
-    ray_tpu.get(g.coordinator.p2p_put.remote(g.rank, dst_rank, np.asarray(tensor)))
+    _group(group_name).send(np.asarray(tensor), dst_rank)
 
 
 def recv(shape, dtype, src_rank: int, group_name: str = "default",
          timeout: float = 120.0) -> np.ndarray:
     """Blocking point-to-point receive from ``src_rank`` (collective.py:594)."""
-    import time
-
-    g = _group(group_name)
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        ok, val = ray_tpu.get(g.coordinator.p2p_take.remote(src_rank, g.rank))
-        if ok:
-            return np.asarray(val, dtype=dtype).reshape(shape)
-        time.sleep(0.005)
-    raise TimeoutError(f"recv from rank {src_rank} timed out after {timeout}s")
+    val = _group(group_name).recv(src_rank, timeout)
+    return np.asarray(val, dtype=dtype).reshape(shape)
 
 
 def barrier(group_name: str = "default") -> None:

@@ -1,0 +1,72 @@
+"""Profiling helpers: XLA device traces + optional OpenTelemetry spans.
+
+The reference's tracing layer (``python/ray/util/tracing/tracing_helper.py``
+— lazily imported opentelemetry, span contexts injected into task
+metadata) and its on-demand profiling endpoints
+(``dashboard/modules/reporter/profile_manager.py``).  TPU additions:
+``profile_trace`` captures an XLA/jax device trace viewable in
+TensorBoard or Perfetto — the device-side half the reference never had.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str, *, host_tracer_level: int = 2) -> Iterator[None]:
+    """Capture a jax/XLA profiler trace for the enclosed block.
+
+    Run inside a Train worker loop (or any TPU-holding task)::
+
+        with profiling.profile_trace("/tmp/trace"):
+            train_step(...)
+
+    Open with TensorBoard's profile plugin or ui.perfetto.dev.
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir, create_perfetto_trace=False)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def span(name: str, attributes: Optional[dict] = None) -> Iterator[None]:
+    """OpenTelemetry span when the SDK is importable, no-op otherwise
+    (the reference's lazy-import pattern, ``tracing_helper.py:53-59``)."""
+    try:
+        from opentelemetry import trace  # type: ignore
+    except ImportError:
+        yield
+        return
+    tracer = trace.get_tracer("ray_tpu")
+    with tracer.start_as_current_span(name, attributes=attributes or {}):
+        yield
+
+
+class timed:
+    """Tiny wall-clock scope, recorded into ray_tpu.util.metrics::
+
+        with profiling.timed("ingest_batch"):
+            ...
+    """
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        from ray_tpu.util.metrics import Histogram
+
+        Histogram(f"ray_tpu_timed_{self.name}_seconds",
+                  f"wall time of {self.name} scopes").observe(
+            time.perf_counter() - self._t0)
+        return False

@@ -28,14 +28,28 @@ def timeline_events() -> List[dict]:
         if start is None:
             continue
         end = t.get("end_time") or now
+        pid = t.get("node_id") or "pending"
+        tid = t.get("worker_pid") or (t.get("task_id") or "")[:8]
+        exec_start, exec_end = t.get("exec_start"), t.get("exec_end")
+        if exec_start:
+            # queue slice (submission -> worker pickup) + exec slice,
+            # keyed to the actual worker pid like the reference timeline
+            events.append({
+                "name": f"{t.get('name', 'task')} (queued)", "cat": "queue",
+                "ph": "X", "ts": start * 1e6,
+                "dur": max(0.0, (exec_start - start) * 1e6),
+                "pid": pid, "tid": tid,
+                "args": {"task_id": t.get("task_id")},
+            })
+            start, end = exec_start, exec_end or now
         events.append({
             "name": t.get("name", "task"),
             "cat": "task",
             "ph": "X",  # complete event
             "ts": start * 1e6,
             "dur": max(0.0, (end - start) * 1e6),
-            "pid": t.get("node_id") or "pending",
-            "tid": (t.get("task_id") or "")[:8],
+            "pid": pid,
+            "tid": tid,
             "args": {"state": t.get("state"), "task_id": t.get("task_id")},
         })
     return events

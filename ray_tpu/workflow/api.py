@@ -94,6 +94,17 @@ class WorkflowStorage:
     def has_output(self) -> bool:
         return os.path.exists(os.path.join(self.dir, "output.pkl"))
 
+    def save_inputs(self, args: tuple, kwargs: dict) -> None:
+        with open(os.path.join(self.dir, "inputs.pkl"), "wb") as f:
+            cloudpickle.dump((args, kwargs), f)
+
+    def load_inputs(self) -> tuple:
+        try:
+            with open(os.path.join(self.dir, "inputs.pkl"), "rb") as f:
+                return cloudpickle.load(f)
+        except OSError:
+            return (), {}
+
 
 def _step_ids(dag: DAGNode) -> Dict[int, str]:
     """Deterministic step ids over the topological order."""
@@ -113,15 +124,22 @@ def _check_task_dag(dag: DAGNode) -> None:
 def _execute_durably(dag: DAGNode, storage: WorkflowStorage,
                      input_args: tuple, input_kwargs: dict) -> Any:
     import ray_tpu
+    from ray_tpu.dag.dag_node import _DAGInput
 
     _check_task_dag(dag)
     ids = _step_ids(dag)
     results: Dict[int, Any] = {}
+    # submit eagerly: steps whose checkpoints are missing get their
+    # upstream *ObjectRefs* as args (data moves through the object plane,
+    # independent branches run concurrently); checkpoints are then taken
+    # in topological order as each ref resolves
+    submitted = []
     for node in dag.topological():
         if isinstance(node, InputNode):
+            # same input representation as DAGNode.execute()
             results[id(node)] = (input_args[0]
                                  if len(input_args) == 1 and not input_kwargs
-                                 else (input_args, input_kwargs))
+                                 else _DAGInput(input_args, input_kwargs))
             continue
         sid = ids[id(node)]
         if storage.has_step(sid):
@@ -131,6 +149,9 @@ def _execute_durably(dag: DAGNode, storage: WorkflowStorage,
         kwargs = {k: node._resolve(v, results)
                   for k, v in node._bound_kwargs.items()}
         ref = node._execute_impl(args, kwargs)
+        results[id(node)] = ref
+        submitted.append((sid, node, ref))
+    for sid, node, ref in submitted:
         value = ray_tpu.get(ref)
         storage.save_step(sid, value)
         results[id(node)] = value
@@ -157,6 +178,7 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
     workflow_id = workflow_id or f"wf-{os.urandom(4).hex()}"
     storage = WorkflowStorage(workflow_id)
     storage.save_dag(dag)
+    storage.save_inputs(args, kwargs or {})
     return _run_sync(dag, storage, args, kwargs or {})
 
 
@@ -167,6 +189,7 @@ def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None,
     workflow_id = workflow_id or f"wf-{os.urandom(4).hex()}"
     storage = WorkflowStorage(workflow_id)
     storage.save_dag(dag)
+    storage.save_inputs(args, kwargs or {})
 
     class _Handle:
         def __init__(self):
@@ -203,7 +226,8 @@ def resume(workflow_id: str) -> Any:
     if storage.has_output():
         return storage.load_output()
     dag = storage.load_dag()
-    return _run_sync(dag, storage, (), {})
+    args, kwargs = storage.load_inputs()  # the original run's inputs
+    return _run_sync(dag, storage, args, kwargs)
 
 
 def get_status(workflow_id: str) -> Optional[str]:

@@ -117,3 +117,45 @@ def test_kill_actor(ray_start_regular):
     ray_tpu.kill(c)
     with pytest.raises(RayActorError):
         ray_tpu.get(c.inc.remote(), timeout=60)
+
+
+def test_actor_max_task_retries(ray_start_regular):
+    """In-flight methods are at-most-once by default; with max_task_retries
+    they re-run on the restarted instance (reference max_task_retries)."""
+    import os
+    import tempfile
+    import time
+
+    marker = tempfile.mktemp(prefix="rtpu_mtr_")
+    open(marker, "w").write("arm")
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+    class Crashy:
+        def work(self, marker):
+            if os.path.exists(marker):
+                os.unlink(marker)
+                os._exit(1)  # die mid-execution
+            return "second-try"
+
+    a = Crashy.remote()
+    # first call crashes the worker mid-run; the retry must succeed on the
+    # restarted instance
+    assert ray_tpu.get(a.work.remote(marker), timeout=120) == "second-try"
+
+
+def test_actor_no_retries_by_default(ray_start_regular):
+    import os
+
+    @ray_tpu.remote(max_restarts=1)
+    class Crashy:
+        def boom(self):
+            os._exit(1)
+
+        def ping(self):
+            return "ok"
+
+    a = Crashy.remote()
+    with pytest.raises(ray_tpu.exceptions.RayActorError):
+        ray_tpu.get(a.boom.remote(), timeout=120)
+    # the actor itself restarted and keeps serving
+    assert ray_tpu.get(a.ping.remote(), timeout=120) == "ok"

@@ -83,3 +83,36 @@ def test_send_recv_point_to_point(four_ranks):
     send_ref = four_ranks[0].do_send.remote(1)
     assert ray_tpu.get(send_ref) is True
     np.testing.assert_allclose(ray_tpu.get(recv_ref), np.zeros(3))
+
+
+def test_xla_backend_single_process(ray_start_regular):
+    """backend="xla" rides the jax runtime (single-process world here;
+    multi-process gangs are wired by the JaxConfig Train backend)."""
+    from ray_tpu.util.collective import collective as col
+
+    col.init_collective_group(world_size=1, rank=0, backend="xla",
+                              group_name="xg")
+    try:
+        x = np.arange(8.0)
+        np.testing.assert_allclose(col.allreduce(x, group_name="xg"), x)
+        gathered = col.allgather(x, group_name="xg")
+        assert len(gathered) == 1
+        np.testing.assert_allclose(gathered[0], x)
+        np.testing.assert_allclose(
+            col.broadcast(x, src_rank=0, group_name="xg"), x)
+        np.testing.assert_allclose(
+            col.reducescatter(x, group_name="xg"), x)
+        col.barrier(group_name="xg")
+        assert col.get_rank("xg") == 0
+        with pytest.raises(NotImplementedError):
+            col.send(x, 0, group_name="xg")
+    finally:
+        col.destroy_collective_group("xg")
+
+
+def test_xla_backend_world_size_mismatch(ray_start_regular):
+    from ray_tpu.util.collective import collective as col
+
+    with pytest.raises(ValueError, match="process_count"):
+        col.init_collective_group(world_size=4, rank=0, backend="xla",
+                                  group_name="bad-xg")

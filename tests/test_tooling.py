@@ -230,3 +230,42 @@ def test_autoscaler_scales_up_and_down(ray_start_regular):
     finally:
         monitor.stop()
         provider.shutdown()
+
+
+def test_timeline_exec_slices(ray_start_regular, tmp_path):
+    """Worker-reported exec windows show up as per-worker-pid slices with a
+    separate queued slice (profile-event enrichment)."""
+    @ray_tpu.remote
+    def tick():
+        time.sleep(0.05)
+        return 1
+
+    ray_tpu.get([tick.remote() for _ in range(2)], timeout=60)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        from ray_tpu.util.timeline import timeline_events
+
+        evs = [e for e in timeline_events() if e["name"] == "tick"]
+        if len(evs) == 2 and all(isinstance(e["tid"], int) for e in evs):
+            break
+        time.sleep(0.1)
+    assert len(evs) == 2
+    assert all(e["dur"] >= 0.04e6 for e in evs)
+    queued = [e for e in timeline_events() if e["name"] == "tick (queued)"]
+    assert len(queued) == 2
+
+
+def test_profiling_timed_scope(ray_start_regular):
+    from ray_tpu.util import profiling
+    from ray_tpu.util.metrics import registry
+
+    with profiling.timed("unit_scope"):
+        time.sleep(0.01)
+    snap = registry().snapshot()
+    assert "ray_tpu_timed_unit_scope_seconds" in snap
+    vals = list(snap["ray_tpu_timed_unit_scope_seconds"]["values"].values())
+    assert vals[0]["count"] >= 1 and vals[0]["sum"] >= 0.01
+
+    # span() is a no-op without opentelemetry installed
+    with profiling.span("noop-span"):
+        pass

@@ -111,3 +111,30 @@ def test_tuner_restore(ray_start_regular, tmp_path):
     )
     grid2 = restored.fit()  # everything terminated: results survive
     assert grid2[0].metrics["count"] == 5
+
+
+def test_trial_timeout_kills_hung_trial(ray_start_regular):
+    """A wedged trial must not stall the experiment (trial_timeout_s)."""
+    import time
+
+    from ray_tpu import tune
+
+    def loop(config):
+        from ray_tpu.air import session
+
+        if config["hang"]:
+            time.sleep(3600)
+        for i in range(2):
+            session.report({"score": i})
+
+    tuner = tune.Tuner(
+        loop,
+        param_space={"hang": tune.grid_search([False, True])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    trial_timeout_s=8.0, stop={"score": 1}),
+    )
+    t0 = time.time()
+    grid = tuner.fit()
+    assert time.time() - t0 < 240
+    statuses = sorted(r.error is not None for r in grid)
+    assert statuses == [False, True], "expected one ok trial and one timed-out"
