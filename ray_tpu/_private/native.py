@@ -1,0 +1,165 @@
+"""ctypes bindings for the native store core (src/store_core/).
+
+The native layer of the framework (SURVEY §2.1 expects C++ equivalents of
+the plasma/runtime components).  The library builds on demand with the
+baked-in toolchain (g++); everything degrades to the pure-Python
+per-object-file path when a compiler is unavailable, so the native layer
+is an accelerator, never a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SRC_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "src", "store_core",
+)
+_LIB_NAME = "libray_tpu_store.so"
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[str]:
+    """Compile the .so next to its source (cached across sessions)."""
+    out = os.path.join(_SRC_DIR, _LIB_NAME)
+    src = os.path.join(_SRC_DIR, "store_core.cc")
+    if not os.path.exists(src):
+        return None
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o", out, src],
+            check=True, capture_output=True, timeout=120,
+        )
+        return out
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.info("native store core unavailable (build failed: %s)", e)
+        return None
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The shared library, building it on first use; None when impossible."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        path = _build()
+        if path is None:
+            _build_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError as e:
+            logger.info("native store core failed to load: %s", e)
+            _build_failed = True
+            return None
+        lib.rtpu_store_create.restype = ctypes.c_void_p
+        lib.rtpu_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rtpu_store_put.restype = ctypes.c_int
+        lib.rtpu_store_put.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.rtpu_store_seal.restype = ctypes.c_int
+        lib.rtpu_store_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_store_get.restype = ctypes.c_int
+        lib.rtpu_store_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.rtpu_store_delete.restype = ctypes.c_int
+        lib.rtpu_store_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        for fn in ("rtpu_store_bytes_used", "rtpu_store_capacity",
+                   "rtpu_store_num_objects", "rtpu_store_num_free_blocks"):
+            getattr(lib, fn).restype = ctypes.c_uint64
+            getattr(lib, fn).argtypes = [ctypes.c_void_p]
+        lib.rtpu_store_close.restype = None
+        lib.rtpu_store_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+class NativeArena:
+    """Owner-side handle over one arena file (single-writer: the head).
+
+    Consumers never need this class — they mmap the arena file directly
+    and slice at the offsets the control plane hands them."""
+
+    def __init__(self, path: str, capacity: int):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native store core unavailable")
+        self._lib = lib
+        self.path = path
+        self.capacity = capacity
+        self._h = lib.rtpu_store_create(path.encode(), capacity)
+        if not self._h:
+            raise OSError(f"could not create arena at {path}")
+        import mmap as mmap_mod
+
+        fd = os.open(path, os.O_RDWR)
+        try:
+            self._mm = mmap_mod.mmap(fd, capacity)
+        finally:
+            os.close(fd)
+        self.buf = memoryview(self._mm)
+        self._closed = False
+
+    def put(self, oid: bytes, size: int) -> Optional[int]:
+        """Allocate+index; returns the offset or None when full."""
+        off = ctypes.c_uint64()
+        rc = self._lib.rtpu_store_put(self._h, oid, size, ctypes.byref(off))
+        if rc != 0:
+            return None
+        return off.value
+
+    def seal(self, oid: bytes) -> None:
+        self._lib.rtpu_store_seal(self._h, oid)
+
+    def get(self, oid: bytes):
+        off = ctypes.c_uint64()
+        size = ctypes.c_uint64()
+        sealed = ctypes.c_int()
+        rc = self._lib.rtpu_store_get(self._h, oid, ctypes.byref(off),
+                                      ctypes.byref(size), ctypes.byref(sealed))
+        if rc != 0:
+            return None
+        return off.value, size.value, bool(sealed.value)
+
+    def delete(self, oid: bytes) -> bool:
+        return self._lib.rtpu_store_delete(self._h, oid) == 0
+
+    def stats(self) -> dict:
+        return {
+            "bytes_used": self._lib.rtpu_store_bytes_used(self._h),
+            "capacity": self._lib.rtpu_store_capacity(self._h),
+            "num_objects": self._lib.rtpu_store_num_objects(self._h),
+            "free_blocks": self._lib.rtpu_store_num_free_blocks(self._h),
+        }
+
+    def close(self, unlink: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.buf.release()
+            self._mm.close()
+        except (BufferError, ValueError):
+            pass  # exported zero-copy views still alive
+        self._lib.rtpu_store_close(self._h, 1 if unlink else 0)
+
+
+def available() -> bool:
+    return load() is not None

@@ -256,6 +256,27 @@ class Node:
             capacity_bytes=self.cfg.object_store_memory or None,
             spill_dir=os.path.join(self.session_dir, "spill"),
         )
+        # Native arena store (plasma analog, src/store_core) for this
+        # process's objects; per-object files remain the fallback and the
+        # worker-side path.
+        self.arena = None
+        try:
+            from ray_tpu._private import native, object_store as ostore_mod
+
+            if native.available():
+                arena_path = os.path.join(
+                    shm_mod.shm_dir(),
+                    f"{self.cfg.shm_prefix}-{self.session_id}-arena",
+                )
+                self.arena = native.NativeArena(
+                    arena_path, int(self.cfg.object_store_memory or 2 << 30)
+                )
+                ostore_mod.set_owned_arena(self.arena)
+                self.registry.arena_delete = self.arena.delete
+                logger.info("native arena store at %s (%d MiB)",
+                            arena_path, self.arena.capacity >> 20)
+        except Exception:
+            logger.warning("native arena unavailable:\n%s", traceback.format_exc())
         self.gcs = GcsTables()
 
         # GCS fault tolerance: with a persistent store, replay the prior
@@ -1868,6 +1889,14 @@ class Node:
             except Exception:
                 pass
         self.registry.shutdown()
+        if self.arena is not None:
+            from ray_tpu._private import object_store as ostore_mod
+
+            ostore_mod.set_owned_arena(None)
+            try:
+                self.arena.close(unlink=True)
+            except Exception:
+                pass
         from ray_tpu._private import shm as shm_mod
 
         shm_mod.remove_session_marker(self.session_id)

@@ -53,6 +53,14 @@ class ObjectLocation:
     # (the head fills fetch_addr when serving locations cross-node).
     node_id: str = ""
     fetch_addr: Optional[tuple] = None
+    # Native arena backing (plasma analog): the payload is the
+    # [arena_off, arena_off+size) slice of the arena file.  shm_name is
+    # still set — it names the pulled copy on remote consumers.
+    arena_path: Optional[str] = None
+    arena_off: int = 0
+    # the arena index key (== oid normally; a fresh key when a retried
+    # task re-produced a return whose first attempt left an allocation)
+    arena_key: Optional[bytes] = None
 
     def __post_init__(self):
         if self.inline is not None:
@@ -92,6 +100,9 @@ class ObjectRegistry:
         # deletion broadcasts (the head's own copy/replica is unlinked
         # locally either way).
         self.broadcast_unlink = None
+        # set by the head Node when the native arena backs local objects:
+        # oid -> free the arena allocation
+        self.arena_delete = None
 
     # -- creation / sealing --------------------------------------------
     def create_pending(self, oid: bytes) -> None:
@@ -110,7 +121,13 @@ class ObjectRegistry:
                 # when a task retried after its worker sealed a return and
                 # then crashed — drop the duplicate payload.  Checked and
                 # set under the lock so two concurrent seals can't both win.
-                unlink = loc.shm_name
+                if loc.arena_path:
+                    dead.append(("arena", (loc.arena_key, None)))
+                    unlink = None
+                elif e.loc is not None and loc.shm_name == e.loc.shm_name:
+                    unlink = None  # same segment as the winner: never unlink
+                else:
+                    unlink = loc.shm_name
             else:
                 e.loc = loc
                 e.contained = list(contained or [])
@@ -178,7 +195,11 @@ class ObjectRegistry:
 
     def _delete_locked(self, oid: bytes, e: _Entry, dead: List[tuple]) -> None:
         if e.loc is not None:
-            if e.loc.shm_name:
+            if e.loc.arena_path:
+                dead.append(("arena", (e.loc.arena_key, e.loc.shm_name)))
+                if not e.loc.node_id:
+                    self._bytes_used -= e.loc.size
+            elif e.loc.shm_name:
                 dead.append(("shm", e.loc.shm_name))
                 if not e.loc.node_id:
                     self._bytes_used -= e.loc.size
@@ -195,6 +216,14 @@ class ObjectRegistry:
                     os.unlink(name)
                 except OSError:
                     pass
+            elif kind == "arena":
+                arena_key, copy_name = name
+                if self.arena_delete is not None and arena_key:
+                    self.arena_delete(arena_key)
+                if copy_name:  # remote pulled copies use the shm name
+                    ShmSegment.unlink(copy_name)
+                    if self.broadcast_unlink is not None:
+                        self.broadcast_unlink(copy_name)
             else:
                 # origin copy or pulled replica in this process's namespace
                 ShmSegment.unlink(name)
@@ -218,6 +247,7 @@ class ObjectRegistry:
                     for oid, e in self._objects.items()
                     if e.sealed.is_set() and e.loc is not None and e.loc.shm_name
                     and not e.loc.node_id  # remote segments aren't local files
+                    and not e.loc.arena_path  # arena slices spill via delete
                     and now - e.last_access >= _SPILL_MIN_IDLE_S
                 ]
                 if not candidates:
@@ -310,6 +340,74 @@ _ATTACHED: Dict[str, ShmSegment] = {}
 _ATTACHED_LOCK = threading.Lock()
 
 
+# Owner-side native arena (plasma analog); the head process sets this at
+# Node init.  Worker processes keep the per-object-file path.
+_OWNED_ARENA = None
+# reader-side cache: arena path -> memoryview over its mmap
+_ARENA_MAPS: Dict[str, memoryview] = {}
+_ARENA_MAPS_LOCK = threading.Lock()
+
+
+def set_owned_arena(arena) -> None:
+    global _OWNED_ARENA
+    _OWNED_ARENA = arena
+
+
+class _ArenaPin:
+    """Holds one head-side reference on an arena object for as long as any
+    zero-copy view of it is alive (the plasma client-pin analog: the slot
+    cannot be recycled under a live numpy array)."""
+
+    __slots__ = ("_oid",)
+
+    def __init__(self, oid: bytes):
+        self._oid = oid
+
+    def __del__(self):
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            client = global_worker.client
+            if client is not None and not client.closed:
+                client.remove_refs([self._oid])
+        except Exception:
+            pass
+
+
+class _PinnedSlice:
+    """Buffer-protocol proxy (PEP 688): exporting views through this keeps
+    the pin — and therefore the head-side reference — alive."""
+
+    __slots__ = ("_view", "_pin")
+
+    def __init__(self, view: memoryview, pin: _ArenaPin):
+        self._view = view
+        self._pin = pin
+
+    def __buffer__(self, flags):
+        return self._view
+
+
+def _arena_view(path: str) -> memoryview:
+    import mmap as mmap_mod
+
+    with _ARENA_MAPS_LOCK:
+        view = _ARENA_MAPS.get(path)
+        if view is None:
+            if _OWNED_ARENA is not None and _OWNED_ARENA.path == path:
+                view = _OWNED_ARENA.buf
+            else:
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    size = os.fstat(fd).st_size
+                    mm = mmap_mod.mmap(fd, size, prot=mmap_mod.PROT_READ)
+                finally:
+                    os.close(fd)
+                view = memoryview(mm)
+            _ARENA_MAPS[path] = view
+        return view
+
+
 def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[ObjectLocation, list]:
     """Serialize ``value``; write big payloads to shm. Returns (location, contained_refs)."""
     cfg = get_config()
@@ -319,10 +417,37 @@ def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[Obj
         blob = serialization.to_bytes(meta, buffers)
         return ObjectLocation(inline=blob, is_error=is_error), refs
     name = session_shm_name(ref.hex())
+    if _OWNED_ARENA is not None:
+        # native path: allocate a slice of the session arena and write in
+        # place (recycled pages skip the fresh-file fault-and-zero cost)
+        key = ref.binary()
+        off = _OWNED_ARENA.put(key, total)
+        if off is None and _OWNED_ARENA.get(key) is not None:
+            # a prior attempt of this task left an allocation (it may be
+            # SEALED and live — never touch it); index this attempt under
+            # a fresh key and let first-seal-wins pick the survivor
+            key = os.urandom(16)
+            off = _OWNED_ARENA.put(key, total)
+        if off is not None:
+            serialization.write_into(_OWNED_ARENA.buf[off:off + total], meta, buffers)
+            _OWNED_ARENA.seal(key)
+            return ObjectLocation(
+                shm_name=name, size=total, is_error=is_error,
+                arena_path=_OWNED_ARENA.path, arena_off=off, arena_key=key,
+            ), refs
+        # arena full: fall through to the per-object-file path
     # producer side writes through the fd (page-allocation path, ~2.4x the
     # mmap-memcpy bandwidth on tmpfs); consumers still mmap zero-copy
     path = ShmSegment.path_for(name)
-    fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+    except FileExistsError:
+        # a prior attempt of this task created this segment; it may be a
+        # SEALED live object — never unlink or rewrite it.  Publish this
+        # attempt under a unique name; first-seal-wins reaps the loser.
+        name = f"{name}-r{os.urandom(3).hex()}"
+        path = ShmSegment.path_for(name)
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
     try:
         written = serialization.write_to_fd(fd, meta, buffers)
         assert written == total, f"wrote {written}, expected {total}"
@@ -334,15 +459,51 @@ def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[Obj
     return ObjectLocation(shm_name=name, size=total, is_error=is_error), refs
 
 
-def read_value(loc: ObjectLocation) -> Any:
+def read_value(loc: ObjectLocation, oid: Optional[bytes] = None) -> Any:
     """Deserialize an object from its location (zero-copy for shm payloads;
     spilled objects are read back from disk; remote segments are pulled
-    into the local shm namespace first — ``ray.get`` step 3 in SURVEY §3.3)."""
+    into the local shm namespace first — ``ray.get`` step 3 in SURVEY §3.3).
+
+    ``oid`` enables zero-copy reads of arena-backed objects: the views are
+    pinned with a head-side reference so the slot can't be recycled under
+    them.  Without an oid, arena payloads are copied out for safety."""
     if loc.inline is not None:
         value = serialization.deserialize(memoryview(loc.inline))
     elif loc.spilled_path is not None:
         with open(loc.spilled_path, "rb") as f:
             value = serialization.deserialize(memoryview(f.read()))
+    elif loc.arena_path is not None:
+        try:
+            view = _arena_view(loc.arena_path)
+            payload = view[loc.arena_off:loc.arena_off + loc.size]
+            wrap = None
+            if oid is not None:
+                from ray_tpu._private.worker import global_worker
+
+                client = global_worker.client
+                if client is not None and not client.closed:
+                    # the caller's handle is live right now, so this
+                    # add_ref cannot race the object's deletion
+                    client.add_refs([oid])
+                    pin = _ArenaPin(oid)
+                    wrap = lambda v: _PinnedSlice(v, pin)  # noqa: E731
+            if wrap is None:
+                payload = memoryview(bytes(payload))  # safe copy
+            value = serialization.deserialize(payload, wrap_buffer=wrap)
+        except FileNotFoundError:
+            # remote node: pull a private copy named loc.shm_name
+            if not loc.fetch_addr:
+                raise
+            from ray_tpu._private import object_transfer
+
+            object_transfer.pull_object(
+                loc.shm_name, loc.fetch_addr, loc.size,
+                arena=(loc.arena_path, loc.arena_off),
+            )
+            seg = ShmSegment.attach(loc.shm_name, loc.size)
+            with _ATTACHED_LOCK:
+                seg = _ATTACHED.setdefault(loc.shm_name, seg)
+            value = serialization.deserialize(seg.buf)
     else:
         with _ATTACHED_LOCK:
             seg = _ATTACHED.get(loc.shm_name)

@@ -58,21 +58,36 @@ class ObjectServer:
                     msg = conn.recv()
                 except (EOFError, OSError):
                     return
-                name = msg.get("name", "")
-                # names are flat session-scoped identifiers; never serve a
-                # path outside the local shm dir
-                path = os.path.join(shm_dir(), os.path.basename(name))
+                if msg.get("arena"):
+                    # a slice of the local arena file (native store path)
+                    path = os.path.join(shm_dir(), os.path.basename(msg["arena"]))
+                    base, size = int(msg["off"]), int(msg["size"])
+                else:
+                    # names are flat session-scoped identifiers; never
+                    # serve a path outside the local shm dir
+                    path = os.path.join(shm_dir(), os.path.basename(msg.get("name", "")))
+                    base, size = 0, -1
                 try:
                     fd = os.open(path, os.O_RDONLY)
                 except OSError:
-                    conn.send({"ok": False, "error": f"no such segment {name}"})
+                    conn.send({"ok": False, "error": f"no such segment {path}"})
                     continue
                 try:
-                    size = os.fstat(fd).st_size
+                    file_size = os.fstat(fd).st_size
+                    if size < 0:
+                        size = file_size
+                    if base < 0 or base + size > file_size:
+                        conn.send({"ok": False,
+                                   "error": f"range [{base}, {base + size}) "
+                                            f"outside file of {file_size}"})
+                        continue
                     conn.send({"ok": True, "size": size})
                     off = 0
                     while off < size:
-                        data = os.pread(fd, min(CHUNK, size - off), off)
+                        data = os.pread(fd, min(CHUNK, size - off), base + off)
+                        if not data:  # hole/truncation race: fail the stream
+                            conn.close()
+                            return
                         conn.send_bytes(data)
                         off += len(data)
                 finally:
@@ -153,9 +168,13 @@ def _evict(addr: Addr, conn: Connection) -> None:
         pass
 
 
-def pull_object(name: str, addr: Addr, expected_size: int = -1) -> None:
+def pull_object(name: str, addr: Addr, expected_size: int = -1,
+                arena: Optional[tuple] = None) -> None:
     """Fetch segment ``name`` from the object server at ``addr`` into the
     local shm dir (PullManager analog: chunked transfer into local plasma).
+    With ``arena=(path, offset)`` the origin payload is an arena slice
+    rather than a standalone file; the local copy is still a file named
+    ``name``.
 
     Idempotent: if the local copy already exists, returns immediately.
     """
@@ -168,7 +187,11 @@ def pull_object(name: str, addr: Addr, expected_size: int = -1) -> None:
     fd = -1
     try:
         with req_lock:
-            conn.send({"name": name})
+            if arena is not None:
+                conn.send({"arena": arena[0], "off": arena[1],
+                           "size": expected_size})
+            else:
+                conn.send({"name": name})
             hdr = conn.recv()
             if not hdr.get("ok"):
                 # clean protocol state — no chunks follow an error header

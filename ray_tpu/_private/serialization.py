@@ -157,14 +157,20 @@ def to_bytes(meta: bytes, buffers: List[memoryview]) -> bytes:
     return bytes(out)
 
 
-def deserialize(src: memoryview) -> Any:
-    """Deserialize from the wire layout; buffers are zero-copy views of ``src``."""
+def deserialize(src: memoryview, wrap_buffer: Optional[Callable] = None) -> Any:
+    """Deserialize from the wire layout; buffers are zero-copy views of
+    ``src``.  ``wrap_buffer`` (view -> buffer-protocol object) interposes
+    on every out-of-band buffer — the arena store uses it to pin the
+    backing object alive for as long as any deserialized view exists."""
     (meta_len,) = _HEADER.unpack_from(src, 0)
     meta = bytes(src[_HEADER.size : _HEADER.size + meta_len])
     payload, table = pickle.loads(meta)
     off = _HEADER.size + _pad(meta_len)
     bufs = []
     for n in table:
-        bufs.append(pickle.PickleBuffer(src[off : off + n]))
+        view = src[off : off + n]
+        if wrap_buffer is not None:
+            view = memoryview(wrap_buffer(view))
+        bufs.append(pickle.PickleBuffer(view))
         off += _pad(n)
     return pickle.loads(payload, buffers=bufs)

@@ -178,12 +178,12 @@ class Worker:
         if locations is None:
             raise GetTimeoutError(f"Get timed out after {timeout}s for {len(oids)} objects")
         try:
-            return [read_value(locations[oid]) for oid in oids]
+            return [read_value(locations[oid], oid) for oid in oids]
         except FileNotFoundError:
             # segment spilled/moved between location reply and attach —
             # one refetch gets the fresh location
             locations = self.client.get_locations(list(set(oids)), timeout)
-            return [read_value(locations[oid]) for oid in oids]
+            return [read_value(locations[oid], oid) for oid in oids]
 
     def wait(
         self, refs: List[ObjectRef], num_returns: int, timeout: Optional[float]
@@ -357,13 +357,13 @@ def _completion_executor():
 
 def _resolve_args(spec: dict, dep_locs: Dict[bytes, ObjectLocation]) -> Tuple[tuple, dict]:
     if spec.get("args_oid"):
-        conv_args, conv_kwargs = read_value(dep_locs[spec["args_oid"]])
+        conv_args, conv_kwargs = read_value(dep_locs[spec["args_oid"]], spec["args_oid"])
     else:
         conv_args, conv_kwargs = serialization.deserialize(memoryview(spec["args_blob"]))
 
     def _resolve(v):
         if isinstance(v, _ArgPlaceholder):
-            return read_value(dep_locs[v.oid])
+            return read_value(dep_locs[v.oid], v.oid)
         return v
 
     args = tuple(_resolve(a) for a in conv_args)

@@ -1,0 +1,132 @@
+"""Native arena store (src/store_core — the plasma analog).
+
+The head's objects live as slices of one C++-managed arena: allocation,
+free-list recycling, index, eviction decommit.  Workers attach the arena
+file zero-copy on the same host; remote nodes pull arena slices through
+the object plane.
+"""
+
+import gc
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.native import available
+
+pytestmark = pytest.mark.skipif(not available(), reason="no C++ toolchain")
+
+
+def _arena():
+    node = ray_tpu._private.worker.global_worker.node
+    assert node.arena is not None, "native arena did not come up"
+    return node.arena
+
+
+def test_puts_go_through_arena(ray_start_regular):
+    arena = _arena()
+    before = arena.stats()["num_objects"]
+    ref = ray_tpu.put(np.ones(1 << 20))
+    stats = arena.stats()
+    assert stats["num_objects"] == before + 1
+    out = ray_tpu.get(ref)
+    assert out.nbytes == 8 << 20
+
+
+def test_arena_reclaims_on_ref_drop(ray_start_regular):
+    """The VERDICT bar: a loop putting throwaway arrays holds steady-state
+    memory — freed slices recycle through the C++ free list."""
+    arena = _arena()
+    for _ in range(12):
+        ref = ray_tpu.put(np.random.default_rng(0).standard_normal(4 << 20))  # 32MB
+        assert ray_tpu.get(ref).shape == (4 << 20,)
+        del ref
+        gc.collect()
+        ray_tpu.global_worker.flush_removals()
+    import time
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        gc.collect()
+        ray_tpu.global_worker.flush_removals()
+        if arena.stats()["bytes_used"] < 100 << 20:
+            break
+        time.sleep(0.3)
+    stats = arena.stats()
+    # 12 x 32MB churned; steady state must be far below the total
+    assert stats["bytes_used"] < 100 << 20, stats
+
+
+def test_worker_reads_arena_object(ray_start_regular):
+    """Same-host workers attach the arena file and slice zero-copy."""
+    payload = np.arange(1 << 20, dtype=np.float64)
+    ref = ray_tpu.put(payload)
+
+    @ray_tpu.remote
+    def total(x):
+        return float(np.sum(x))
+
+    assert ray_tpu.get(total.remote(ref), timeout=120) == pytest.approx(
+        float(np.sum(payload)))
+
+
+def test_remote_node_pulls_arena_slice():
+    """A driver-put arena object is pulled across the node boundary (the
+    arena-slice request path of the object server)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2}, real_processes=True)
+    try:
+        node_b = cluster.add_node(num_cpus=2)
+        arena = _arena()
+        payload = np.random.default_rng(1).standard_normal(1 << 20)  # 8MB
+        ref = ray_tpu.put(payload)
+        assert arena.stats()["num_objects"] >= 1
+
+        @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(node_b))
+        def checksum(x):
+            return float(np.sum(x))
+
+        assert ray_tpu.get(checksum.remote(ref), timeout=180) == pytest.approx(
+            float(np.sum(payload)))
+    finally:
+        cluster.shutdown()
+
+
+def test_zero_copy_views_pin_arena_slots(ray_start_regular):
+    """A live numpy view of an arena object must keep its slot pinned:
+    dropping the ObjectRef and churning new puts must NOT corrupt the
+    array (the plasma client-pin semantics)."""
+    arena = _arena()
+    payload = np.full(1 << 20, 7.0)
+    ref = ray_tpu.put(payload)
+    arr = ray_tpu.get(ref)  # zero-copy view into the arena
+    del ref
+    gc.collect()
+    ray_tpu.global_worker.flush_removals()
+    import time
+
+    time.sleep(1.5)
+    # churn allocations that would reuse a freed slot
+    for i in range(6):
+        r = ray_tpu.put(np.full(1 << 20, float(i)))
+        ray_tpu.get(r)
+        del r
+        gc.collect()
+        ray_tpu.global_worker.flush_removals()
+    assert float(arr[0]) == 7.0 and float(arr[-1]) == 7.0, "view corrupted!"
+    # once the view dies, the slot is reclaimable
+    used_with_pin = arena.stats()["bytes_used"]
+    del arr
+    gc.collect()
+    ray_tpu.global_worker.flush_removals()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        gc.collect()
+        ray_tpu.global_worker.flush_removals()
+        if arena.stats()["bytes_used"] < used_with_pin:
+            break
+        time.sleep(0.3)
+    assert arena.stats()["bytes_used"] < used_with_pin

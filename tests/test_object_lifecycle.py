@@ -23,8 +23,11 @@ def _session_shm_segments():
     from ray_tpu._private.shm import current_session_id
 
     prefix = f"{get_config().shm_prefix}-{current_session_id()}-"
+    # the arena file is the session's (bounded, self-reclaiming) store
+    # itself, not a leaked per-object segment
     return [n for n in os.listdir("/dev/shm")
-            if n.startswith(prefix) and not n.endswith("-alive")]
+            if n.startswith(prefix)
+            and not n.endswith(("-alive", "-arena"))]
 
 
 def _stats():
