@@ -21,7 +21,9 @@ import subprocess
 import sys
 
 N_STEPS = 12
-BATCH = 12
+# B=6 with the "dots" remat policy measured fastest on v5e (sweep over
+# B in {4..24} x {full, none, dots} remat; bandwidth-bound regime)
+BATCH = 6
 
 PEAK_BF16 = {
     "v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,

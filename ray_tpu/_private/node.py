@@ -250,6 +250,10 @@ class Node:
             logger.info("swept %d orphaned shm segments from dead sessions", swept)
         shm_mod.write_session_marker(self.session_id, os.getpid())
 
+        from ray_tpu._private import usage as _usage
+
+        _usage.reset()  # per-session scope for the usage report
+
         self.lock = threading.RLock()
         self.cond = threading.Condition(self.lock)
         self.registry = ObjectRegistry(
@@ -1898,5 +1902,11 @@ class Node:
             except Exception:
                 pass
         from ray_tpu._private import shm as shm_mod
+        from ray_tpu._private import usage
 
+        with self.gcs.lock:
+            usage.record_set("tasks_total", len(self.gcs.tasks))
+            usage.record_set("actors_total", len(self.gcs.actors))
+            usage.record_set("nodes_total", len(self.gcs.nodes))
+        usage.write_report(self.session_dir)
         shm_mod.remove_session_marker(self.session_id)

@@ -1,0 +1,78 @@
+"""Usage stats: opt-out, local-only session feature report.
+
+Analog of the reference's usage-stats subsystem
+(``python/ray/_private/usage/usage_lib.py`` — opt-out telemetry of which
+libraries/features a session used).  This environment has zero egress, so
+the report is written to the session directory (``usage_report.json``)
+instead of posted; the schema mirrors the reference's payload so an
+operator can aggregate reports themselves.
+
+Disable with ``RAY_TPU_USAGE_STATS_ENABLED=0``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Set
+
+_lock = threading.Lock()
+_features: Set[str] = set()
+_counters: Dict[str, int] = {}
+
+
+def reset() -> None:
+    """Start a fresh session scope (called at head start)."""
+    with _lock:
+        _features.clear()
+        _counters.clear()
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_USAGE_STATS_ENABLED", "1") not in ("0", "false")
+
+
+def record_feature(name: str) -> None:
+    """Mark a library/feature as used this session (cheap, idempotent)."""
+    if not enabled():
+        return
+    with _lock:
+        _features.add(name)
+
+
+def record_set(name: str, n: int) -> None:
+    """Set a counter to an absolute value (session totals at shutdown)."""
+    if not enabled():
+        return
+    with _lock:
+        _counters[name] = n
+
+
+def record_count(name: str, n: int = 1) -> None:
+    if not enabled():
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def write_report(session_dir: str, extra: Dict = None) -> str:
+    """Write the session's usage report (called at head shutdown)."""
+    if not enabled():
+        return ""
+    with _lock:
+        payload = {
+            "schema_version": "0.1",
+            "timestamp": time.time(),
+            "features_used": sorted(_features),
+            "counters": dict(_counters),
+            **(extra or {}),
+        }
+    path = os.path.join(session_dir, "usage_report.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=2)
+    except OSError:
+        return ""
+    return path

@@ -44,6 +44,10 @@ class TransformerConfig:
     causal: bool = True
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "dots": save matmul outputs, recompute elementwise (measured ~+6%
+    # over full remat at GPT-2 shapes on v5e — the backward re-reads saved
+    # MXU outputs instead of re-running them); "full": recompute all.
+    remat_policy: str = "dots"
     # pre-LN (GPT-2 style) by default; post-LN matches original BERT so
     # HF checkpoints load faithfully.
     post_ln: bool = False
@@ -186,7 +190,17 @@ def apply_stack(
         return apply_block(x, layer_params, cfg, mesh)
 
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy == "dots":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        elif cfg.remat_policy == "full":
+            body = jax.checkpoint(body)
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r} (use 'dots' or 'full')"
+            )
 
     def stage(local_blocks, h):
         h, auxs = lax.scan(body, h, local_blocks)

@@ -3,7 +3,8 @@
 Analog of the reference's ``rllib/`` minimal spine (SURVEY §2.4):
 ``Algorithm``/``AlgorithmConfig`` as Tune Trainables, ``RolloutWorker``
 actors gathered in a ``WorkerSet``, ``SampleBatch`` columns, GAE
-postprocessing, and PPO with a fully-jitted loss+update.
+postprocessing, PPO with a fully-jitted loss+update, and DQN with a
+replay buffer + target network (``rllib/algorithms/dqn``).
 """
 
 from ray_tpu.rllib.algorithm import (
@@ -12,9 +13,11 @@ from ray_tpu.rllib.algorithm import (
     synchronous_parallel_sample,
     train_one_step,
 )
+from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.postprocessing import compute_gae
 from ray_tpu.rllib.ppo import PPO, PPOConfig
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.rollout_worker import RolloutWorker
 from ray_tpu.rllib.sample_batch import SampleBatch
 from ray_tpu.rllib.worker_set import WorkerSet
@@ -24,6 +27,9 @@ __all__ = [
     "AlgorithmConfig",
     "PPO",
     "PPOConfig",
+    "DQN",
+    "DQNConfig",
+    "ReplayBuffer",
     "JaxPolicy",
     "RolloutWorker",
     "WorkerSet",

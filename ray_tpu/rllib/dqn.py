@@ -1,0 +1,181 @@
+"""DQN: off-policy Q-learning with replay + target network, in jax.
+
+Analog of ``/root/reference/rllib/algorithms/dqn/dqn.py`` (training_step:
+sample -> store to replay -> TD updates from replay -> periodic target
+sync) with the torch loss of ``dqn_torch_policy.py`` expressed as a pure
+jitted function.  The Q-network reuses the actor-critic MLP's logits head
+as Q-values; exploration is epsilon-greedy with a linear anneal.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, synchronous_parallel_sample
+from ray_tpu.rllib.models import apply_actor_critic
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def make_dqn_loss():
+    """Huber TD loss on Q(s, a) vs precomputed targets (the target-network
+    max lives outside the loss, computed with the frozen params)."""
+
+    def loss(params, batch):
+        q_all, _ = apply_actor_critic(params, batch[SampleBatch.OBS])
+        actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
+        q = jnp.take_along_axis(q_all, actions[:, None], axis=-1)[:, 0]
+        td = q - batch[SampleBatch.VALUE_TARGETS]
+        # Huber (delta=1)
+        abs_td = jnp.abs(td)
+        loss_val = jnp.mean(jnp.where(abs_td <= 1.0, 0.5 * td ** 2, abs_td - 0.5))
+        return loss_val, {"mean_q": jnp.mean(q), "mean_td_error": jnp.mean(abs_td)}
+
+    return loss
+
+
+def _dqn_loss_factory(config: Dict[str, Any]):
+    return make_dqn_loss()
+
+
+def _dqn_policy_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
+    """Exploration schedule from the (possibly .training()-overridden)
+    algorithm config to the per-worker policy constructors."""
+    return {
+        "epsilon_timesteps": config["epsilon_timesteps"],
+        "final_epsilon": config["final_epsilon"],
+    }
+
+
+class DQNPolicy(JaxPolicy):
+    """Epsilon-greedy acting + a frozen target network for TD targets."""
+
+    def __init__(self, *args, **kwargs):
+        self._epsilon_timesteps = kwargs.pop("epsilon_timesteps", 10_000)
+        self._final_epsilon = kwargs.pop("final_epsilon", 0.02)
+        super().__init__(*args, **kwargs)
+        self.target_params = jax.tree_util.tree_map(jnp.asarray, self.params)
+        self._steps = 0
+        self._np_rng = np.random.default_rng(kwargs.get("seed", 0) or 0)
+
+        @jax.jit
+        def _td_targets(target_params, next_obs, rewards, dones, gamma):
+            q_next, _ = apply_actor_critic(target_params, next_obs)
+            return rewards + gamma * (1.0 - dones) * q_next.max(axis=-1)
+
+        self._td_targets_jit = _td_targets
+
+        @jax.jit
+        def _q(params, obs):
+            q_all, _ = apply_actor_critic(params, obs)
+            return q_all
+
+        self._q_jit = _q
+
+    @property
+    def epsilon(self) -> float:
+        frac = min(1.0, self._steps / max(1, self._epsilon_timesteps))
+        return 1.0 + frac * (self._final_epsilon - 1.0)
+
+    def compute_actions(self, obs: np.ndarray):
+        q = np.asarray(self._q_jit(self.params, jnp.asarray(obs)))
+        greedy = np.argmax(q, axis=-1)
+        explore = self._np_rng.random(len(greedy)) < self.epsilon
+        random_a = self._np_rng.integers(0, self.num_actions, len(greedy))
+        actions = np.where(explore, random_a, greedy)
+        self._steps += len(greedy)
+        # logp/vf columns keep the RolloutWorker contract; DQN ignores them
+        logp = np.zeros(len(greedy), np.float32)
+        vf = q.max(axis=-1).astype(np.float32)
+        return actions.astype(np.int64), logp, vf
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        q = np.asarray(self._q_jit(self.params, jnp.asarray(obs)))
+        return q.max(axis=-1)
+
+    def compute_td_targets(self, batch: SampleBatch, gamma: float) -> np.ndarray:
+        dones = batch[SampleBatch.TERMINATEDS].astype(np.float32)
+        return np.asarray(self._td_targets_jit(
+            self.target_params,
+            jnp.asarray(batch[SampleBatch.NEXT_OBS]),
+            jnp.asarray(batch[SampleBatch.REWARDS]),
+            jnp.asarray(dones),
+            gamma,
+        ))
+
+    def update_target(self) -> None:
+        self.target_params = jax.tree_util.tree_map(jnp.asarray, self.params)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=DQN)
+        self._config.update(
+            _loss_factory=_dqn_loss_factory,
+            _policy_class=DQNPolicy,
+            _policy_kwargs_factory=_dqn_policy_kwargs,
+            _store_next_obs=True,
+            lr=5e-4,
+            gamma=0.99,
+            train_batch_size=32,
+            replay_buffer_capacity=50_000,
+            learning_starts=1000,
+            target_network_update_freq=500,
+            epsilon_timesteps=10_000,
+            final_epsilon=0.02,
+            timesteps_per_iteration=1000,
+            updates_per_iteration=250,
+            grad_clip=10.0,
+        )
+
+
+class DQN(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        super().setup(config)
+        self.replay = ReplayBuffer(
+            self.config["replay_buffer_capacity"],
+            seed=self.config.get("seed") or 0,
+        )
+        self._since_target_sync = 0
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        self.workers.sync_weights()
+        batch = synchronous_parallel_sample(
+            self.workers, max_env_steps=cfg["timesteps_per_iteration"]
+        )
+        self._timesteps_total += batch.count
+        self.replay.add_batch(batch)
+
+        policy: DQNPolicy = self.workers.local_worker.policy
+        learner_metrics: Dict[str, Any] = {}
+        if len(self.replay) >= cfg["learning_starts"]:
+            for _ in range(cfg["updates_per_iteration"]):
+                mb = self.replay.sample(cfg["train_batch_size"])
+                mb[SampleBatch.VALUE_TARGETS] = policy.compute_td_targets(
+                    mb, cfg["gamma"]
+                )
+                learner_metrics = policy.learn_on_minibatch({
+                    SampleBatch.OBS: mb[SampleBatch.OBS],
+                    SampleBatch.ACTIONS: mb[SampleBatch.ACTIONS],
+                    SampleBatch.VALUE_TARGETS: mb[SampleBatch.VALUE_TARGETS],
+                })
+                self._since_target_sync += 1
+                if self._since_target_sync >= cfg["target_network_update_freq"]:
+                    policy.update_target()
+                    self._since_target_sync = 0
+        # the schedule is deterministic in sampled timesteps, so this is
+        # correct for any rollout-worker count (the local policy only acts
+        # when num_rollout_workers == 0)
+        frac = min(1.0, self._timesteps_total / max(1, cfg["epsilon_timesteps"]))
+        learner_metrics["epsilon"] = 1.0 + frac * (cfg["final_epsilon"] - 1.0)
+        learner_metrics["replay_size"] = len(self.replay)
+        return {"info": {"learner": learner_metrics}}
+
+
+DQN._default_config = DQNConfig().to_dict()

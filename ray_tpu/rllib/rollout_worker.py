@@ -41,7 +41,14 @@ class RolloutWorker:
         from ray_tpu.rllib.policy import JaxPolicy
 
         loss_factory = config.get("_loss_factory")
-        self.policy = JaxPolicy(
+        policy_cls = config.get("_policy_class") or JaxPolicy
+        # algorithm-specific policy constructor args travel as one dict
+        # (or a factory over the live config) so this worker stays
+        # algorithm-agnostic
+        pk_factory = config.get("_policy_kwargs_factory")
+        extra = (dict(pk_factory(config)) if pk_factory
+                 else dict(config.get("_policy_kwargs") or {}))
+        self.policy = policy_cls(
             obs_dim,
             num_actions,
             lr=config.get("lr", 5e-4),
@@ -49,7 +56,9 @@ class RolloutWorker:
             seed=seed,  # per-worker: decorrelates action sampling rng
             loss_fn=loss_factory(config) if loss_factory else None,
             grad_clip=config.get("grad_clip", 0.5),
+            **extra,
         )
+        self._store_next_obs = bool(config.get("_store_next_obs"))
         self.gamma = config.get("gamma", 0.99)
         self.lambda_ = config.get("lambda_", 0.95)
         self.fragment_length = config.get("rollout_fragment_length", 200)
@@ -65,11 +74,14 @@ class RolloutWorker:
     def sample(self) -> SampleBatch:
         """One fragment of ``rollout_fragment_length`` steps, GAE-complete
         (``rollout_worker.py`` sample -> SamplerInput analog)."""
-        cols: Dict[str, List] = {k: [] for k in (
+        keys = [
             SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
             SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS,
             SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS, SampleBatch.EPS_ID,
-        )}
+        ]
+        if self._store_next_obs:  # off-policy algorithms need transitions
+            keys.append(SampleBatch.NEXT_OBS)
+        cols: Dict[str, List] = {k: [] for k in keys}
         segments: List[SampleBatch] = []
         seg_start = 0
 
@@ -97,6 +109,10 @@ class RolloutWorker:
             cols[SampleBatch.ACTION_LOGP].append(np.float32(logp[0]))
             cols[SampleBatch.VF_PREDS].append(np.float32(vf[0]))
             cols[SampleBatch.EPS_ID].append(self._eps_id)
+            if self._store_next_obs:
+                cols[SampleBatch.NEXT_OBS].append(
+                    np.asarray(next_obs, np.float32).reshape(-1)
+                )
             self._episode_reward += float(reward)
             self._episode_len += 1
             self._total_steps += 1

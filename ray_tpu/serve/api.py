@@ -216,6 +216,8 @@ def run(
 ) -> DeploymentHandle:
     """Deploy an application and wait until healthy (``api.py:455``).
     Returns a handle to the root deployment."""
+    from ray_tpu._private.usage import record_feature
+    record_feature("serve")
     import ray_tpu
 
     if isinstance(target, Deployment):

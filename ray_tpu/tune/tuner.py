@@ -79,6 +79,9 @@ class Tuner:
         return path
 
     def fit(self) -> ResultGrid:
+        from ray_tpu._private.usage import record_feature
+        record_feature("tune")
+
         tc = self.tune_config
         if self._preloaded_trials is not None:
             trials = self._preloaded_trials

@@ -174,6 +174,8 @@ def _run_sync(dag: DAGNode, storage: WorkflowStorage,
 def run(dag: DAGNode, *, workflow_id: Optional[str] = None,
         args: tuple = (), kwargs: Optional[dict] = None) -> Any:
     """Run a DAG durably; blocks and returns the final result."""
+    from ray_tpu._private.usage import record_feature
+    record_feature("workflow")
     _check_task_dag(dag)
     workflow_id = workflow_id or f"wf-{os.urandom(4).hex()}"
     storage = WorkflowStorage(workflow_id)

@@ -8,6 +8,7 @@ submission (``dashboard/modules/job/job_manager.py:431``), ``ray`` CLI
 """
 
 import json
+import os
 import sys
 import time
 import urllib.request
@@ -269,3 +270,26 @@ def test_profiling_timed_scope(ray_start_regular):
     # span() is a no-op without opentelemetry installed
     with profiling.span("noop-span"):
         pass
+
+
+def test_usage_report_written(tmp_path):
+    """Opt-out usage stats: a session report lands in the session dir
+    (local-only; the reference posts the same schema to a collector)."""
+    import ray_tpu
+    from ray_tpu._private import usage
+
+    ray_tpu.init(num_cpus=2)
+    node = ray_tpu._private.worker.global_worker.node
+    session_dir = node.session_dir
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+    usage.record_feature("unit-test-feature")
+    ray_tpu.shutdown()
+
+    report = json.load(open(os.path.join(session_dir, "usage_report.json")))
+    assert "unit-test-feature" in report["features_used"]
+    assert report["counters"]["tasks_total"] >= 1
