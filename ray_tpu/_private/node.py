@@ -1539,6 +1539,20 @@ class Node:
                 art.info.death_cause = f"creation failed: {error}"
             else:
                 art.info.state = "ALIVE"
+                # A defaulted num_cpus=1 was placement-only: reference actors
+                # occupy 0 CPU once created, so long-lived idle actors don't
+                # starve tasks out of the node (actor.py release_cpu_after_start).
+                if art.info.creation_spec.get("release_cpu_after_start") and art.held.get(CPU):
+                    ns = self.nodes.get(art.node_id)
+                    bundle = getattr(art, "bundle", None)
+                    pool = (
+                        bundle.available
+                        if bundle is not None and not bundle.detached
+                        else (ns.available if ns is not None else None)
+                    )
+                    if pool is not None and w.block_depth == 0:
+                        _release({CPU: art.held[CPU]}, pool)
+                    art.held[CPU] = 0.0
             self.cond.notify_all()
         if failed:
             self._release_spec_pins(art.info.creation_spec)
@@ -1908,5 +1922,8 @@ class Node:
             usage.record_set("tasks_total", len(self.gcs.tasks))
             usage.record_set("actors_total", len(self.gcs.actors))
             usage.record_set("nodes_total", len(self.gcs.nodes))
+        # fold in features recorded by worker/driver processes via KV
+        for key in self.gcs.kv_keys("usage"):
+            usage.record_feature(key.decode(errors="replace"))
         usage.write_report(self.session_dir)
         shm_mod.remove_session_marker(self.session_id)

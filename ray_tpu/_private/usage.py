@@ -35,11 +35,24 @@ def enabled() -> bool:
 
 
 def record_feature(name: str) -> None:
-    """Mark a library/feature as used this session (cheap, idempotent)."""
+    """Mark a library/feature as used this session (cheap, idempotent).
+
+    Works from any process: worker/driver processes also publish the flag
+    to the head's KV (namespace ``usage``) so features exercised inside
+    actors — e.g. a Tune trial importing rllib — reach the head's report."""
     if not enabled():
         return
     with _lock:
+        if name in _features:
+            return
         _features.add(name)
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        if global_worker.connected and global_worker.client is not None:
+            global_worker.client.kv_put("usage", name.encode(), b"1")
+    except Exception:
+        pass  # never let telemetry break the caller
 
 
 def record_set(name: str, n: int) -> None:

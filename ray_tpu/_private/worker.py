@@ -243,6 +243,7 @@ class Worker:
         actor_name: Optional[str] = None,
         runtime_env: Optional[dict] = None,
         max_concurrency: int = 1,
+        release_cpu_after_start: bool = False,
     ) -> Tuple[dict, List[ObjectRef]]:
         cfg = get_config()
         dep_ids: List[bytes] = []
@@ -303,6 +304,7 @@ class Worker:
             "actor_name": actor_name,
             "runtime_env": runtime_env,
             "max_concurrency": max_concurrency,
+            "release_cpu_after_start": release_cpu_after_start,
         }
         return spec, [
             self.track_ref(ObjectRef(oid), owned=True) for oid in return_ids

@@ -130,8 +130,9 @@ class ActorClass:
                 for _, m in inspect.getmembers(self._cls, inspect.isfunction)
             )
             max_concurrency = 1000 if is_async else 1
-        # Actors default to 1 CPU for placement but hold 0 while idle in the
-        # reference; we hold what was requested for the actor's lifetime.
+        # Actors default to 1 CPU for placement but occupy 0 once created
+        # (reference semantics); an explicit num_cpus is held for life.
+        cpu_defaulted = options.get("num_cpus") is None
         resources = ray_option_utils.resources_from_options(options, default_num_cpus=1)
         spec, return_refs = w.build_task_spec(
             name=f"{self._cls.__name__}.__init__",
@@ -148,6 +149,7 @@ class ActorClass:
             actor_name=options.get("name"),
             runtime_env=options.get("runtime_env"),
             max_concurrency=max_concurrency,
+            release_cpu_after_start=cpu_defaulted,
         )
         w.client.create_actor(spec)
         return ActorHandle(actor_id, self._cls.__name__)

@@ -82,6 +82,11 @@ class DQNPolicy(JaxPolicy):
         frac = min(1.0, self._steps / max(1, self._epsilon_timesteps))
         return 1.0 + frac * (self._final_epsilon - 1.0)
 
+    def on_global_timestep(self, timesteps_total: int) -> None:
+        """Anneal from GLOBAL sampled steps — with N workers each stepping
+        locally, per-policy counts would decay the schedule N× too slowly."""
+        self._steps = int(timesteps_total)
+
     def compute_actions(self, obs: np.ndarray):
         q = np.asarray(self._q_jit(self.params, jnp.asarray(obs)))
         greedy = np.argmax(q, axis=-1)
@@ -146,6 +151,7 @@ class DQN(Algorithm):
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
         self.workers.sync_weights()
+        self.workers.sync_global_vars(self._timesteps_total)
         batch = synchronous_parallel_sample(
             self.workers, max_env_steps=cfg["timesteps_per_iteration"]
         )
@@ -169,9 +175,7 @@ class DQN(Algorithm):
                 if self._since_target_sync >= cfg["target_network_update_freq"]:
                     policy.update_target()
                     self._since_target_sync = 0
-        # the schedule is deterministic in sampled timesteps, so this is
-        # correct for any rollout-worker count (the local policy only acts
-        # when num_rollout_workers == 0)
+        # sync_global_vars pins every acting policy to this same schedule
         frac = min(1.0, self._timesteps_total / max(1, cfg["epsilon_timesteps"]))
         learner_metrics["epsilon"] = 1.0 + frac * (cfg["final_epsilon"] - 1.0)
         learner_metrics["replay_size"] = len(self.replay)

@@ -76,23 +76,31 @@ class RolloutWorker:
         (``rollout_worker.py`` sample -> SamplerInput analog)."""
         keys = [
             SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
-            SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS,
-            SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS, SampleBatch.EPS_ID,
+            SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS, SampleBatch.EPS_ID,
         ]
-        if self._store_next_obs:  # off-policy algorithms need transitions
+        if self._store_next_obs:
+            # off-policy algorithms store raw transitions; logp/vf/GAE
+            # columns would be dead weight in the replay buffer
             keys.append(SampleBatch.NEXT_OBS)
+        else:
+            keys += [SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS]
         cols: Dict[str, List] = {k: [] for k in keys}
         segments: List[SampleBatch] = []
         seg_start = 0
 
-        def close_segment(last_value: float):
+        def close_segment(last_value_fn):
             nonlocal seg_start
             if seg_start >= len(cols[SampleBatch.OBS]):
                 return
             seg = SampleBatch({
                 k: np.asarray(v[seg_start:]) for k, v in cols.items()
             })
-            segments.append(compute_gae(seg, last_value, self.gamma, self.lambda_))
+            if self._store_next_obs:
+                segments.append(seg)  # TD targets are recomputed at replay time
+            else:
+                segments.append(
+                    compute_gae(seg, last_value_fn(), self.gamma, self.lambda_)
+                )
             seg_start = len(cols[SampleBatch.OBS])
 
         for _ in range(self.fragment_length):
@@ -106,8 +114,9 @@ class RolloutWorker:
             cols[SampleBatch.REWARDS].append(np.float32(reward))
             cols[SampleBatch.TERMINATEDS].append(terminated)
             cols[SampleBatch.TRUNCATEDS].append(truncated)
-            cols[SampleBatch.ACTION_LOGP].append(np.float32(logp[0]))
-            cols[SampleBatch.VF_PREDS].append(np.float32(vf[0]))
+            if not self._store_next_obs:
+                cols[SampleBatch.ACTION_LOGP].append(np.float32(logp[0]))
+                cols[SampleBatch.VF_PREDS].append(np.float32(vf[0]))
             cols[SampleBatch.EPS_ID].append(self._eps_id)
             if self._store_next_obs:
                 cols[SampleBatch.NEXT_OBS].append(
@@ -119,12 +128,12 @@ class RolloutWorker:
             self._obs = next_obs
             if terminated or truncated:
                 # terminal: no bootstrap; truncation: bootstrap v(s_T)
-                last_value = 0.0 if terminated else float(
+                _next = next_obs
+                close_segment(lambda: 0.0 if terminated else float(
                     self.policy.value(
-                        np.asarray(next_obs, np.float32).reshape(1, -1)
+                        np.asarray(_next, np.float32).reshape(1, -1)
                     )[0]
-                )
-                close_segment(last_value)
+                ))
                 self._episode_rewards.append(self._episode_reward)
                 self._episode_lengths.append(self._episode_len)
                 self._episode_reward = 0.0
@@ -132,7 +141,7 @@ class RolloutWorker:
                 self._eps_id += 1
                 self._obs, _ = self.env.reset()
         # fragment ended mid-episode: bootstrap with v(current obs)
-        close_segment(float(
+        close_segment(lambda: float(
             self.policy.value(np.asarray(self._obs, np.float32).reshape(1, -1))[0]
         ))
         return SampleBatch.concat_samples(segments)
@@ -148,6 +157,13 @@ class RolloutWorker:
             "episodes_total": self._eps_id - self.worker_index * 1_000_000,
             "worker_steps": self._total_steps,
         }
+
+    def set_global_vars(self, timesteps_total: int) -> bool:
+        """Pin the policy's exploration schedule to global progress."""
+        hook = getattr(self.policy, "on_global_timestep", None)
+        if hook is not None:
+            hook(timesteps_total)
+        return True
 
     def get_weights(self):
         return self.policy.get_weights()

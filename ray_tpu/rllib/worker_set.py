@@ -39,6 +39,18 @@ class WorkerSet:
             [w.set_weights.remote(ref) for w in self.remote_workers], timeout=120
         )
 
+    def sync_global_vars(self, timesteps_total: int) -> None:
+        """Broadcast the global env-step count so per-worker exploration
+        schedules (e.g. epsilon anneal) track global progress instead of
+        each worker's local step count (reference: WorkerSet.sync_weights
+        global_vars propagation)."""
+        self.local_worker.set_global_vars(timesteps_total)
+        if self.remote_workers:
+            ray_tpu.get(
+                [w.set_global_vars.remote(timesteps_total) for w in self.remote_workers],
+                timeout=120,
+            )
+
     def synchronous_parallel_sample(self) -> SampleBatch:
         """One sampling round across all workers
         (``execution/rollout_ops.py:21`` analog)."""

@@ -1,5 +1,6 @@
 """Utilities (analog of ``python/ray/util``)."""
 
+from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import placement_group, remove_placement_group
 from ray_tpu.util.scheduling_strategies import (
     NodeAffinitySchedulingStrategy,
@@ -7,6 +8,7 @@ from ray_tpu.util.scheduling_strategies import (
 )
 
 __all__ = [
+    "ActorPool",
     "placement_group",
     "remove_placement_group",
     "NodeAffinitySchedulingStrategy",

@@ -1,0 +1,110 @@
+"""ActorPool + distributed Queue (reference: python/ray/tests/test_actor_pool.py,
+test_queue.py)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+
+@ray_tpu.remote
+class Doubler:
+    def double(self, x):
+        return 2 * x
+
+    def slow_double(self, x):
+        time.sleep(0.05 * (x % 3))
+        return 2 * x
+
+
+def test_actor_pool_map_ordered(ray_start_regular):
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    out = list(pool.map(lambda a, x: a.slow_double.remote(x), range(10)))
+    assert out == [2 * x for x in range(10)]
+
+
+def test_actor_pool_map_unordered(ray_start_regular):
+    pool = ActorPool([Doubler.remote() for _ in range(3)])
+    out = list(pool.map_unordered(lambda a, x: a.slow_double.remote(x), range(10)))
+    assert sorted(out) == [2 * x for x in range(10)]
+
+
+def test_actor_pool_submit_get_next(ray_start_regular):
+    pool = ActorPool([Doubler.remote() for _ in range(2)])
+    pool.submit(lambda a, x: a.double.remote(x), 1)
+    pool.submit(lambda a, x: a.double.remote(x), 2)
+    assert pool.get_next() == 2
+    assert pool.get_next() == 4
+    assert not pool.has_next()
+
+
+def test_actor_pool_push_pop(ray_start_regular):
+    pool = ActorPool([Doubler.remote()])
+    extra = Doubler.remote()
+    pool.push(extra)
+    a = pool.pop_idle()
+    assert a is not None
+    pool.submit(lambda a, x: a.double.remote(x), 5)
+    assert pool.get_next() == 10
+
+
+def test_queue_basic(ray_start_regular):
+    q = Queue()
+    q.put(1)
+    q.put("two")
+    assert q.qsize() == 2
+    assert q.get() == 1
+    assert q.get() == "two"
+    assert q.empty()
+
+
+def test_queue_nowait_and_batch(ray_start_regular):
+    q = Queue(maxsize=2)
+    q.put_nowait(1)
+    q.put_nowait(2)
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    assert q.get_nowait() == 1
+    with pytest.raises(Full):  # batch of 2 does not fit next to the 1 left
+        q.put_nowait_batch([10, 11])
+    assert q.get_nowait() == 2
+    q.put_nowait_batch([10, 11])
+    with pytest.raises(Empty):
+        Queue().get_nowait()
+    assert q.get_nowait_batch(10) == [10, 11]
+
+
+def test_queue_blocking_get(ray_start_regular):
+    q = Queue()
+
+    def producer():
+        time.sleep(0.3)
+        q.put("late")
+
+    t = threading.Thread(target=producer)
+    t.start()
+    assert q.get(timeout=5) == "late"
+    t.join()
+
+
+def test_queue_get_timeout(ray_start_regular):
+    q = Queue()
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+
+
+def test_queue_across_tasks(ray_start_regular):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return n
+
+    ray_tpu.get(producer.remote(q, 5))
+    assert [q.get() for _ in range(5)] == list(range(5))
