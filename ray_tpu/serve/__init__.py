@@ -19,7 +19,7 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
-from ray_tpu.serve.config import DeploymentConfig, HTTPOptions
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve._private.http_util import Request
 
@@ -27,6 +27,7 @@ __all__ = [
     "deployment",
     "Deployment",
     "DeploymentConfig",
+    "AutoscalingConfig",
     "Application",
     "run",
     "start",

@@ -5,10 +5,12 @@ Analog of ``python/ray/serve/controller.py:61`` (ServeController) plus the
 holds declarative deployment goal state, diffs it against live replica
 actors, and converges — creating replicas, replacing dead ones (detected by
 a background health loop pinging each replica), scaling up/down, and
-propagating ``user_config`` via ``reconfigure``.  Routers and proxies pull
-routing tables from here (the reference pushes via LongPollHost; with
-single-in-flight actor calls a blocking long-poll would wedge the
-controller, so consumers poll with a short TTL instead).
+propagating ``user_config`` via ``reconfigure``.  Routers and proxies get
+routing tables via ``listen_for_change`` — a LongPollHost-style blocking
+poll (``serve/_private/long_poll.py:185``) parked on the controller's
+threaded executor — with a TTL pull as fallback.  Demand-driven replica
+autoscaling (``_private/autoscaling_policy.py`` analog) sizes deployments
+from router-reported ongoing-request counts.
 """
 
 from __future__ import annotations
@@ -18,6 +20,8 @@ import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
+
+import math
 
 from ray_tpu.serve.config import (
     MAX_CONSECUTIVE_START_FAILURES,
@@ -51,6 +55,10 @@ class _DeploymentState:
         self.consecutive_failures = 0  # replica deaths with no RUNNING between
         self.unhealthy_reason: Optional[str] = None
         self.last_probe = 0.0
+        # autoscaling: per-router ongoing-request reports + decision smoothing
+        self.handle_metrics: Dict[str, Tuple[float, float]] = {}  # router -> (count, ts)
+        self.scale_direction = 0  # sign of the pending decision
+        self.scale_pending_since = 0.0
 
     @property
     def config(self) -> DeploymentConfig:
@@ -61,12 +69,20 @@ class ServeController:
     def __init__(self, http_config: Optional[dict] = None):
         self._deployments: Dict[str, _DeploymentState] = {}
         self._lock = threading.RLock()
+        # LongPollHost analog: routers park in listen_for_change on this
+        # condition; every version bump notifies it (requires the controller
+        # actor to run with max_concurrency > #parked listeners)
+        self._changed = threading.Condition(self._lock)
         self._stopped = threading.Event()
         self._http_config = http_config or {}
         self._health_thread = threading.Thread(
             target=self._health_loop, daemon=True, name="serve-health"
         )
         self._health_thread.start()
+        self._autoscale_thread = threading.Thread(
+            target=self._autoscale_loop, daemon=True, name="serve-autoscale"
+        )
+        self._autoscale_thread.start()
 
     # ------------------------------------------------------------------
     # control-plane API (called by serve.api / proxies / handles)
@@ -75,8 +91,22 @@ class ServeController:
         """Set/replace a deployment's goal state and converge toward it
         (``controller.py`` deploy -> DeploymentState.deploy analog)."""
         goal["config"].validate()
+        auto = goal["config"].autoscaling_config
         with self._lock:
             state = self._deployments.get(name)
+            if auto is not None:
+                # the autoscaler owns num_replicas: new deployments start at
+                # the floor; a redeploy keeps the current autoscaled size
+                # (clamped to the new bounds) so config tweaks don't collapse
+                # live capacity
+                prev = state.config if state is not None else None
+                if prev is not None and prev.autoscaling_config is not None:
+                    goal["config"].num_replicas = max(
+                        auto.min_replicas,
+                        min(auto.max_replicas, prev.num_replicas),
+                    )
+                else:
+                    goal["config"].num_replicas = auto.min_replicas
             if state is None:
                 self._deployments[name] = state = _DeploymentState(name, goal)
             else:
@@ -104,7 +134,7 @@ class ServeController:
                             r.handle.reconfigure.remote(goal["config"].user_config)
                         except Exception:
                             pass
-                state.version += 1
+                self._bump(state)
             self._reconcile(state)
         return True
 
@@ -117,6 +147,7 @@ class ServeController:
             for r in list(state.replicas):
                 self._stop_replica(state, r)
             del self._deployments[name]
+            self._changed.notify_all()  # wake listeners on the deleted name
         return True
 
     def get_routing_info(self, name: str) -> Optional[dict]:
@@ -183,10 +214,108 @@ class ServeController:
                 for r in list(state.replicas):
                     self._stop_replica(state, r)
             self._deployments.clear()
+            self._changed.notify_all()  # release parked long-poll listeners
         return True
 
     def ping(self) -> str:
         return "pong"
+
+    def _bump(self, state: _DeploymentState) -> None:
+        """Version bump + wake every parked long-poll listener (lock held)."""
+        state.version += 1
+        self._changed.notify_all()
+
+    def listen_for_change(
+        self, name: str, known_version: int, timeout_s: float = 30.0
+    ) -> Optional[dict]:
+        """LongPollHost analog (``serve/_private/long_poll.py:185``): block
+        until the deployment's routing info is newer than ``known_version``
+        (or the timeout lapses), then return the fresh snapshot.  Runs on
+        the controller's threaded executor, so parked listeners don't block
+        other control-plane calls."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while not self._stopped.is_set():
+                state = self._deployments.get(name)
+                if state is None or state.version != known_version:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._changed.wait(remaining)
+        return self.get_routing_info(name)
+
+    # ------------------------------------------------------------------
+    # autoscaling (serve/_private/autoscaling_policy.py analog)
+    # ------------------------------------------------------------------
+    def record_handle_metrics(
+        self, name: str, router_id: str, num_ongoing: float
+    ) -> None:
+        """Routers report their in-flight request count here (the
+        reference's handle autoscaling-metrics push)."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is not None:
+                state.handle_metrics[router_id] = (float(num_ongoing), time.monotonic())
+
+    def get_autoscaling_metrics(self, name: str) -> Optional[dict]:
+        """Live router load reports for one deployment (observability)."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None:
+                return None
+            now = time.monotonic()
+            return {
+                rid: {"ongoing": c, "age_s": now - ts}
+                for rid, (c, ts) in state.handle_metrics.items()
+            }
+
+    def _autoscale_once(self, state: _DeploymentState, now: float) -> None:
+        """One scaling decision for one deployment (lock held)."""
+        cfg = state.config.autoscaling_config
+        if cfg is None or state.deleting or state.unhealthy_reason:
+            return
+        # drop reports from routers that stopped reporting (dead handles) —
+        # freshness-filtering alone would leak one entry per router ever seen
+        stale = [
+            rid for rid, (_, ts) in state.handle_metrics.items()
+            if now - ts > cfg.look_back_period_s
+        ]
+        for rid in stale:
+            del state.handle_metrics[rid]
+        total_ongoing = sum(c for c, _ in state.handle_metrics.values())
+        desired = math.ceil(
+            total_ongoing / cfg.target_num_ongoing_requests_per_replica
+        )
+        desired = max(cfg.min_replicas, min(cfg.max_replicas, desired))
+        current = state.config.num_replicas
+        direction = (desired > current) - (desired < current)
+        if direction == 0:
+            state.scale_direction = 0
+            return
+        if direction != state.scale_direction:
+            state.scale_direction = direction
+            state.scale_pending_since = now
+            return
+        delay = cfg.upscale_delay_s if direction > 0 else cfg.downscale_delay_s
+        if now - state.scale_pending_since < delay:
+            return
+        logger.info(
+            "serve: autoscaling %s %d -> %d (ongoing=%.1f)",
+            state.name, current, desired, total_ongoing,
+        )
+        state.config.num_replicas = desired
+        state.scale_direction = 0
+        self._reconcile(state)
+        self._bump(state)
+
+    def _autoscale_loop(self) -> None:
+        while not self._stopped.is_set():
+            now = time.monotonic()
+            with self._lock:
+                for state in list(self._deployments.values()):
+                    self._autoscale_once(state, now)
+            self._stopped.wait(0.5)
 
     # ------------------------------------------------------------------
     # reconciliation (deployment_state.py:958 update loop)
@@ -207,7 +336,7 @@ class ServeController:
             )[: len(live) - goal_n]
             for r in victims:
                 self._stop_replica(state, r)
-            state.version += 1
+            self._bump(state)
 
     def _start_replica(self, state: _DeploymentState) -> None:
         import ray_tpu
@@ -237,7 +366,7 @@ class ServeController:
         # the actor is killed.
         if replica in state.replicas:
             state.replicas.remove(replica)
-        state.version += 1
+        self._bump(state)
         grace = state.config.graceful_shutdown_timeout_s
 
         def drain():
@@ -293,12 +422,12 @@ class ServeController:
                         if alive:
                             if r.state == ReplicaState.STARTING:
                                 r.state = ReplicaState.RUNNING
-                                state.version += 1
+                                self._bump(state)
                                 state.consecutive_failures = 0
                                 logger.info("serve: replica %s RUNNING", r.tag)
                         else:
                             state.replicas.remove(r)
-                            state.version += 1
+                            self._bump(state)
                             if r.state == ReplicaState.STARTING:
                                 state.consecutive_failures += 1
                             if (

@@ -4,9 +4,10 @@ Analog of ``python/ray/serve/_private/router.py:221`` (ReplicaSet with
 ``max_concurrent_queries``) + ``:261`` (assign_replica): least-loaded
 selection among RUNNING replicas, counting this router's in-flight calls
 per replica, blocking when every replica is at its cap until an in-flight
-call drains.  Each handle/proxy owns a Router (per-caller accounting, as in
-the reference); the replica membership is pulled from the controller with a
-short TTL instead of the reference's long-poll push.
+call drains.  Replica membership arrives via a LongPollClient-style
+listener thread parked in the controller's ``listen_for_change`` (TTL pull
+as fallback); routers also report ongoing-request counts that feed the
+controller's autoscaler.
 """
 
 from __future__ import annotations
@@ -20,6 +21,8 @@ from ray_tpu.serve.config import ROUTE_TABLE_TTL_S
 
 class Router:
     def __init__(self, controller_handle, deployment_name: str):
+        import uuid
+
         self._controller = controller_handle
         self._name = deployment_name
         self._lock = threading.Lock()
@@ -29,6 +32,68 @@ class Router:
         self._last_refresh = 0.0
         self._inflight: Dict[str, List[Any]] = {}  # tag -> [ObjectRef]
         self._rr = 0  # round-robin tiebreak among equally-loaded replicas
+        self._router_id = uuid.uuid4().hex[:12]
+        self._last_metrics_push = 0.0
+        self._listener_started = False
+        # callers inside assign_request that have not been assigned a
+        # replica yet — queued demand the autoscaler must see
+        self._pending = 0
+
+    def _ensure_listener(self) -> None:
+        """LongPollClient analog (``long_poll.py:68``): a daemon thread
+        parks in the controller's listen_for_change and applies membership
+        updates the moment they happen (the TTL pull stays as a fallback
+        for missed notifications).  The threads hold only a weakref — when
+        the Router is garbage-collected they exit on their next cycle, so
+        handle churn can't leak threads or parked controller slots."""
+        import weakref
+
+        with self._lock:
+            if self._listener_started:
+                return
+            self._listener_started = True
+        ref = weakref.ref(self)
+        t = threading.Thread(
+            target=_listen_loop, args=(ref,), daemon=True,
+            name=f"router-poll-{self._name}",
+        )
+        t.start()
+        # periodic prune+report even when no requests arrive — without it a
+        # gone-idle router's last (high) in-flight report would pin the
+        # autoscaler at peak size until look_back_period expires
+        m = threading.Thread(
+            target=_metrics_loop, args=(ref,), daemon=True,
+            name=f"router-metrics-{self._name}",
+        )
+        m.start()
+
+    def _apply_routing_info(self, info: dict) -> None:
+        with self._lock:
+            self._last_refresh = time.monotonic()
+            self._version = info["version"]
+            self._max_concurrent = info["max_concurrent_queries"]
+            self._replicas = info["replicas"]
+            live = {tag for tag, _ in self._replicas}
+            self._inflight = {
+                tag: refs for tag, refs in self._inflight.items() if tag in live
+            }
+
+    def _push_metrics(self) -> None:
+        """Throttled fire-and-forget ongoing-request report feeding the
+        controller's autoscaler."""
+        now = time.monotonic()
+        if now - self._last_metrics_push < 0.5:
+            return
+        self._last_metrics_push = now
+        # ongoing = assigned + queued (the reference's num_ongoing_requests
+        # counts queued handle requests too — autoscaling_policy.py)
+        total = self._pending + sum(len(refs) for refs in self._inflight.values())
+        try:
+            self._controller.record_handle_metrics.remote(
+                self._name, self._router_id, total
+            )
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     def _refresh(self, force: bool = False) -> None:
@@ -40,18 +105,12 @@ class Router:
         info = ray_tpu.get(
             self._controller.get_routing_info.remote(self._name), timeout=30
         )
-        with self._lock:
-            self._last_refresh = now
-            if info is None:
+        if info is None:
+            with self._lock:
+                self._last_refresh = now
                 self._replicas = []
-                return
-            self._version = info["version"]
-            self._max_concurrent = info["max_concurrent_queries"]
-            self._replicas = info["replicas"]
-            live = {tag for tag, _ in self._replicas}
-            self._inflight = {
-                tag: refs for tag, refs in self._inflight.items() if tag in live
-            }
+            return
+        self._apply_routing_info(info)
 
     def _prune_inflight(self) -> None:
         """Drop completed refs from the in-flight ledgers (lock held)."""
@@ -98,30 +157,43 @@ class Router:
         from ray_tpu.exceptions import GetTimeoutError
 
         deadline = time.monotonic() + timeout if timeout is not None else None
+        self._ensure_listener()
         force = False
-        while True:
-            self._refresh(force=force)
-            force = False
-            with self._lock:
-                self._prune_inflight()
-                picked = self._pick()
-                if picked is not None:
-                    tag, handle = picked
-                    ref = handle.handle_request.remote(method_name, args, kwargs)
-                    self._inflight.setdefault(tag, []).append(ref)
-                    return ref
-                waitable = [r for refs in self._inflight.values() for r in refs]
-            if deadline is not None and time.monotonic() >= deadline:
-                raise GetTimeoutError(
-                    f"no replica of {self._name!r} available within {timeout}s"
-                )
-            if waitable:
-                # our own backpressure: wait for one in-flight call to drain
-                ray_tpu.wait(waitable, num_returns=1, timeout=0.5)
-            else:
-                # deployment still starting (or scaled to 0): poll membership
-                time.sleep(0.1)
-                force = True
+        with self._lock:
+            self._pending += 1  # queued demand, visible to the autoscaler
+        assigned = False
+        try:
+            while True:
+                self._refresh(force=force)
+                force = False
+                with self._lock:
+                    self._prune_inflight()
+                    picked = self._pick()
+                    if picked is not None:
+                        tag, handle = picked
+                        self._pending -= 1
+                        assigned = True
+                        ref = handle.handle_request.remote(method_name, args, kwargs)
+                        self._inflight.setdefault(tag, []).append(ref)
+                        self._push_metrics()
+                        return ref
+                    self._push_metrics()
+                    waitable = [r for refs in self._inflight.values() for r in refs]
+                if deadline is not None and time.monotonic() >= deadline:
+                    raise GetTimeoutError(
+                        f"no replica of {self._name!r} available within {timeout}s"
+                    )
+                if waitable:
+                    # our own backpressure: wait for one in-flight call to drain
+                    ray_tpu.wait(waitable, num_returns=1, timeout=0.5)
+                else:
+                    # deployment still starting (or scaled to 0): poll membership
+                    time.sleep(0.1)
+                    force = True
+        finally:
+            if not assigned:
+                with self._lock:
+                    self._pending -= 1
 
     def on_replica_error(self, ref) -> None:
         """Caller observed a RayActorError from ``ref``: evict that replica
@@ -140,3 +212,51 @@ class Router:
                     (t, h) for t, h in self._replicas if t != dead_tag
                 ]
             self._last_refresh = 0.0
+
+
+# ---------------------------------------------------------------------------
+# background loops — module functions over a weakref so a dropped Router is
+# collectable and its threads unwind instead of leaking
+# ---------------------------------------------------------------------------
+
+
+def _listen_loop(router_ref) -> None:
+    import ray_tpu
+
+    while True:
+        router = router_ref()
+        if router is None:
+            return
+        controller, name, version = router._controller, router._name, router._version
+        del router  # don't pin the Router across the blocking poll
+        try:
+            info = ray_tpu.get(
+                controller.listen_for_change.remote(name, version, 30.0),
+                timeout=45,
+            )
+        except Exception:
+            time.sleep(1.0)
+            continue
+        router = router_ref()
+        if router is None:
+            return
+        if info is not None:
+            router._apply_routing_info(info)
+        else:
+            # deployment gone (deleted or not yet deployed): don't hammer
+            # the controller with back-to-back polls
+            time.sleep(1.0)
+
+
+def _metrics_loop(router_ref) -> None:
+    while True:
+        time.sleep(2.0)
+        router = router_ref()
+        if router is None:
+            return
+        try:
+            with router._lock:
+                router._prune_inflight()
+                router._push_metrics()
+        except Exception:
+            pass

@@ -16,7 +16,15 @@ from typing import Any, Callable, Dict, Optional, Tuple, Union
 
 import cloudpickle
 
-from ray_tpu.serve.config import DeploymentConfig, HTTPOptions
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+
+
+def _coerce_autoscaling(v) -> Optional[AutoscalingConfig]:
+    if v is None or isinstance(v, AutoscalingConfig):
+        return v
+    if isinstance(v, dict):
+        return AutoscalingConfig(**v)
+    raise TypeError(f"autoscaling_config must be a dict or AutoscalingConfig, got {type(v)}")
 from ray_tpu.serve.handle import DeploymentHandle
 
 _client: Optional["_ServeClient"] = None
@@ -47,10 +55,13 @@ class Deployment:
         user_config: Optional[Any] = None,
         ray_actor_options: Optional[Dict] = None,
         route_prefix: Optional[str] = "__unset__",
+        autoscaling_config: Optional[Any] = "__unset__",
     ) -> "Deployment":
         cfg = copy.deepcopy(self.config)
         if num_replicas is not None:
             cfg.num_replicas = num_replicas
+        if autoscaling_config != "__unset__":
+            cfg.autoscaling_config = _coerce_autoscaling(autoscaling_config)
         if max_concurrent_queries is not None:
             cfg.max_concurrent_queries = max_concurrent_queries
         if user_config is not None:
@@ -98,6 +109,7 @@ def deployment(
     user_config: Optional[Any] = None,
     ray_actor_options: Optional[Dict] = None,
     route_prefix: Optional[str] = "__auto__",
+    autoscaling_config: Optional[Any] = None,
 ) -> Union[Deployment, Callable[[Callable], Deployment]]:
     """``@serve.deployment`` decorator (``api.py:251`` analog)."""
 
@@ -107,6 +119,7 @@ def deployment(
             max_concurrent_queries=max_concurrent_queries,
             user_config=user_config,
             ray_actor_options=dict(ray_actor_options or {}),
+            autoscaling_config=_coerce_autoscaling(autoscaling_config),
         )
         return Deployment(
             func_or_class,
@@ -157,7 +170,9 @@ def start(http_options: Optional[HTTPOptions] = None, _http: bool = True) -> _Se
     except Exception:
         controller = (
             ray_tpu.remote(ServeController)
-            .options(name=CONTROLLER_NAME)
+            # threaded executor: long-poll listeners park for up to 30 s
+            # each and must not starve control-plane calls
+            .options(name=CONTROLLER_NAME, max_concurrency=64)
             .remote()
         )
         ray_tpu.get(controller.ping.remote(), timeout=60)

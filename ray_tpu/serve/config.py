@@ -12,6 +12,31 @@ from typing import Any, Dict, Optional
 
 
 @dataclass
+class AutoscalingConfig:
+    """Demand-driven replica scaling (``serve/config.py`` AutoscalingConfig +
+    ``_private/autoscaling_policy.py`` analog).  The controller aggregates
+    ongoing-request counts reported by routers and sizes the replica set to
+    ``total_ongoing / target_num_ongoing_requests_per_replica``, smoothed by
+    the up/downscale delays."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_num_ongoing_requests_per_replica: float = 1.0
+    upscale_delay_s: float = 2.0
+    downscale_delay_s: float = 10.0
+    # router metric reports older than this are dropped from the aggregate
+    look_back_period_s: float = 10.0
+
+    def validate(self) -> None:
+        if self.min_replicas < 0 or self.max_replicas < max(1, self.min_replicas):
+            raise ValueError(
+                "need 0 <= min_replicas <= max_replicas (and max_replicas >= 1)"
+            )
+        if self.target_num_ongoing_requests_per_replica <= 0:
+            raise ValueError("target_num_ongoing_requests_per_replica must be > 0")
+
+
+@dataclass
 class DeploymentConfig:
     """Goal-state knobs the controller reconciles toward
     (``serve/config.py`` DeploymentConfig analog)."""
@@ -22,12 +47,15 @@ class DeploymentConfig:
     ray_actor_options: Dict[str, Any] = field(default_factory=dict)
     health_check_period_s: float = 2.0
     graceful_shutdown_timeout_s: float = 10.0
+    autoscaling_config: Optional[AutoscalingConfig] = None
 
     def validate(self) -> None:
         if self.num_replicas < 0:
             raise ValueError("num_replicas must be >= 0")
         if self.max_concurrent_queries <= 0:
             raise ValueError("max_concurrent_queries must be > 0")
+        if self.autoscaling_config is not None:
+            self.autoscaling_config.validate()
 
 
 # How long routers/proxies trust a cached routing snapshot before re-pulling

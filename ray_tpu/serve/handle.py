@@ -10,7 +10,11 @@ destination process.
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+_router_cache: Dict[Tuple[str, str], Any] = {}
+_router_cache_lock = threading.Lock()
 
 
 class _MethodCaller:
@@ -40,7 +44,16 @@ class DeploymentHandle:
                 from ray_tpu.serve._private.controller import CONTROLLER_NAME
 
                 self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
-            self._router = Router(self._controller, self.deployment_name)
+            # one Router per (controller, deployment) per process: handles
+            # are cheap to churn, and each Router owns background
+            # listener/metrics threads that must stay bounded
+            key = (self._controller._id_hex, self.deployment_name)
+            with _router_cache_lock:
+                router = _router_cache.get(key)
+                if router is None:
+                    router = Router(self._controller, self.deployment_name)
+                    _router_cache[key] = router
+            self._router = router
         return self._router
 
     def _remote(self, method: str, args, kwargs):

@@ -252,3 +252,81 @@ def test_http_404_and_delete(serve_instance):
 
     serve.delete("ping")
     assert "ping" not in serve.status()
+
+
+def test_autoscaling_up_and_down(serve_instance):
+    """Router-reported load drives replica count between min and max
+    (autoscaling_policy analog)."""
+
+    @serve.deployment(
+        autoscaling_config={
+            "min_replicas": 1,
+            "max_replicas": 3,
+            "target_num_ongoing_requests_per_replica": 1.0,
+            "upscale_delay_s": 0.5,
+            "downscale_delay_s": 1.5,
+            "look_back_period_s": 4.0,
+        },
+        max_concurrent_queries=2,
+    )
+    class Slow:
+        def __call__(self, request=None):
+            time.sleep(0.4)
+            return "done"
+
+    serve.run(Slow.bind(), port=0)
+    assert serve.status()["Slow"]["num_replicas_goal"] == 1
+    handle = serve.get_deployment_handle("Slow")
+
+    # sustained burst: keep ~6 requests in flight until the controller
+    # scales past 1 replica
+    deadline = time.monotonic() + 60
+    goal = 1
+    inflight = [handle.remote() for _ in range(6)]
+    while time.monotonic() < deadline:
+        done, pending = ray_tpu.wait(inflight, num_returns=1, timeout=5)
+        for r in done:
+            ray_tpu.get(r, timeout=60)
+        inflight = list(pending) + [handle.remote() for _ in range(len(done))]
+        goal = serve.status()["Slow"]["num_replicas_goal"]
+        if goal >= 2:
+            break
+    for r in inflight:
+        ray_tpu.get(r, timeout=120)
+    assert goal >= 2, f"never scaled up (goal={goal})"
+
+    # idle: scales back down to min_replicas
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if serve.status()["Slow"]["num_replicas_goal"] == 1:
+            break
+        time.sleep(0.5)
+    assert serve.status()["Slow"]["num_replicas_goal"] == 1
+    serve.delete("Slow")
+
+
+def test_long_poll_membership_propagation(serve_instance):
+    """A scale-up reaches existing handles without waiting out the TTL
+    (LongPollHost/Client analog)."""
+
+    @serve.deployment(num_replicas=1)
+    class Pid:
+        def __init__(self):
+            import os
+
+            self.pid = os.getpid()
+
+        def __call__(self, request=None):
+            return self.pid
+
+    serve.run(Pid.bind(), port=0)
+    handle = serve.get_deployment_handle("Pid")
+    assert isinstance(ray_tpu.get(handle.remote(), timeout=60), int)
+
+    serve.run(Pid.options(num_replicas=2).bind(), port=0)
+    deadline = time.monotonic() + 90
+    pids = set()
+    while time.monotonic() < deadline and len(pids) < 2:
+        pids.add(ray_tpu.get(handle.remote(), timeout=60))
+    assert len(pids) == 2
+    serve.delete("Pid")
