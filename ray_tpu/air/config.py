@@ -49,3 +49,6 @@ class RunConfig:
     failure_config: Optional[FailureConfig] = None
     checkpoint_config: Optional[CheckpointConfig] = None
     verbose: int = 1
+    # tune.Callback instances (loggers etc.); factories taking the
+    # experiment dir (e.g. CSVLoggerCallback) are instantiated by Tuner
+    callbacks: Optional[list] = None

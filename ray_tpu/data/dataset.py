@@ -440,6 +440,79 @@ class Dataset:
         ray_tpu.get(self._blocks)
         return self
 
+    def to_torch(
+        self,
+        *,
+        label_column: Optional[str] = None,
+        feature_columns: Optional[List[str]] = None,
+        batch_size: int = 1,
+        prefetch_blocks: int = 1,
+        drop_last: bool = False,
+        unsqueeze_label_tensor: bool = True,
+    ):
+        """Torch IterableDataset over this Dataset (``dataset.py:2835``
+        analog) — each item is ``(features, label)`` (or just features with
+        no ``label_column``), batched to ``batch_size``."""
+        import torch
+        from torch.utils.data import IterableDataset
+
+        outer = self
+
+        class _TorchIterable(IterableDataset):
+            def __iter__(self):
+                for batch in outer.iter_batches(
+                    batch_size=batch_size,
+                    batch_format="numpy",
+                    prefetch_blocks=prefetch_blocks,
+                    drop_last=drop_last,
+                ):
+                    if isinstance(batch, dict):
+                        if label_column is not None:
+                            label = torch.as_tensor(batch[label_column])
+                            if unsqueeze_label_tensor and label.dim() == 1:
+                                label = label.unsqueeze(1)
+                            cols = feature_columns or [
+                                c for c in batch if c != label_column
+                            ]
+                            if not cols:
+                                raise ValueError(
+                                    "to_torch: no feature columns left after "
+                                    f"excluding label {label_column!r}"
+                                )
+                            # always (N, C) float32 — shape/dtype must not
+                            # flip when the feature list grows past one
+                            flat = [
+                                torch.as_tensor(
+                                    np.asarray(batch[c], np.float32)
+                                ).reshape(len(label), -1)
+                                for c in cols
+                            ]
+                            feats = torch.cat(flat, dim=1)
+                            yield feats, label
+                        else:
+                            yield {k: torch.as_tensor(np.asarray(v))
+                                   for k, v in batch.items()}
+                    else:
+                        yield torch.as_tensor(np.asarray(batch))
+
+        return _TorchIterable()
+
+    def iter_torch_batches(
+        self, *, batch_size: Optional[int] = None, prefetch_blocks: int = 1,
+        drop_last: bool = False,
+    ) -> Iterator[Any]:
+        """Batches as torch tensors (``iter_torch_batches`` analog)."""
+        import torch
+
+        for batch in self.iter_batches(
+            batch_size=batch_size or 256, batch_format="numpy",
+            prefetch_blocks=prefetch_blocks, drop_last=drop_last,
+        ):
+            if isinstance(batch, dict):
+                yield {k: torch.as_tensor(np.asarray(v)) for k, v in batch.items()}
+            else:
+                yield torch.as_tensor(np.asarray(batch))
+
     # -- pipeline ------------------------------------------------------
     def window(self, *, blocks_per_window: int = 1) -> "DatasetPipeline":
         from ray_tpu.data.dataset_pipeline import DatasetPipeline

@@ -17,6 +17,9 @@ from typing import Any, Callable, Dict, Optional, Tuple, Union
 import cloudpickle
 
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.handle import DeploymentHandle
+
+_client: Optional["_ServeClient"] = None
 
 
 def _coerce_autoscaling(v) -> Optional[AutoscalingConfig]:
@@ -25,9 +28,6 @@ def _coerce_autoscaling(v) -> Optional[AutoscalingConfig]:
     if isinstance(v, dict):
         return AutoscalingConfig(**v)
     raise TypeError(f"autoscaling_config must be a dict or AutoscalingConfig, got {type(v)}")
-from ray_tpu.serve.handle import DeploymentHandle
-
-_client: Optional["_ServeClient"] = None
 
 
 class Deployment:
@@ -170,9 +170,10 @@ def start(http_options: Optional[HTTPOptions] = None, _http: bool = True) -> _Se
     except Exception:
         controller = (
             ray_tpu.remote(ServeController)
-            # threaded executor: long-poll listeners park for up to 30 s
-            # each and must not starve control-plane calls
-            .options(name=CONTROLLER_NAME, max_concurrency=64)
+            # threaded executor: every router parks one 30 s long-poll here,
+            # so headroom must exceed any realistic router count or the
+            # control plane wedges behind parked listeners
+            .options(name=CONTROLLER_NAME, max_concurrency=512)
             .remote()
         )
         ray_tpu.get(controller.ping.remote(), timeout=60)

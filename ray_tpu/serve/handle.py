@@ -11,9 +11,15 @@ destination process.
 from __future__ import annotations
 
 import threading
+import weakref
 from typing import Any, Dict, Optional, Tuple
 
-_router_cache: Dict[Tuple[str, str], Any] = {}
+# weak values: a Router lives only while some handle references it, so a
+# deleted deployment's router (and its background threads, which hold only a
+# weakref) unwinds once its handles are dropped
+_router_cache: "weakref.WeakValueDictionary[Tuple[str, str], Any]" = (
+    weakref.WeakValueDictionary()
+)
 _router_cache_lock = threading.Lock()
 
 

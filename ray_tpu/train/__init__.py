@@ -9,11 +9,15 @@ global SPMD program.
 
 from ray_tpu.air import Checkpoint, Result, RunConfig, ScalingConfig
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
+from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
 from ray_tpu.train.worker_group import WorkerGroup
 from ray_tpu.train import jax_utils
 
 __all__ = [
+    "Predictor",
+    "JaxPredictor",
+    "BatchPredictor",
     "Backend",
     "BackendConfig",
     "JaxConfig",

@@ -1,0 +1,157 @@
+"""Predictors: checkpoint -> inference, single-batch and over Datasets.
+
+Analog of the reference's ``python/ray/train/predictor.py`` (Predictor) and
+``python/ray/train/batch_predictor.py`` (BatchPredictor): a Predictor turns
+an AIR :class:`~ray_tpu.air.Checkpoint` into a callable model; a
+BatchPredictor scores a whole :class:`~ray_tpu.data.Dataset` by fanning the
+predictor out over an actor pool (``num_tpus=1`` actors put one jitted model
+on each chip — the TPU batch-inference path of BASELINE's XGBoost
+batch-prediction rows).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Union
+
+import numpy as np
+
+from ray_tpu.air import Checkpoint
+
+
+class Predictor:
+    """Base predictor (``train/predictor.py`` analog).
+
+    Subclasses implement :meth:`from_checkpoint` and :meth:`predict` over a
+    numpy batch (an ``np.ndarray`` or a ``{column: np.ndarray}`` dict).
+    """
+
+    @classmethod
+    def from_checkpoint(cls, checkpoint: Checkpoint, **kwargs) -> "Predictor":
+        raise NotImplementedError
+
+    def predict(self, batch: Union[np.ndarray, Dict[str, np.ndarray]], **kwargs):
+        raise NotImplementedError
+
+
+class JaxPredictor(Predictor):
+    """Predictor over a jitted jax apply function.
+
+    ``apply_fn(params, batch) -> predictions``; params come from the
+    checkpoint (``params_key`` selects them out of a training-state dict).
+    The function is jitted once and reused across batches, so the per-batch
+    cost on TPU is one device transfer + one compiled call.
+    """
+
+    def __init__(self, params: Any, apply_fn: Callable, *, jit: bool = True):
+        import jax
+
+        self._params = params
+        self._apply = jax.jit(apply_fn) if jit else apply_fn
+
+    @classmethod
+    def from_checkpoint(
+        cls,
+        checkpoint: Checkpoint,
+        apply_fn: Callable,
+        *,
+        params_key: str = "params",
+        jit: bool = True,
+        **_kwargs,
+    ) -> "JaxPredictor":
+        data = checkpoint.to_dict()
+        params = data.get(params_key, data) if isinstance(data, dict) else data
+        return cls(params, apply_fn, jit=jit)
+
+    def predict(self, batch, **kwargs):
+        out = self._apply(self._params, batch)
+        import jax
+
+        return jax.tree_util.tree_map(np.asarray, out)
+
+
+class _ScoringWrapper:
+    """The callable-class map_batches runs on each actor: builds the
+    predictor once per actor (model lives on that actor's chip), then scores
+    batches (``batch_predictor.py`` ScoringWrapper analog)."""
+
+    def __init__(
+        self,
+        checkpoint_blob: bytes,
+        predictor_cls: type,
+        predictor_kwargs: dict,
+        feature_columns,
+        keep_columns,
+    ):
+        import cloudpickle
+
+        checkpoint = cloudpickle.loads(checkpoint_blob)
+        self._predictor = predictor_cls.from_checkpoint(checkpoint, **predictor_kwargs)
+        self._feature_columns = feature_columns
+        self._keep_columns = keep_columns
+
+    def __call__(self, batch):
+        feats = batch
+        if self._feature_columns is not None and isinstance(batch, dict):
+            if len(self._feature_columns) == 1:
+                feats = batch[self._feature_columns[0]]
+            else:
+                feats = {c: batch[c] for c in self._feature_columns}
+        preds = self._predictor.predict(feats)
+        if not isinstance(preds, dict):
+            preds = {"predictions": np.asarray(preds)}
+        if self._keep_columns and isinstance(batch, dict):
+            for c in self._keep_columns:
+                preds[c] = batch[c]
+        return preds
+
+
+class BatchPredictor:
+    """Score a Dataset with an actor pool of predictors
+    (``train/batch_predictor.py`` analog)."""
+
+    def __init__(self, checkpoint: Checkpoint, predictor_cls: type, **predictor_kwargs):
+        if not (isinstance(predictor_cls, type) and issubclass(predictor_cls, Predictor)):
+            raise TypeError(f"predictor_cls must be a Predictor subclass, got {predictor_cls!r}")
+        self._checkpoint = checkpoint
+        self._predictor_cls = predictor_cls
+        self._predictor_kwargs = predictor_kwargs
+
+    @classmethod
+    def from_checkpoint(
+        cls, checkpoint: Checkpoint, predictor_cls: type, **predictor_kwargs
+    ) -> "BatchPredictor":
+        return cls(checkpoint, predictor_cls, **predictor_kwargs)
+
+    def predict(
+        self,
+        data,
+        *,
+        batch_size: Optional[int] = None,
+        min_scoring_workers: int = 1,
+        max_scoring_workers: int = 2,
+        num_tpus_per_worker: float = 0,
+        num_cpus_per_worker: float = 1,
+        feature_columns=None,
+        keep_columns=None,
+    ):
+        """Returns a Dataset of predictions (lazy, like the input)."""
+        import cloudpickle
+
+        from ray_tpu.data.dataset import ActorPoolStrategy
+
+        ckpt_blob = cloudpickle.dumps(self._checkpoint)
+        return data.map_batches(
+            _ScoringWrapper,
+            batch_size=batch_size,
+            compute=ActorPoolStrategy(
+                size=max(min_scoring_workers, max_scoring_workers)
+            ),
+            fn_constructor_args=(
+                ckpt_blob,
+                self._predictor_cls,
+                self._predictor_kwargs,
+                feature_columns,
+                keep_columns,
+            ),
+            num_tpus=num_tpus_per_worker,
+        )

@@ -7,6 +7,8 @@ continue/stop decisions on reported results, and Train runs on Tune via
 ``BaseTrainer.as_trainable``.
 """
 
+from ray_tpu.tune.callback import Callback, CSVLoggerCallback, JSONLoggerCallback
+from ray_tpu.tune.search.searcher import Searcher, TPESearcher
 from ray_tpu.tune.trainable import Trainable, wrap_function
 from ray_tpu.tune.search.sample import (
     choice,
@@ -27,6 +29,11 @@ from ray_tpu.tune.result_grid import ResultGrid
 __all__ = [
     "Trainable",
     "wrap_function",
+    "Callback",
+    "CSVLoggerCallback",
+    "JSONLoggerCallback",
+    "Searcher",
+    "TPESearcher",
     "uniform",
     "loguniform",
     "choice",

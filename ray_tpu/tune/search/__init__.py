@@ -1,4 +1,5 @@
 from ray_tpu.tune.search.basic_variant import BasicVariantGenerator
+from ray_tpu.tune.search.searcher import Searcher, TPESearcher
 from ray_tpu.tune.search.sample import (
     Categorical,
     Domain,
@@ -13,6 +14,8 @@ from ray_tpu.tune.search.sample import (
 
 __all__ = [
     "BasicVariantGenerator",
+    "Searcher",
+    "TPESearcher",
     "Domain",
     "Float",
     "Integer",

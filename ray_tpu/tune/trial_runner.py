@@ -66,6 +66,9 @@ class TrialRunner:
         max_failures: int = 0,
         stop: Optional[Dict[str, Any]] = None,
         trial_timeout_s: Optional[float] = None,
+        searcher: Optional[Any] = None,
+        num_samples: Optional[int] = None,
+        callbacks: Optional[List[Any]] = None,
     ):
         self.trainable_blob = cloudpickle.dumps(trainable_cls)
         self.trials = trials
@@ -77,6 +80,18 @@ class TrialRunner:
         # a train() iteration exceeding this is a failure (hung-trial
         # deadline — without it one wedged trial stalls the experiment)
         self.trial_timeout_s = trial_timeout_s
+        # adaptive search: new trials are suggested as slots free up, so
+        # later suggestions see earlier results (Searcher interface)
+        self.searcher = searcher
+        self.num_samples = num_samples or len(trials)
+        self.callbacks = callbacks or []
+
+    def _callback(self, hook: str, trial, *args) -> None:
+        for cb in self.callbacks:
+            try:
+                getattr(cb, hook)(trial, *args)
+            except Exception:  # noqa: BLE001 — a logger must not kill the loop
+                logger.exception("callback %s.%s failed", cb, hook)
 
     # -- scheduler support services -----------------------------------
     def get_trial(self, trial_id: str) -> Optional[T.Trial]:
@@ -145,10 +160,25 @@ class TrialRunner:
         """One event-loop turn; returns False when the experiment is done."""
         running = [t for t in self.trials if t.status == T.RUNNING]
         pending = [t for t in self.trials if t.status == T.PENDING]
+        if self.searcher is not None:
+            # top up from the searcher: each suggestion sees all completed
+            # results reported so far
+            while (
+                len(self.trials) < self.num_samples
+                and len(running) + len(pending) < self.max_concurrent
+            ):
+                trial = T.Trial(config={})
+                cfg = self.searcher.suggest(trial.trial_id)
+                if cfg is None:
+                    break
+                trial.config = cfg
+                self.trials.append(trial)
+                pending.append(trial)
         if not running and not pending:
             return False
         for t in pending[: max(0, self.max_concurrent - len(running))]:
             self._start_trial(t)
+            self._callback("on_trial_start", t)
             running.append(t)
         if not running:
             return False
@@ -173,6 +203,9 @@ class TrialRunner:
                     if trial.num_failures > self.max_failures:
                         trial.error = f"trial timed out after {self.trial_timeout_s}s"
                         self._stop_trial(trial, T.ERROR, save=False, graceful=False)
+                        self._callback("on_trial_error", trial)
+                        if self.searcher is not None:
+                            self.searcher.on_trial_complete(trial.trial_id, None)
                     else:
                         self._stop_trial(trial, T.PENDING, save=False, graceful=False)
         for fut in ready:
@@ -184,25 +217,41 @@ class TrialRunner:
                 if trial.num_failures > self.max_failures:
                     trial.error = str(e)
                     self._stop_trial(trial, T.ERROR, save=False)
+                    self._callback("on_trial_error", trial)
+                    if self.searcher is not None:
+                        self.searcher.on_trial_complete(trial.trial_id, None)
                 else:
                     self._stop_trial(trial, T.PENDING, save=False)
                 continue
             # merge: the synthetic terminal {done: True} must not clobber the
             # last real metrics
             trial.last_result = {**(trial.last_result or {}), **result}
+            self._callback("on_trial_result", trial, result)
             if self._should_stop(result):
                 self.scheduler.on_trial_complete(self, trial, result)
                 self._stop_trial(trial, T.TERMINATED)
+                self._finish_trial(trial)
                 continue
             decision = self.scheduler.on_trial_result(self, trial, result)
             if decision == STOP:
                 self._stop_trial(trial, T.TERMINATED)
+                self._finish_trial(trial)
             else:
                 trial.future = trial.actor.train.remote()
                 trial.future_started = time.time()
         return True
 
+    def _finish_trial(self, trial: T.Trial) -> None:
+        self._callback("on_trial_complete", trial)
+        if self.searcher is not None:
+            self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+
     def run(self) -> List[T.Trial]:
         while self.step():
             pass
+        for cb in self.callbacks:
+            try:
+                cb.on_experiment_end(self.trials)
+            except Exception:  # noqa: BLE001
+                logger.exception("callback on_experiment_end failed")
         return self.trials

@@ -83,14 +83,23 @@ class Tuner:
         record_feature("tune")
 
         tc = self.tune_config
+        exp_dir = self._exp_dir()
+        searcher = None
         if self._preloaded_trials is not None:
             trials = self._preloaded_trials
+        elif tc.search_alg is not None and hasattr(tc.search_alg, "suggest"):
+            # adaptive Searcher (TPE etc.): trials are suggested as slots
+            # free so later suggestions learn from earlier results
+            searcher = tc.search_alg
+            trials = []
         else:
             gen = tc.search_alg or BasicVariantGenerator()
             trials = [
                 T.Trial(config=cfg)
                 for cfg in gen.variants(self.param_space, tc.num_samples)
             ]
+        callbacks = list(self.run_config.callbacks or [])
+        callbacks = [cb(exp_dir) if isinstance(cb, type) else cb for cb in callbacks]
         runner = TrialRunner(
             self.trainable,
             trials,
@@ -100,8 +109,10 @@ class Tuner:
             max_failures=tc.max_failures,
             stop=tc.stop,
             trial_timeout_s=tc.trial_timeout_s,
+            searcher=searcher,
+            num_samples=tc.num_samples,
+            callbacks=callbacks,
         )
-        exp_dir = self._exp_dir()
         try:
             runner.run()
         finally:

@@ -254,3 +254,23 @@ def test_iter_batches_early_break(ray_start_regular):
     while prefetchers() and _time.time() < deadline:
         _time.sleep(0.1)
     assert not prefetchers(), f"leaked prefetch threads: {prefetchers()}"
+
+
+def test_to_torch_and_iter_torch_batches(ray_start_regular):
+    import torch
+
+    from ray_tpu.data import read_api
+
+    rows = [{"x": float(i), "y": 2.0 * i} for i in range(16)]
+    ds = read_api.from_items(rows)
+    batches = list(ds.iter_torch_batches(batch_size=8))
+    assert all(isinstance(b["x"], torch.Tensor) for b in batches)
+    total = torch.cat([b["x"] for b in batches])
+    assert sorted(total.tolist()) == [float(i) for i in range(16)]
+
+    it = ds.to_torch(label_column="y", feature_columns=["x"], batch_size=4)
+    feats, labels = next(iter(it))
+    assert isinstance(feats, torch.Tensor) and isinstance(labels, torch.Tensor)
+    assert feats.shape == (4, 1) and feats.dtype == torch.float32
+    assert labels.shape[-1] == 1
+    torch.testing.assert_close(labels.double(), (feats * 2).double())

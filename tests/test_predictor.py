@@ -1,0 +1,66 @@
+"""Predictor / BatchPredictor (reference: python/ray/train/tests/test_predictor.py,
+test_batch_predictor.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.air import Checkpoint
+from ray_tpu.data import read_api
+from ray_tpu.train import BatchPredictor, JaxPredictor, Predictor
+
+
+def _linear_apply(params, batch):
+    return batch @ params["w"] + params["b"]
+
+
+@pytest.fixture
+def linear_checkpoint():
+    w = np.array([[2.0], [3.0]], np.float32)
+    b = np.array([1.0], np.float32)
+    return Checkpoint.from_dict({"params": {"w": w, "b": b}, "step": 7})
+
+
+def test_jax_predictor_single_batch(ray_start_regular, linear_checkpoint):
+    pred = JaxPredictor.from_checkpoint(linear_checkpoint, _linear_apply)
+    x = np.array([[1.0, 1.0], [0.0, 2.0]], np.float32)
+    out = pred.predict(x)
+    np.testing.assert_allclose(out, [[6.0], [7.0]], rtol=1e-6)
+
+
+def test_batch_predictor_over_dataset(ray_start_regular, linear_checkpoint):
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    ds = read_api.from_numpy(x)
+    bp = BatchPredictor.from_checkpoint(
+        linear_checkpoint, JaxPredictor, apply_fn=_linear_apply
+    )
+    result = bp.predict(ds, batch_size=4, max_scoring_workers=2)
+    rows = result.take_all()
+    got = np.concatenate([np.atleast_1d(r["predictions"]) for r in rows]).reshape(-1)
+    want = (x @ np.array([[2.0], [3.0]], np.float32) + 1.0).reshape(-1)
+    np.testing.assert_allclose(np.sort(got), np.sort(want), rtol=1e-5)
+
+
+def test_batch_predictor_keep_columns(ray_start_regular, linear_checkpoint):
+    n = 8
+    ds = read_api.from_items(
+        [{"x": np.array([i, i], np.float32), "id": i} for i in range(n)]
+    )
+    bp = BatchPredictor.from_checkpoint(
+        linear_checkpoint, JaxPredictor, apply_fn=_linear_apply
+    )
+    result = bp.predict(
+        ds, batch_size=4, feature_columns=["x"], keep_columns=["id"]
+    )
+    rows = result.take_all()
+    assert len(rows) == n
+    for r in rows:
+        i = r["id"]
+        np.testing.assert_allclose(r["predictions"], [5.0 * i + 1.0], rtol=1e-5)
+
+
+def test_predictor_base_raises(ray_start_regular, linear_checkpoint):
+    with pytest.raises(NotImplementedError):
+        Predictor.from_checkpoint(linear_checkpoint)
+    with pytest.raises(TypeError):
+        BatchPredictor.from_checkpoint(linear_checkpoint, dict)

@@ -138,3 +138,74 @@ def test_trial_timeout_kills_hung_trial(ray_start_regular):
     assert time.time() - t0 < 240
     statuses = sorted(r.error is not None for r in grid)
     assert statuses == [False, True], "expected one ok trial and one timed-out"
+
+
+def test_tpe_searcher_converges(ray_start_regular, tmp_path):
+    """TPE should concentrate samples near the optimum of a quadratic."""
+
+    def objective(config):
+        session.report({"loss": (config["x"] - 3.0) ** 2, "training_iteration": 1})
+
+    space = {"x": tune.uniform(-10.0, 10.0)}
+    searcher = tune.TPESearcher(space, metric="loss", mode="min",
+                                n_initial_points=6, seed=0)
+    tuner = Tuner(
+        objective,
+        tune_config=TuneConfig(
+            metric="loss", mode="min", num_samples=24,
+            max_concurrent_trials=2, search_alg=searcher,
+            stop={"training_iteration": 1},
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path), name="tpe"),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 24
+    best = grid.get_best_result()
+    assert best.metrics["loss"] < 1.5, best.metrics
+    # the last half of suggestions should be much closer to 3 on average
+    xs = [t.config["x"] for t in grid._trials]
+    early = np.mean([abs(x - 3.0) for x in xs[:8]])
+    late = np.mean([abs(x - 3.0) for x in xs[-8:]])
+    assert late < early
+
+
+def test_tpe_categorical_and_integer():
+    space = {"c": tune.choice(["a", "b"]), "n": tune.randint(0, 10)}
+    s = tune.TPESearcher(space, metric="m", mode="max", n_initial_points=4, seed=1)
+    # feed it results where c="b", n>=7 is best
+    for i in range(20):
+        cfg = s.suggest(f"t{i}")
+        score = (1.0 if cfg["c"] == "b" else 0.0) + (cfg["n"] >= 7)
+        s.on_trial_complete(f"t{i}", {"m": score})
+    tail = [s.suggest(f"z{i}") for i in range(10)]
+    assert sum(1 for c in tail if c["c"] == "b") >= 7
+    assert np.mean([c["n"] for c in tail]) > 5
+
+
+def test_logger_callbacks(ray_start_regular, tmp_path):
+    import csv
+    import json
+    import os
+
+    tuner = Tuner(
+        _objective,
+        param_space={"x": tune.grid_search([1.0, 2.0])},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               stop={"training_iteration": 3}),
+        run_config=RunConfig(
+            storage_path=str(tmp_path), name="logs",
+            callbacks=[tune.CSVLoggerCallback, tune.JSONLoggerCallback],
+        ),
+    )
+    grid = tuner.fit()
+    exp = os.path.join(str(tmp_path), "logs")
+    trial_dirs = [d for d in os.listdir(exp) if d.startswith("trial_")]
+    assert len(trial_dirs) == 2
+    for d in trial_dirs:
+        with open(os.path.join(exp, d, "progress.csv")) as f:
+            rows = list(csv.DictReader(f))
+        assert len(rows) == 3
+        assert "loss" in rows[0]
+        with open(os.path.join(exp, d, "result.json")) as f:
+            lines = [json.loads(line) for line in f]
+        assert len(lines) == 3
