@@ -490,9 +490,17 @@ class Dataset:
                             feats = torch.cat(flat, dim=1)
                             yield feats, label
                         else:
+                            if feature_columns is not None:
+                                batch = {c: batch[c] for c in feature_columns}
                             yield {k: torch.as_tensor(np.asarray(v))
                                    for k, v in batch.items()}
                     else:
+                        if label_column is not None or feature_columns is not None:
+                            raise ValueError(
+                                "to_torch: label_column/feature_columns need "
+                                "named columns, but this dataset yields plain "
+                                "arrays (e.g. from_numpy)"
+                            )
                         yield torch.as_tensor(np.asarray(batch))
 
         return _TorchIterable()

@@ -3,10 +3,12 @@
 Analog of the reference's ``rllib/`` minimal spine (SURVEY §2.4):
 ``Algorithm``/``AlgorithmConfig`` as Tune Trainables, ``RolloutWorker``
 actors gathered in a ``WorkerSet``, ``SampleBatch`` columns, GAE
-postprocessing, PPO with a fully-jitted loss+update, and DQN with a
-replay buffer + target network (``rllib/algorithms/dqn``).
+postprocessing, and jax algorithm families: PPO/A2C/IMPALA (on-policy,
+V-trace for the latter), DQN (replay + target net), SAC (continuous
+control), with vectorized envs, greedy evaluation, and offline JSON IO.
 """
 
+from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithm import (
     Algorithm,
     AlgorithmConfig,
@@ -14,6 +16,9 @@ from ray_tpu.rllib.algorithm import (
     train_one_step,
 )
 from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.impala import Impala, ImpalaConfig, compute_vtrace
+from ray_tpu.rllib.offline import JsonReader, JsonWriter
+from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.postprocessing import compute_gae
 from ray_tpu.rllib.ppo import PPO, PPOConfig
@@ -27,8 +32,18 @@ __all__ = [
     "AlgorithmConfig",
     "PPO",
     "PPOConfig",
+    "A2C",
+    "A2CConfig",
+    "Impala",
+    "ImpalaConfig",
+    "compute_vtrace",
     "DQN",
     "DQNConfig",
+    "SAC",
+    "SACConfig",
+    "SACPolicy",
+    "JsonReader",
+    "JsonWriter",
     "ReplayBuffer",
     "JaxPolicy",
     "RolloutWorker",

@@ -1,0 +1,94 @@
+"""A2C: synchronous advantage actor-critic in jax.
+
+Analog of ``/root/reference/rllib/algorithms/a2c/a2c.py`` (A2C's
+training_step: synchronous sampling → one gradient step on the full batch
+with the vanilla policy-gradient loss) — PPO without the ratio clip and
+without SGD epochs.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, train_one_step
+from ray_tpu.rllib.models import apply_actor_critic
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def make_a2c_loss(vf_loss_coeff: float, entropy_coeff: float):
+    def loss(params, batch):
+        logits, values = apply_actor_critic(params, batch[SampleBatch.OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
+        logp = jnp.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]
+        adv = batch[SampleBatch.ADVANTAGES]
+        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+
+        policy_loss = -jnp.mean(logp * adv)
+        vf_loss = jnp.mean(jnp.square(values - batch[SampleBatch.VALUE_TARGETS]))
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = policy_loss + vf_loss_coeff * vf_loss - entropy_coeff * entropy
+        return total, {
+            "policy_loss": policy_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+    return loss
+
+
+def _a2c_loss_factory(config: Dict[str, Any]):
+    return make_a2c_loss(config["vf_loss_coeff"], config["entropy_coeff"])
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=A2C)
+        self._config.update(
+            _loss_factory=_a2c_loss_factory,
+            lr=1e-3,
+            train_batch_size=1000,
+            # None = one gradient step over the whole batch (true A2C);
+            # setting it takes one optimizer step per microbatch instead —
+            # an approximation, not gradient accumulation
+            microbatch_size=None,
+            vf_loss_coeff=0.5,
+            entropy_coeff=0.01,
+            lambda_=0.95,
+            grad_clip=0.5,
+        )
+
+
+class A2C(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        super().setup(config)
+        self._sgd_rng = np.random.default_rng(self.config.get("seed", 0))
+
+    def training_step(self) -> Dict[str, Any]:
+        from ray_tpu.rllib.algorithm import synchronous_parallel_sample
+
+        cfg = self.config
+        self.workers.sync_weights()
+        batch = synchronous_parallel_sample(
+            self.workers, max_env_steps=cfg["train_batch_size"]
+        )
+        self._timesteps_total += batch.count
+        learner_metrics = train_one_step(
+            self.workers.local_worker.policy,
+            batch,
+            num_sgd_iter=1,
+            sgd_minibatch_size=cfg["microbatch_size"] or batch.count,
+            rng=self._sgd_rng,
+            required_keys=(
+                SampleBatch.OBS, SampleBatch.ACTIONS,
+                SampleBatch.ADVANTAGES, SampleBatch.VALUE_TARGETS,
+            ),
+        )
+        return {"info": {"learner": learner_metrics}}
+
+
+A2C._default_config = A2CConfig().to_dict()

@@ -31,7 +31,12 @@ class AlgorithmConfig:
             "num_rollout_workers": 0,
             "num_cpus_per_worker": 1,
             "rollout_fragment_length": 200,
+            "num_envs_per_worker": 1,
             "train_batch_size": 4000,
+            "evaluation_interval": 0,  # 0 = never
+            "evaluation_num_episodes": 5,
+            "input": None,
+            "output": None,
             "gamma": 0.99,
             "lr": 5e-4,
             "fcnet_hiddens": (64, 64),
@@ -51,11 +56,14 @@ class AlgorithmConfig:
         return self
 
     def rollouts(self, *, num_rollout_workers: Optional[int] = None,
-                 rollout_fragment_length: Optional[int] = None) -> "AlgorithmConfig":
+                 rollout_fragment_length: Optional[int] = None,
+                 num_envs_per_worker: Optional[int] = None) -> "AlgorithmConfig":
         if num_rollout_workers is not None:
             self._config["num_rollout_workers"] = num_rollout_workers
         if rollout_fragment_length is not None:
             self._config["rollout_fragment_length"] = rollout_fragment_length
+        if num_envs_per_worker is not None:
+            self._config["num_envs_per_worker"] = num_envs_per_worker
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -70,6 +78,25 @@ class AlgorithmConfig:
     def framework(self, framework: str = "jax") -> "AlgorithmConfig":
         if framework != "jax":
             raise ValueError("only framework='jax' is supported")
+        return self
+
+    def evaluation(self, *, evaluation_interval: Optional[int] = None,
+                   evaluation_num_episodes: Optional[int] = None) -> "AlgorithmConfig":
+        if evaluation_interval is not None:
+            self._config["evaluation_interval"] = evaluation_interval
+        if evaluation_num_episodes is not None:
+            self._config["evaluation_num_episodes"] = evaluation_num_episodes
+        return self
+
+    def offline_data(self, *, input_: Optional[str] = None,
+                     output: Optional[str] = None) -> "AlgorithmConfig":
+        """Offline IO (``rllib/offline`` analog): ``output`` makes every
+        rollout worker write its fragments as JSON lines; ``input_`` trains
+        replay-based algorithms from recorded batches instead of an env."""
+        if input_ is not None:
+            self._config["input"] = input_
+        if output is not None:
+            self._config["output"] = output
         return self
 
     def debugging(self, *, seed: Optional[int] = None) -> "AlgorithmConfig":
@@ -108,9 +135,16 @@ class Algorithm(Trainable):
         self.config = merged
         self.workers = WorkerSet(merged)
         self._timesteps_total = 0
+        self._iteration_count = 0
+        self.reader = None
+        if merged.get("input"):
+            from ray_tpu.rllib.offline import JsonReader
+
+            self.reader = JsonReader(merged["input"])
 
     def step(self) -> Dict[str, Any]:
         results = self.training_step()
+        self._iteration_count += 1
         metrics = (
             self.workers.collect_metrics()
             + [self.workers.local_worker.get_metrics()]
@@ -127,7 +161,28 @@ class Algorithm(Trainable):
             "episodes_total": int(sum(m["episodes_total"] for m in metrics)),
             "timesteps_total": self._timesteps_total,
         })
+        interval = self.config.get("evaluation_interval") or 0
+        if interval and self._iteration_count % interval == 0:
+            results["evaluation"] = self.evaluate()
         return results
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Greedy episodes on a fresh env (``Algorithm.evaluate`` analog)."""
+        return self.workers.local_worker.evaluate_episodes(
+            int(self.config.get("evaluation_num_episodes", 5))
+        )
+
+    def _read_offline(self, min_env_steps: int) -> SampleBatch:
+        """Accumulate recorded batches from ``config.input`` to at least
+        ``min_env_steps`` transitions (offline-training sampling seam)."""
+        parts, total = [], 0
+        while total < min_env_steps:
+            b = self.reader.next()
+            if b.count == 0:
+                continue
+            parts.append(b)
+            total += b.count
+        return SampleBatch.concat_samples(parts)
 
     def training_step(self) -> Dict[str, Any]:
         """Default: sample and do nothing (``algorithm.py:1284`` is

@@ -150,11 +150,16 @@ class DQN(Algorithm):
 
     def training_step(self) -> Dict[str, Any]:
         cfg = self.config
-        self.workers.sync_weights()
-        self.workers.sync_global_vars(self._timesteps_total)
-        batch = synchronous_parallel_sample(
-            self.workers, max_env_steps=cfg["timesteps_per_iteration"]
-        )
+        if self.reader is not None:
+            # offline training: recorded transitions feed the replay buffer
+            # (rllib/offline input path); no env interaction at all
+            batch = self._read_offline(cfg["timesteps_per_iteration"])
+        else:
+            self.workers.sync_weights()
+            self.workers.sync_global_vars(self._timesteps_total)
+            batch = synchronous_parallel_sample(
+                self.workers, max_env_steps=cfg["timesteps_per_iteration"]
+            )
         self._timesteps_total += batch.count
         self.replay.add_batch(batch)
 

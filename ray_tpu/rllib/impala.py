@@ -1,0 +1,182 @@
+"""IMPALA: importance-weighted actor-learner with V-trace, in jax.
+
+Analog of ``/root/reference/rllib/algorithms/impala/impala.py`` (and its
+``vtrace_torch.py``): rollout actors run behavior policies that lag the
+learner by up to one sync, and V-trace corrects the off-policyness with
+clipped importance ratios (rho for value targets, c for the trace).  Our
+WorkerSet samples synchronously, so the lag is exactly one training_step's
+worth of SGD — small but nonzero, which is precisely what V-trace absorbs.
+
+V-trace recursion (from the IMPALA paper, computed per episode segment):
+  delta_t = rho_t (r_t + gamma V(x_{t+1}) - V(x_t))
+  vs_t    = V(x_t) + delta_t + gamma c_t (vs_{t+1} - V(x_{t+1}))
+  pg_adv  = rho_t (r_t + gamma vs_{t+1} - V(x_t))
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.models import apply_actor_critic
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def compute_vtrace(
+    behavior_logp: np.ndarray,
+    current_logp: np.ndarray,
+    values: np.ndarray,          # V(x_t) under the CURRENT policy
+    bootstrap_value: float,      # V(x_{T}) after the segment (0 if terminal)
+    rewards: np.ndarray,
+    gamma: float,
+    rho_bar: float = 1.0,
+    c_bar: float = 1.0,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One contiguous segment -> (vs targets, pg advantages, clipped rho)."""
+    T = len(rewards)
+    rho = np.minimum(rho_bar, np.exp(current_logp - behavior_logp))
+    c = np.minimum(c_bar, np.exp(current_logp - behavior_logp))
+    v_next = np.append(values[1:], bootstrap_value)
+    deltas = rho * (rewards + gamma * v_next - values)
+    vs = np.zeros(T, np.float32)
+    acc = 0.0  # vs_{t+1} - V(x_{t+1}), zero past the boundary
+    for t in range(T - 1, -1, -1):
+        acc = deltas[t] + gamma * c[t] * acc
+        vs[t] = values[t] + acc
+    vs_next = np.append(vs[1:], bootstrap_value)
+    pg_adv = rho * (rewards + gamma * vs_next - values)
+    return vs.astype(np.float32), pg_adv.astype(np.float32), rho.astype(np.float32)
+
+
+def make_impala_loss(vf_loss_coeff: float, entropy_coeff: float):
+    """Policy gradient with precomputed V-trace advantages (already
+    rho-weighted, so NOT renormalized) + vs-target value loss."""
+
+    def loss(params, batch):
+        logits, values = apply_actor_critic(params, batch[SampleBatch.OBS])
+        logp_all = jax.nn.log_softmax(logits)
+        actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
+        logp = jnp.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]
+        pg_loss = -jnp.mean(logp * batch[SampleBatch.ADVANTAGES])
+        vf_loss = jnp.mean(jnp.square(values - batch[SampleBatch.VALUE_TARGETS]))
+        entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+        total = pg_loss + vf_loss_coeff * vf_loss - entropy_coeff * entropy
+        return total, {
+            "policy_loss": pg_loss,
+            "vf_loss": vf_loss,
+            "entropy": entropy,
+        }
+
+    return loss
+
+
+def _impala_loss_factory(config: Dict[str, Any]):
+    return make_impala_loss(config["vf_loss_coeff"], config["entropy_coeff"])
+
+
+class ImpalaConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=Impala)
+        self._config.update(
+            _loss_factory=_impala_loss_factory,
+            # V-trace needs raw transitions + the behavior policy's logp;
+            # GAE columns would be recomputed wrong (stale values)
+            _store_next_obs=True,
+            _postprocess_gae=False,
+            _keep_behavior_logp=True,
+            lr=1e-3,
+            train_batch_size=1000,
+            minibatch_size=1000,
+            vf_loss_coeff=0.5,
+            entropy_coeff=0.01,
+            vtrace_rho_clip=1.0,
+            vtrace_c_clip=1.0,
+            grad_clip=40.0,
+        )
+
+
+class Impala(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        super().setup(config)
+        self._sgd_rng = np.random.default_rng(self.config.get("seed", 0))
+
+    def _vtrace_batch(self, batch: SampleBatch) -> SampleBatch:
+        """Compute vs targets + pg advantages per contiguous segment with
+        the CURRENT learner policy (one forward over the whole batch)."""
+        cfg = self.config
+        policy = self.workers.local_worker.policy
+        obs = batch[SampleBatch.OBS]
+        current_logp = policy.action_logp(obs, batch[SampleBatch.ACTIONS])
+        values = policy.value(obs)
+        terminateds = batch[SampleBatch.TERMINATEDS]
+        truncateds = batch[SampleBatch.TRUNCATEDS]
+        eps_id = batch[SampleBatch.EPS_ID]
+        next_obs = batch[SampleBatch.NEXT_OBS]
+        n = batch.count
+
+        # segment boundaries: episode end or eps_id change (fragment seam)
+        bounds: List[Tuple[int, int]] = []
+        start = 0
+        for t in range(n):
+            end_here = (
+                terminateds[t] or truncateds[t]
+                or t == n - 1 or eps_id[t + 1] != eps_id[t]
+            )
+            if end_here:
+                bounds.append((start, t + 1))
+                start = t + 1
+
+        vs = np.empty(n, np.float32)
+        pg_adv = np.empty(n, np.float32)
+        # bootstrap values for all segment ends in one forward pass
+        last_idx = np.asarray([e - 1 for _, e in bounds])
+        boot_all = policy.value(next_obs[last_idx])
+        for (s, e), boot in zip(bounds, boot_all):
+            bv = 0.0 if terminateds[e - 1] else float(boot)
+            vs[s:e], pg_adv[s:e], _ = compute_vtrace(
+                batch[SampleBatch.ACTION_LOGP][s:e],
+                current_logp[s:e],
+                values[s:e],
+                bv,
+                batch[SampleBatch.REWARDS][s:e],
+                cfg["gamma"],
+                cfg["vtrace_rho_clip"],
+                cfg["vtrace_c_clip"],
+            )
+        out = SampleBatch({
+            SampleBatch.OBS: obs,
+            SampleBatch.ACTIONS: batch[SampleBatch.ACTIONS],
+            SampleBatch.ADVANTAGES: pg_adv,
+            SampleBatch.VALUE_TARGETS: vs,
+        })
+        return out
+
+    def training_step(self) -> Dict[str, Any]:
+        from ray_tpu.rllib.algorithm import synchronous_parallel_sample, train_one_step
+
+        cfg = self.config
+        self.workers.sync_weights()
+        batch = synchronous_parallel_sample(
+            self.workers, max_env_steps=cfg["train_batch_size"]
+        )
+        self._timesteps_total += batch.count
+        train_batch = self._vtrace_batch(batch)
+        learner_metrics = train_one_step(
+            self.workers.local_worker.policy,
+            train_batch,
+            num_sgd_iter=1,
+            sgd_minibatch_size=cfg["minibatch_size"],
+            rng=self._sgd_rng,
+            required_keys=(
+                SampleBatch.OBS, SampleBatch.ACTIONS,
+                SampleBatch.ADVANTAGES, SampleBatch.VALUE_TARGETS,
+            ),
+        )
+        return {"info": {"learner": learner_metrics}}
+
+
+Impala._default_config = ImpalaConfig().to_dict()

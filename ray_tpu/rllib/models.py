@@ -50,3 +50,61 @@ def apply_actor_critic(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Arr
     logits = mlp(params["pi"], obs)
     value = mlp(params["vf"], obs)[..., 0]
     return logits, value
+
+
+# ---------------------------------------------------------------------------
+# continuous-control nets (SAC): squashed-Gaussian actor + state-action Q
+# ---------------------------------------------------------------------------
+
+
+def _dense_params(key, n_in, n_out, scale=1.0):
+    w = jax.random.normal(key, (n_in, n_out)) * scale / jnp.sqrt(n_in)
+    return {"w": w, "b": jnp.zeros((n_out,))}
+
+
+def _mlp(layers, x, final_linear=True):
+    for layer in layers[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    out = x @ last["w"] + last["b"]
+    return out if final_linear else jnp.tanh(out)
+
+
+def init_gaussian_actor(rng, obs_dim: int, act_dim: int,
+                        hiddens: Sequence[int] = (64, 64)) -> Dict:
+    """Actor emitting (mean, log_std) per action dim."""
+    keys = jax.random.split(rng, len(hiddens) + 1)
+    layers = []
+    n_in = obs_dim
+    for i, h in enumerate(hiddens):
+        layers.append(_dense_params(keys[i], n_in, h))
+        n_in = h
+    layers.append(_dense_params(keys[-1], n_in, 2 * act_dim, 0.01))
+    return {"layers": layers}
+
+
+def apply_gaussian_actor(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """obs [B, D] -> (mean [B, A], log_std [B, A]) with log_std bounded."""
+    out = _mlp(params["layers"], obs)
+    act_dim = out.shape[-1] // 2  # static: from the layer width, not a traced leaf
+    mean, log_std = out[..., :act_dim], out[..., act_dim:]
+    log_std = jnp.clip(log_std, -20.0, 2.0)
+    return mean, log_std
+
+
+def init_q_network(rng, obs_dim: int, act_dim: int,
+                   hiddens: Sequence[int] = (64, 64)) -> Dict:
+    keys = jax.random.split(rng, len(hiddens) + 1)
+    layers = []
+    n_in = obs_dim + act_dim
+    for i, h in enumerate(hiddens):
+        layers.append(_dense_params(keys[i], n_in, h))
+        n_in = h
+    layers.append(_dense_params(keys[-1], n_in, 1))
+    return {"layers": layers}
+
+
+def apply_q_network(params: Dict, obs: jax.Array, act: jax.Array) -> jax.Array:
+    """(obs [B, D], act [B, A]) -> Q [B]."""
+    x = jnp.concatenate([obs, act], axis=-1)
+    return _mlp(params["layers"], x)[..., 0]

@@ -55,8 +55,23 @@ class JaxPolicy:
             _, value = apply_actor_critic(params, obs)
             return value
 
+        @jax.jit
+        def _greedy(params, obs):
+            logits, _ = apply_actor_critic(params, obs)
+            return jnp.argmax(logits, axis=-1)
+
+        @jax.jit
+        def _action_logp(params, obs, actions):
+            logits, _ = apply_actor_critic(params, obs)
+            logp = jax.nn.log_softmax(logits)
+            return jnp.take_along_axis(
+                logp, actions.astype(jnp.int32)[:, None], axis=-1
+            )[:, 0]
+
         self._sample_jit = _sample
         self._value_jit = _value
+        self._greedy_jit = _greedy
+        self._action_logp_jit = _action_logp
         self._update_jit = None
         if loss_fn is not None:
 
@@ -80,6 +95,16 @@ class JaxPolicy:
 
     def value(self, obs: np.ndarray) -> np.ndarray:
         return np.asarray(self._value_jit(self.params, jnp.asarray(obs)))
+
+    def greedy_action(self, obs: np.ndarray) -> np.ndarray:
+        """Deterministic action (evaluation / explore=False path)."""
+        return np.asarray(self._greedy_jit(self.params, jnp.asarray(obs)))
+
+    def action_logp(self, obs: np.ndarray, actions: np.ndarray) -> np.ndarray:
+        """Current-policy log-prob of given actions (V-trace ratios)."""
+        return np.asarray(
+            self._action_logp_jit(self.params, jnp.asarray(obs), jnp.asarray(actions))
+        )
 
     # -- learning ------------------------------------------------------
     def learn_on_minibatch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:

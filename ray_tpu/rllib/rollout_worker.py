@@ -1,11 +1,12 @@
 """RolloutWorker: env stepping + trajectory postprocessing.
 
-Analog of ``/root/reference/rllib/evaluation/rollout_worker.py:153``: owns
-env instances and a policy copy, collects fixed-size sample fragments,
-postprocesses each episode segment with GAE at its boundary (terminal → no
-bootstrap; truncation/fragment end → bootstrap with v(s_T)), and exposes
-get/set_weights for learner sync.  Runs inline (local worker) or as an
-actor (``num_rollout_workers > 0``).
+Analog of ``/root/reference/rllib/evaluation/rollout_worker.py:153`` with
+the vector-env stepping of ``env_runner_v2.py:198``: owns ``num_envs``
+env instances stepped in lockstep (one batched policy forward per tick),
+collects fixed-size sample fragments, postprocesses each episode segment
+at its boundary (GAE for on-policy learners; raw transitions for
+replay-based ones), and exposes get/set_weights for learner sync.  Runs
+inline (local worker) or as an actor (``num_rollout_workers > 0``).
 """
 
 from __future__ import annotations
@@ -25,17 +26,42 @@ def _default_env_creator(env_name: str):
     return gym.make(env_name)
 
 
+class _EnvState:
+    """Per-env rollout bookkeeping (column buffers + episode stats)."""
+
+    __slots__ = ("env", "obs", "cols", "episode_reward", "episode_len", "eps_id")
+
+    def __init__(self, env, obs, keys, eps_id):
+        self.env = env
+        self.obs = obs
+        self.cols: Dict[str, List] = {k: [] for k in keys}
+        self.episode_reward = 0.0
+        self.episode_len = 0
+        self.eps_id = eps_id
+
+
 class RolloutWorker:
     def __init__(self, config: Dict[str, Any], worker_index: int = 0):
         self.config = config
         self.worker_index = worker_index
         env_creator: Optional[Callable] = config.get("env_creator")
-        if env_creator is not None:
-            self.env = env_creator(config.get("env_config", {}))
+        self._make_env = (
+            (lambda: env_creator(config.get("env_config", {})))
+            if env_creator is not None
+            else (lambda: _default_env_creator(config["env"]))
+        )
+        self.num_envs = max(1, int(config.get("num_envs_per_worker", 1)))
+        probe_env = self._make_env()
+        obs_dim = int(np.prod(probe_env.observation_space.shape))
+        space = probe_env.action_space
+        self._discrete = hasattr(space, "n")
+        if self._discrete:
+            num_actions = int(space.n)
+            self._action_low = self._action_high = None
         else:
-            self.env = _default_env_creator(config["env"])
-        obs_dim = int(np.prod(self.env.observation_space.shape))
-        num_actions = int(self.env.action_space.n)
+            num_actions = int(np.prod(space.shape))
+            self._action_low = np.asarray(space.low, np.float32)
+            self._action_high = np.asarray(space.high, np.float32)
         seed = int(config.get("seed") or 0) + worker_index
 
         from ray_tpu.rllib.policy import JaxPolicy
@@ -59,92 +85,160 @@ class RolloutWorker:
             **extra,
         )
         self._store_next_obs = bool(config.get("_store_next_obs"))
+        # on-policy learners want GAE + behavior logp/vf columns; replay
+        # learners want raw transitions; IMPALA wants transitions AND the
+        # behavior policy's logp for V-trace importance ratios
+        self._postprocess_gae = bool(
+            config.get("_postprocess_gae", not self._store_next_obs)
+        )
+        self._keep_behavior_logp = self._postprocess_gae or bool(
+            config.get("_keep_behavior_logp")
+        )
         self.gamma = config.get("gamma", 0.99)
         self.lambda_ = config.get("lambda_", 0.95)
         self.fragment_length = config.get("rollout_fragment_length", 200)
-        self._obs, _ = self.env.reset(seed=seed)
-        self._episode_reward = 0.0
-        self._episode_len = 0
-        self._episode_rewards: deque = deque(maxlen=100)
-        self._episode_lengths: deque = deque(maxlen=100)
-        self._eps_id = worker_index * 1_000_000
-        self._total_steps = 0
 
-    # ------------------------------------------------------------------
-    def sample(self) -> SampleBatch:
-        """One fragment of ``rollout_fragment_length`` steps, GAE-complete
-        (``rollout_worker.py`` sample -> SamplerInput analog)."""
         keys = [
             SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
             SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS, SampleBatch.EPS_ID,
         ]
         if self._store_next_obs:
-            # off-policy algorithms store raw transitions; logp/vf/GAE
-            # columns would be dead weight in the replay buffer
             keys.append(SampleBatch.NEXT_OBS)
-        else:
+        if self._keep_behavior_logp:
             keys += [SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS]
-        cols: Dict[str, List] = {k: [] for k in keys}
-        segments: List[SampleBatch] = []
-        seg_start = 0
+        self._keys = keys
 
-        def close_segment(last_value_fn):
-            nonlocal seg_start
-            if seg_start >= len(cols[SampleBatch.OBS]):
+        self._eps_counter = worker_index * 1_000_000
+        self._envs: List[_EnvState] = []
+        for i in range(self.num_envs):
+            env = probe_env if i == 0 else self._make_env()
+            obs, _ = env.reset(seed=seed * 10_000 + i)
+            self._envs.append(_EnvState(env, obs, keys, self._next_eps_id()))
+        self._episode_rewards: deque = deque(maxlen=100)
+        self._episode_lengths: deque = deque(maxlen=100)
+        self._episodes_total = 0
+        self._total_steps = 0
+        # offline output (rllib/offline JsonWriter analog)
+        self._writer = None
+        if config.get("output"):
+            from ray_tpu.rllib.offline import JsonWriter
+
+            self._writer = JsonWriter(config["output"], worker_index=worker_index)
+
+    def _next_eps_id(self) -> int:
+        self._eps_counter += 1
+        return self._eps_counter
+
+    def _env_action(self, action: np.ndarray):
+        """Policy output -> what env.step accepts.  Continuous policies act
+        in the canonical [-1, 1] box (tanh squash); rescale to the env's
+        bounds so full-range actions are reachable (clip only when a bound
+        is infinite and rescaling is undefined)."""
+        if self._discrete:
+            return int(action)
+        lo, hi = self._action_low, self._action_high
+        if np.all(np.isfinite(lo)) and np.all(np.isfinite(hi)):
+            return lo + (np.clip(action, -1.0, 1.0) + 1.0) * (hi - lo) / 2.0
+        return np.clip(action, lo, hi)
+
+    # ------------------------------------------------------------------
+    def sample(self) -> SampleBatch:
+        """One fragment of ``num_envs * rollout_fragment_length`` steps,
+        postprocessed per episode segment at its boundary."""
+        segments: List[SampleBatch] = []
+
+        def close_segment(es: _EnvState, last_value_fn):
+            n = len(es.cols[SampleBatch.OBS])
+            if n == 0:
                 return
-            seg = SampleBatch({
-                k: np.asarray(v[seg_start:]) for k, v in cols.items()
-            })
-            if self._store_next_obs:
-                segments.append(seg)  # TD targets are recomputed at replay time
-            else:
-                segments.append(
-                    compute_gae(seg, last_value_fn(), self.gamma, self.lambda_)
-                )
-            seg_start = len(cols[SampleBatch.OBS])
+            seg = SampleBatch({k: np.asarray(v) for k, v in es.cols.items()})
+            if self._postprocess_gae:
+                seg = compute_gae(seg, last_value_fn(), self.gamma, self.lambda_)
+            segments.append(seg)
+            for v in es.cols.values():
+                v.clear()
 
         for _ in range(self.fragment_length):
-            # flatten: the policy is an MLP over a 1-D feature vector
-            obs = np.asarray(self._obs, dtype=np.float32).reshape(-1)
-            action, logp, vf = self.policy.compute_actions(obs[None])
-            a = int(action[0])
-            next_obs, reward, terminated, truncated, _ = self.env.step(a)
-            cols[SampleBatch.OBS].append(obs)
-            cols[SampleBatch.ACTIONS].append(a)
-            cols[SampleBatch.REWARDS].append(np.float32(reward))
-            cols[SampleBatch.TERMINATEDS].append(terminated)
-            cols[SampleBatch.TRUNCATEDS].append(truncated)
-            if not self._store_next_obs:
-                cols[SampleBatch.ACTION_LOGP].append(np.float32(logp[0]))
-                cols[SampleBatch.VF_PREDS].append(np.float32(vf[0]))
-            cols[SampleBatch.EPS_ID].append(self._eps_id)
-            if self._store_next_obs:
-                cols[SampleBatch.NEXT_OBS].append(
-                    np.asarray(next_obs, np.float32).reshape(-1)
+            obs_batch = np.stack([
+                np.asarray(es.obs, np.float32).reshape(-1) for es in self._envs
+            ])
+            actions, logps, vfs = self.policy.compute_actions(obs_batch)
+            for i, es in enumerate(self._envs):
+                a = actions[i]
+                next_obs, reward, terminated, truncated, _ = es.env.step(
+                    self._env_action(a)
                 )
-            self._episode_reward += float(reward)
-            self._episode_len += 1
-            self._total_steps += 1
-            self._obs = next_obs
-            if terminated or truncated:
-                # terminal: no bootstrap; truncation: bootstrap v(s_T)
-                _next = next_obs
-                close_segment(lambda: 0.0 if terminated else float(
-                    self.policy.value(
-                        np.asarray(_next, np.float32).reshape(1, -1)
-                    )[0]
-                ))
-                self._episode_rewards.append(self._episode_reward)
-                self._episode_lengths.append(self._episode_len)
-                self._episode_reward = 0.0
-                self._episode_len = 0
-                self._eps_id += 1
-                self._obs, _ = self.env.reset()
+                es.cols[SampleBatch.OBS].append(obs_batch[i])
+                es.cols[SampleBatch.ACTIONS].append(a)
+                es.cols[SampleBatch.REWARDS].append(np.float32(reward))
+                es.cols[SampleBatch.TERMINATEDS].append(terminated)
+                es.cols[SampleBatch.TRUNCATEDS].append(truncated)
+                es.cols[SampleBatch.EPS_ID].append(es.eps_id)
+                if self._store_next_obs:
+                    es.cols[SampleBatch.NEXT_OBS].append(
+                        np.asarray(next_obs, np.float32).reshape(-1)
+                    )
+                if self._keep_behavior_logp:
+                    es.cols[SampleBatch.ACTION_LOGP].append(np.float32(logps[i]))
+                    es.cols[SampleBatch.VF_PREDS].append(np.float32(vfs[i]))
+                es.episode_reward += float(reward)
+                es.episode_len += 1
+                self._total_steps += 1
+                es.obs = next_obs
+                if terminated or truncated:
+                    # terminal: no bootstrap; truncation: bootstrap v(s_T)
+                    _next = next_obs
+                    close_segment(es, lambda: 0.0 if terminated else float(
+                        self.policy.value(
+                            np.asarray(_next, np.float32).reshape(1, -1)
+                        )[0]
+                    ))
+                    self._episode_rewards.append(es.episode_reward)
+                    self._episode_lengths.append(es.episode_len)
+                    self._episodes_total += 1
+                    es.episode_reward = 0.0
+                    es.episode_len = 0
+                    es.eps_id = self._next_eps_id()
+                    es.obs, _ = es.env.reset()
         # fragment ended mid-episode: bootstrap with v(current obs)
-        close_segment(lambda: float(
-            self.policy.value(np.asarray(self._obs, np.float32).reshape(1, -1))[0]
-        ))
-        return SampleBatch.concat_samples(segments)
+        for es in self._envs:
+            close_segment(es, lambda es=es: float(
+                self.policy.value(
+                    np.asarray(es.obs, np.float32).reshape(1, -1)
+                )[0]
+            ))
+        batch = SampleBatch.concat_samples(segments)
+        if self._writer is not None:
+            self._writer.write(batch)
+        return batch
+
+    # ------------------------------------------------------------------
+    def evaluate_episodes(self, num_episodes: int) -> Dict[str, Any]:
+        """Greedy evaluation on a dedicated cached env (``evaluation_config``'s
+        explore=False path)."""
+        env = getattr(self, "_eval_env", None)
+        if env is None:
+            env = self._eval_env = self._make_env()
+        rewards, lengths = [], []
+        for ep in range(num_episodes):
+            obs, _ = env.reset(seed=977 + ep)
+            total, steps = 0.0, 0
+            while True:
+                a = self.policy.greedy_action(
+                    np.asarray(obs, np.float32).reshape(1, -1)
+                )[0]
+                obs, r, term, trunc, _ = env.step(self._env_action(a))
+                total += float(r)
+                steps += 1
+                if term or trunc:
+                    break
+            rewards.append(total)
+            lengths.append(steps)
+        return {
+            "episode_reward_mean": float(np.mean(rewards)),
+            "episode_len_mean": float(np.mean(lengths)),
+            "episodes_this_eval": num_episodes,
+        }
 
     # ------------------------------------------------------------------
     def get_metrics(self) -> Dict[str, Any]:
@@ -154,7 +248,7 @@ class RolloutWorker:
             "episode_len_mean": (
                 float(np.mean(self._episode_lengths)) if self._episode_lengths else np.nan
             ),
-            "episodes_total": self._eps_id - self.worker_index * 1_000_000,
+            "episodes_total": self._episodes_total,
             "worker_steps": self._total_steps,
         }
 

@@ -1,0 +1,255 @@
+"""SAC: soft actor-critic for continuous control, in jax.
+
+Analog of ``/root/reference/rllib/algorithms/sac/sac.py`` (+
+``sac_torch_policy.py``): squashed-Gaussian actor, twin Q networks with
+Polyak-averaged targets, entropy-regularized objectives, and automatic
+temperature tuning against a target entropy of ``-act_dim``.  The whole
+update (actor + both critics + alpha + target Polyak) jits into one XLA
+program — the TPU-friendly phrasing of the reference's four torch
+optimizer steps.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, synchronous_parallel_sample
+from ray_tpu.rllib.models import (
+    apply_gaussian_actor,
+    apply_q_network,
+    init_gaussian_actor,
+    init_q_network,
+)
+from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+_LOG_2PI = float(np.log(2.0 * np.pi))
+
+
+def _squashed_sample(actor_params, rng, obs):
+    """Sample tanh-squashed action + its log-prob (change of variables)."""
+    mean, log_std = apply_gaussian_actor(actor_params, obs)
+    std = jnp.exp(log_std)
+    eps = jax.random.normal(rng, mean.shape)
+    pre = mean + std * eps
+    act = jnp.tanh(pre)
+    # Gaussian logp minus tanh Jacobian, summed over action dims
+    logp = -0.5 * jnp.sum(
+        ((pre - mean) / std) ** 2 + 2.0 * log_std + _LOG_2PI, axis=-1
+    )
+    logp -= jnp.sum(2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)), axis=-1)
+    return act, logp
+
+
+class SACPolicy:
+    """Continuous policy: actor + twin critics + temperature, all jax.
+
+    Constructor signature matches what RolloutWorker passes a policy
+    (obs_dim, num_actions=act_dim, lr, hiddens, seed, loss_fn unused,
+    grad_clip), so SAC plugs into the same rollout machinery as the
+    discrete algorithms.
+    """
+
+    def __init__(self, obs_dim: int, num_actions: int, *, lr=3e-4,
+                 hiddens=(64, 64), seed=0, loss_fn=None, grad_clip=None,
+                 gamma=0.99, tau=0.005, initial_alpha=1.0, **_kw):
+        del loss_fn
+        self.obs_dim, self.act_dim = obs_dim, num_actions
+        self.gamma, self.tau = gamma, tau
+        self._rng = jax.random.PRNGKey(seed)
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed + 1), 3)
+        self.params = {
+            "actor": init_gaussian_actor(k1, obs_dim, num_actions, hiddens),
+            "q1": init_q_network(k2, obs_dim, num_actions, hiddens),
+            "q2": init_q_network(k3, obs_dim, num_actions, hiddens),
+            "log_alpha": jnp.asarray(float(np.log(initial_alpha))),
+        }
+        self.target_q = jax.tree_util.tree_map(
+            jnp.asarray, {"q1": self.params["q1"], "q2": self.params["q2"]}
+        )
+        tx = [optax.clip_by_global_norm(grad_clip)] if grad_clip else []
+        self.optimizer = optax.chain(*tx, optax.adam(lr))
+        self.opt_state = self.optimizer.init(self.params)
+        self.target_entropy = -float(num_actions)
+
+        @jax.jit
+        def _act(params, rng, obs):
+            return _squashed_sample(params["actor"], rng, obs)
+
+        @jax.jit
+        def _greedy(params, obs):
+            mean, _ = apply_gaussian_actor(params["actor"], obs)
+            return jnp.tanh(mean)
+
+        @jax.jit
+        def _update(params, target_q, opt_state, rng, batch):
+            obs = batch[SampleBatch.OBS]
+            act = batch[SampleBatch.ACTIONS]
+            rew = batch[SampleBatch.REWARDS]
+            done = batch[SampleBatch.TERMINATEDS].astype(jnp.float32)
+            next_obs = batch[SampleBatch.NEXT_OBS]
+            r1, r2 = jax.random.split(rng)
+
+            # targets from the frozen critics (no gradient)
+            next_a, next_logp = _squashed_sample(params["actor"], r1, next_obs)
+            alpha = jnp.exp(params["log_alpha"])
+            tq = jnp.minimum(
+                apply_q_network(target_q["q1"], next_obs, next_a),
+                apply_q_network(target_q["q2"], next_obs, next_a),
+            ) - alpha * next_logp
+            q_target = jax.lax.stop_gradient(rew + self.gamma * (1.0 - done) * tq)
+
+            def loss_fn(p):
+                q1 = apply_q_network(p["q1"], obs, act)
+                q2 = apply_q_network(p["q2"], obs, act)
+                critic_loss = jnp.mean((q1 - q_target) ** 2) + jnp.mean(
+                    (q2 - q_target) ** 2
+                )
+                new_a, logp = _squashed_sample(p["actor"], r2, obs)
+                a_det = jnp.exp(jax.lax.stop_gradient(p["log_alpha"]))
+                q_pi = jnp.minimum(
+                    apply_q_network(jax.lax.stop_gradient(p["q1"]), obs, new_a),
+                    apply_q_network(jax.lax.stop_gradient(p["q2"]), obs, new_a),
+                )
+                actor_loss = jnp.mean(a_det * logp - q_pi)
+                alpha_loss = -jnp.mean(
+                    p["log_alpha"]
+                    * jax.lax.stop_gradient(logp + self.target_entropy)
+                )
+                total = critic_loss + actor_loss + alpha_loss
+                return total, {
+                    "critic_loss": critic_loss,
+                    "actor_loss": actor_loss,
+                    "alpha_loss": alpha_loss,
+                    "alpha": a_det,
+                    "mean_q": jnp.mean(q1),
+                    "entropy": -jnp.mean(logp),
+                }
+
+            (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            # Polyak target update, fused into the same compiled step
+            target_q = jax.tree_util.tree_map(
+                lambda t, s: (1.0 - self.tau) * t + self.tau * s,
+                target_q,
+                {"q1": params["q1"], "q2": params["q2"]},
+            )
+            return params, target_q, opt_state, loss, metrics
+
+        self._act_jit = _act
+        self._greedy_jit = _greedy
+        self._update_jit = _update
+
+    # -- acting (RolloutWorker contract) --------------------------------
+    def compute_actions(self, obs: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        self._rng, key = jax.random.split(self._rng)
+        act, logp = self._act_jit(self.params, key, jnp.asarray(obs))
+        vf = np.zeros(len(obs), np.float32)  # SAC has no V head; unused
+        return np.asarray(act), np.asarray(logp), vf
+
+    def greedy_action(self, obs: np.ndarray) -> np.ndarray:
+        return np.asarray(self._greedy_jit(self.params, jnp.asarray(obs)))
+
+    def value(self, obs: np.ndarray) -> np.ndarray:
+        return np.zeros(len(obs), np.float32)  # replay path never bootstraps here
+
+    # -- learning --------------------------------------------------------
+    def learn_on_minibatch(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        jb = {k: jnp.asarray(v) for k, v in batch.items()}
+        self._rng, key = jax.random.split(self._rng)
+        self.params, self.target_q, self.opt_state, loss, metrics = self._update_jit(
+            self.params, self.target_q, self.opt_state, key, jb
+        )
+        out = {"total_loss": float(loss)}
+        out.update({k: float(v) for k, v in metrics.items()})
+        return out
+
+    # -- weights ---------------------------------------------------------
+    def get_weights(self):
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+
+    def get_state(self) -> Dict[str, Any]:
+        return {
+            "weights": self.get_weights(),
+            "target_q": jax.tree_util.tree_map(np.asarray, self.target_q),
+            "opt_state": jax.tree_util.tree_map(np.asarray, self.opt_state),
+        }
+
+    def set_state(self, state: Dict[str, Any]) -> None:
+        self.set_weights(state["weights"])
+        if state.get("target_q") is not None:
+            self.target_q = jax.tree_util.tree_map(jnp.asarray, state["target_q"])
+        if state.get("opt_state") is not None:
+            self.opt_state = jax.tree_util.tree_map(jnp.asarray, state["opt_state"])
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self._config.update(
+            _policy_class=SACPolicy,
+            _policy_kwargs_factory=_sac_policy_kwargs,
+            _store_next_obs=True,
+            lr=3e-4,
+            gamma=0.99,
+            tau=0.005,
+            train_batch_size=256,
+            replay_buffer_capacity=100_000,
+            learning_starts=500,
+            timesteps_per_iteration=500,
+            updates_per_iteration=250,
+            grad_clip=None,
+            rollout_fragment_length=100,
+        )
+
+
+def _sac_policy_kwargs(config: Dict[str, Any]) -> Dict[str, Any]:
+    return {"gamma": config["gamma"], "tau": config["tau"]}
+
+
+class SAC(Algorithm):
+    def setup(self, config: Dict[str, Any]) -> None:
+        super().setup(config)
+        self.replay = ReplayBuffer(
+            self.config["replay_buffer_capacity"],
+            seed=self.config.get("seed") or 0,
+        )
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        if self.reader is not None:
+            batch = self._read_offline(cfg["timesteps_per_iteration"])
+        else:
+            self.workers.sync_weights()
+            batch = synchronous_parallel_sample(
+                self.workers, max_env_steps=cfg["timesteps_per_iteration"]
+            )
+        self._timesteps_total += batch.count
+        self.replay.add_batch(batch)
+
+        policy: SACPolicy = self.workers.local_worker.policy
+        learner_metrics: Dict[str, Any] = {}
+        if len(self.replay) >= cfg["learning_starts"]:
+            for _ in range(cfg["updates_per_iteration"]):
+                mb = self.replay.sample(cfg["train_batch_size"])
+                learner_metrics = policy.learn_on_minibatch({
+                    SampleBatch.OBS: mb[SampleBatch.OBS],
+                    SampleBatch.ACTIONS: mb[SampleBatch.ACTIONS],
+                    SampleBatch.REWARDS: mb[SampleBatch.REWARDS],
+                    SampleBatch.TERMINATEDS: mb[SampleBatch.TERMINATEDS],
+                    SampleBatch.NEXT_OBS: mb[SampleBatch.NEXT_OBS],
+                })
+        learner_metrics["replay_size"] = len(self.replay)
+        return {"info": {"learner": learner_metrics}}
+
+
+SAC._default_config = SACConfig().to_dict()

@@ -139,13 +139,16 @@ class BatchPredictor:
 
         from ray_tpu.data.dataset import ActorPoolStrategy
 
+        if min_scoring_workers > max_scoring_workers:
+            raise ValueError(
+                f"min_scoring_workers={min_scoring_workers} exceeds "
+                f"max_scoring_workers={max_scoring_workers}"
+            )
         ckpt_blob = cloudpickle.dumps(self._checkpoint)
         return data.map_batches(
             _ScoringWrapper,
             batch_size=batch_size,
-            compute=ActorPoolStrategy(
-                size=max(min_scoring_workers, max_scoring_workers)
-            ),
+            compute=ActorPoolStrategy(size=max_scoring_workers),
             fn_constructor_args=(
                 ckpt_blob,
                 self._predictor_cls,

@@ -225,3 +225,197 @@ def test_dqn_cartpole_learns():
     info = r["info"]["learner"]
     assert info["replay_size"] > 0 and info["epsilon"] < 1.0
     algo.cleanup()
+
+
+# ---------------------------------------------------------------------------
+# round 3: A2C / IMPALA / SAC / vector env / offline IO / evaluation
+# ---------------------------------------------------------------------------
+
+
+def test_a2c_cartpole_learns():
+    from ray_tpu.rllib import A2CConfig
+
+    algo = (
+        A2CConfig()
+        .environment("CartPole-v1")
+        .rollouts(rollout_fragment_length=200)
+        .training(train_batch_size=800, lr=2e-3, entropy_coeff=0.01)
+        .debugging(seed=3)
+        .build()
+    )
+    best = 0.0
+    for _ in range(40):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+        if best >= 120:
+            break
+    algo.cleanup()
+    assert best >= 120, f"A2C failed to improve on CartPole: best={best}"
+
+
+def test_impala_cartpole_learns():
+    from ray_tpu.rllib import ImpalaConfig
+
+    algo = (
+        ImpalaConfig()
+        .environment("CartPole-v1")
+        .rollouts(rollout_fragment_length=200)
+        .training(train_batch_size=800, lr=2e-3)
+        .debugging(seed=0)
+        .build()
+    )
+    best = 0.0
+    for _ in range(40):
+        r = algo.train()
+        best = max(best, r["episode_reward_mean"])
+        if best >= 120:
+            break
+    algo.cleanup()
+    assert best >= 120, f"IMPALA failed to improve on CartPole: best={best}"
+
+
+def test_vtrace_reduces_to_gae_targets_on_policy():
+    """With identical behavior/current logp, rho = c = 1 and vs equals the
+    discounted return recursion."""
+    from ray_tpu.rllib import compute_vtrace
+
+    rng = np.random.default_rng(0)
+    T = 6
+    logp = rng.normal(size=T).astype(np.float32)
+    values = rng.normal(size=T).astype(np.float32)
+    rewards = rng.normal(size=T).astype(np.float32)
+    gamma = 0.9
+    vs, pg_adv, rho = compute_vtrace(
+        logp, logp, values, 0.5, rewards, gamma
+    )
+    assert np.allclose(rho, 1.0)
+    # on-policy vs recursion == n-step TD(lambda=1) targets
+    expect = np.zeros(T, np.float32)
+    boot = 0.5
+    acc = boot
+    for t in range(T - 1, -1, -1):
+        acc = rewards[t] + gamma * acc
+        expect[t] = acc
+    np.testing.assert_allclose(vs, expect, rtol=1e-5)
+
+
+def test_vector_env_rollout():
+    from ray_tpu.rllib import RolloutWorker
+
+    w = RolloutWorker({
+        "env": "CartPole-v1",
+        "num_envs_per_worker": 4,
+        "rollout_fragment_length": 25,
+        "_loss_factory": None,
+        "seed": 0,
+    })
+    batch = w.sample()
+    assert batch.count == 100  # 4 envs x 25 steps
+    assert len(set(batch["eps_id"].tolist())) >= 4  # one episode per env
+
+
+def test_offline_write_read_roundtrip(tmp_path):
+    from ray_tpu.rllib import JsonReader, JsonWriter, SampleBatch
+
+    w = JsonWriter(str(tmp_path))
+    b = SampleBatch({
+        "obs": np.random.default_rng(0).standard_normal((5, 3)).astype(np.float32),
+        "actions": np.arange(5),
+        "terminateds": np.array([False, False, True, False, True]),
+    })
+    w.write(b)
+    w.write(b)
+    r = JsonReader(str(tmp_path))
+    all_b = r.read_all()
+    assert all_b.count == 10
+    np.testing.assert_allclose(all_b["obs"][:5], b["obs"], rtol=1e-6)
+    assert all_b["terminateds"].dtype == np.bool_
+    nxt = r.next()
+    assert nxt.count == 5
+
+
+def test_dqn_offline_training(tmp_path):
+    """Record CartPole transitions with one DQN, train a second purely
+    offline from the files."""
+    from ray_tpu.rllib import DQNConfig
+
+    rec = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .offline_data(output=str(tmp_path))
+        .training(timesteps_per_iteration=500, updates_per_iteration=20,
+                  learning_starts=100)
+        .build()
+    )
+    for _ in range(3):
+        rec.train()
+    rec.cleanup()
+
+    offline = (
+        DQNConfig()
+        .environment("CartPole-v1")
+        .offline_data(input_=str(tmp_path))
+        .training(timesteps_per_iteration=400, updates_per_iteration=50,
+                  learning_starts=100)
+        .build()
+    )
+    r = offline.train()
+    assert r["info"]["learner"]["replay_size"] >= 400
+    assert np.isfinite(r["info"]["learner"].get("mean_td_error", 0.0))
+    offline.cleanup()
+
+
+def test_evaluation_interval():
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .evaluation(evaluation_interval=2, evaluation_num_episodes=2)
+        .training(train_batch_size=400, sgd_minibatch_size=64, num_sgd_iter=2)
+        .build()
+    )
+    r1 = algo.train()
+    assert "evaluation" not in r1
+    r2 = algo.train()
+    assert "evaluation" in r2
+    assert r2["evaluation"]["episodes_this_eval"] == 2
+    assert np.isfinite(r2["evaluation"]["episode_reward_mean"])
+    algo.cleanup()
+
+
+def test_sac_pendulum_runs_and_improves():
+    from ray_tpu.rllib import SACConfig
+
+    algo = (
+        SACConfig()
+        .environment("Pendulum-v1")
+        .training(timesteps_per_iteration=400, updates_per_iteration=100,
+                  learning_starts=300)
+        .debugging(seed=0)
+        .build()
+    )
+    first = None
+    last = None
+    for i in range(8):
+        r = algo.train()
+        m = r["episode_reward_mean"]
+        if first is None and np.isfinite(m):
+            first = m
+        if np.isfinite(m):
+            last = m
+    lm = r["info"]["learner"]
+    assert np.isfinite(lm["critic_loss"]) and np.isfinite(lm["actor_loss"])
+    assert lm["alpha"] > 0
+    # policy acts in the canonical [-1,1] box; the worker rescales to the
+    # env's Box(-2, 2) so full torque is reachable
+    pol = algo.get_policy()
+    a = pol.greedy_action(np.zeros((4, 3), np.float32))
+    assert a.shape == (4, 1) and np.all(np.abs(a) <= 1.0 + 1e-6)
+    w = algo.workers.local_worker
+    assert np.allclose(w._env_action(np.array([1.0])), [2.0])
+    assert np.allclose(w._env_action(np.array([-1.0])), [-2.0])
+    # Pendulum mean reward should move up from the random-policy floor
+    assert last is not None and first is not None
+    assert last >= first - 100  # not collapsing; strict improvement is noisy in 8 iters
+    algo.cleanup()
