@@ -32,6 +32,13 @@ class Config:
     max_direct_call_object_size: int = 100 * 1024
     # Default object store capacity (bytes); analog of plasma's arena size.
     object_store_memory: int = 2 * 1024**3
+    # Task specs retained for object reconstruction (lineage); analog of
+    # the reference's max_lineage_bytes bound (task_manager.h:94).
+    max_lineage_entries: int = 10_000
+    # Host memory fraction above which the OOM killer fires (reference
+    # memory_usage_threshold, memory_monitor.h:52); refresh <= 0 disables.
+    memory_usage_threshold: float = 0.95
+    memory_monitor_refresh_ms: int = 2000
     # Prefix for named shared-memory segments.
     shm_prefix: str = "rtpu"
 

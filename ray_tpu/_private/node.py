@@ -260,6 +260,16 @@ class Node:
             capacity_bytes=self.cfg.object_store_memory or None,
             spill_dir=os.path.join(self.session_dir, "spill"),
         )
+        # lineage: return oid -> creating task spec, kept while the object
+        # lives so a lost copy can be recomputed (TaskManager lineage,
+        # reference task_manager.h:87; bounded like max_lineage_bytes).
+        # Lineage PINS the spec's argument objects (incl. the big-args
+        # payload) — without the pin, args are refcount-deleted at first
+        # completion and reconstruction could never re-run the task.
+        self.lineage: Dict[bytes, dict] = {}
+        self._lineage_pins: Dict[bytes, List[bytes]] = {}  # task_id -> dep oids
+        self._lineage_refcnt: Dict[bytes, int] = {}  # task_id -> live entries
+        self.registry.on_delete = self._on_object_deleted
         # Native arena store (plasma analog, src/store_core) for this
         # process's objects; per-object files remain the fallback and the
         # worker-side path.
@@ -358,6 +368,12 @@ class Node:
         t = threading.Thread(target=self._gcs_flush_loop, name="gcs-flush", daemon=True)
         t.start()
         self._threads.append(t)
+        if self.cfg.memory_monitor_refresh_ms > 0:
+            t = threading.Thread(
+                target=self._memory_monitor_loop, name="memory-monitor", daemon=True
+            )
+            t.start()
+            self._threads.append(t)
         # Dashboard + merged worker metrics (DashboardHead analog); port -1
         # disables, 0 picks an ephemeral port.
         from ray_tpu._private.job_manager import JobManager
@@ -455,8 +471,78 @@ class Node:
                 pass
             self._on_worker_death(w, reason=f"node {node_id} removed")
         self.publish("node_change", {"node_id": node_id, "alive": False})
+        self._reconstruct_lost_objects(node_id)
         with self.lock:
             self.cond.notify_all()
+
+    def _reconstruct_lost_objects(self, node_id: str) -> None:
+        """Lineage reconstruction (ObjectRecoveryManager +
+        TaskManager-resubmission analog, reference
+        ``object_recovery_manager.h:41``): finished objects whose only copy
+        lived on the dead node are recomputed by resubmitting their
+        creating task; objects with no lineage (ray.put data, actor
+        returns, evicted lineage) seal an ObjectLostError instead."""
+        from ray_tpu.exceptions import ObjectLostError
+        from ray_tpu._private.object_ref import ObjectRef
+        from ray_tpu._private.object_store import store_value
+
+        lost = self.registry.mark_node_lost(node_id)
+        if not lost:
+            return
+        resubmitted = set()
+        n_rebuilt = 0
+        for oid in lost:
+            spec = self.lineage.get(oid)
+            if spec is None or spec.get("actor_id"):
+                err = ObjectLostError(
+                    f"object {oid.hex()} lost with node {node_id} and has no "
+                    "lineage (ray.put data and actor returns are not "
+                    "reconstructable)"
+                )
+                loc, _ = store_value(ObjectRef(oid), err, is_error=True)
+                self.registry.seal(oid, loc)
+                continue
+            tid = spec["task_id"]
+            if tid in resubmitted:
+                continue
+            resubmitted.add(tid)
+            # a dep whose registry entry is gone (refcount-deleted) can
+            # never seal again — the resubmission would wait forever.
+            # Seal errors directly: the spec's pins were already released
+            # at its first completion, so _seal_error_returns (which
+            # releases them again) must not run here.
+            if any(not self.registry.contains(d) for d in spec.get("dep_ids", [])):
+                err = ObjectLostError(
+                    f"cannot reconstruct {oid.hex()}: an argument object "
+                    "was already released"
+                )
+                for rid in spec["return_ids"]:
+                    loc, _ = store_value(ObjectRef(rid), err, is_error=True)
+                    self.registry.seal(rid, loc)
+                continue
+            n_rebuilt += 1
+            # deps that died in the same event are themselves in `lost` and
+            # get resubmitted by this same loop; _deps_ready blocks until
+            # they re-seal, so the reconstruction recursion falls out of
+            # ordinary scheduling
+            copy = dict(spec)
+            # the original pins were popped at first completion; re-pin the
+            # args for the re-execution (released again when it finishes)
+            repin = [d for d in copy.get("dep_ids", []) if self.registry.contains(d)]
+            for d in repin:
+                self.registry.add_ref(d)
+            copy["pinned_refs"] = repin
+            # an affinity to the dead node would leave the resubmission
+            # unschedulable forever; reconstruction may run anywhere
+            strat = copy.get("scheduling_strategy")
+            if isinstance(strat, dict) and strat.get("node_id") == node_id:
+                copy["scheduling_strategy"] = None
+            self.submit_task(copy, _resubmit=True)
+        if n_rebuilt or len(lost):
+            logger.warning(
+                "node %s: %d objects lost; resubmitted %d creating tasks",
+                node_id, len(lost), n_rebuilt,
+            )
 
     # ------------------------------------------------------------------
     # connection handling
@@ -1004,10 +1090,42 @@ class Node:
                 self.gcs.tasks[spec["task_id"]] = TaskInfo(
                     task_id=spec["task_id"], name=spec.get("name", "task")
                 )
+                track = (
+                    not spec.get("actor_id")
+                    and len(self.lineage) < self.cfg.max_lineage_entries
+                )
+                if track:
+                    tid = spec["task_id"]
+                    deps = list(dict.fromkeys(spec.get("dep_ids", [])))
+                    for d in deps:
+                        self.registry.add_ref(d)
+                    self._lineage_pins[tid] = deps
+                    self._lineage_refcnt[tid] = len(spec["return_ids"])
                 for oid in spec["return_ids"]:
                     self.registry.create_pending(oid)
+                    # idempotent tasks are the reconstructable kind (actor
+                    # methods mutate state and are excluded, as in the
+                    # reference's lineage rules)
+                    if track:
+                        self.lineage[oid] = spec
             self.pending_tasks.append(spec)
             self.cond.notify_all()
+
+    def _on_object_deleted(self, oid: bytes) -> None:
+        """Registry delete hook: drop the object's lineage entry and, when
+        the creating task has no live lineage entries left, release the
+        argument pins lineage was holding (cascades dep cleanup)."""
+        spec = self.lineage.pop(oid, None)
+        if spec is None:
+            return
+        tid = spec["task_id"]
+        left = self._lineage_refcnt.get(tid, 1) - 1
+        if left > 0:
+            self._lineage_refcnt[tid] = left
+            return
+        self._lineage_refcnt.pop(tid, None)
+        for d in self._lineage_pins.pop(tid, []):
+            self.registry.remove_ref(d)
 
     def _seal_error_returns(self, spec: dict, err: Exception) -> None:
         from ray_tpu._private.object_store import store_value
@@ -1138,6 +1256,83 @@ class Node:
             logger.warning("node %s failed health check (%.0fs without a pong)",
                            node_id, timeout)
             self.remove_node_state(node_id)
+
+    # ------------------------------------------------------------------
+    # memory monitor + worker killing policy (MemoryMonitor
+    # memory_monitor.h:52 -> WorkerKillingPolicy worker_killing_policy.h:30)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _memory_fraction() -> float:
+        """Host memory in use as a fraction (MemAvailable-based, the same
+        signal the reference's MemoryMonitor reads from /proc)."""
+        try:
+            total = avail = None
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = float(line.split()[1])
+                    elif line.startswith("MemAvailable:"):
+                        avail = float(line.split()[1])
+                    if total is not None and avail is not None:
+                        break
+            if not total or avail is None:
+                # no MemAvailable (old kernels/containers): report no
+                # pressure rather than fabricating 100% and killing workers
+                return 0.0
+            return 1.0 - avail / total
+        except OSError:
+            return 0.0
+
+    def _pick_oom_victim(self) -> Optional[WorkerHandle]:
+        """Newest retriable task first, then newest non-retriable — killing
+        young retriable work preserves the most progress (the reference's
+        group-by-retriable LIFO policy)."""
+        with self.lock:
+            cands = []
+            for tid, rt in self.running.items():
+                w = rt.get("worker")
+                if w is None or w.state == "dead" or w.is_actor_worker:
+                    continue
+                ti = self.gcs.tasks.get(tid)
+                started = ti.start_time if ti else 0.0
+                retriable = rt["spec"].get("retries_left", 0) > 0
+                cands.append((retriable, started, w))
+            if not cands:
+                return None
+            # sort: retriable group first, newest (max start) first in group
+            cands.sort(key=lambda c: (not c[0], -c[1]))
+            return cands[0][2]
+
+    def _check_memory_pressure(self) -> bool:
+        frac = self._memory_fraction()
+        if frac < self.cfg.memory_usage_threshold:
+            return False
+        victim = self._pick_oom_victim()
+        if victim is None:
+            return False
+        logger.warning(
+            "memory pressure %.1f%% >= %.1f%%: killing worker %s (task %s) "
+            "to free memory",
+            frac * 100, self.cfg.memory_usage_threshold * 100,
+            victim.worker_id.hex(),
+            victim.current_task.get("name") if victim.current_task else "?",
+        )
+        self.publish("error", {
+            "type": "oom_kill",
+            "worker_id": victim.worker_id.hex(),
+            "memory_fraction": frac,
+        })
+        self._kill_worker(victim, reason=f"OOM killer (host memory {frac:.0%})")
+        return True
+
+    def _memory_monitor_loop(self) -> None:
+        interval = self.cfg.memory_monitor_refresh_ms / 1000.0
+        while not self._shutdown:
+            time.sleep(interval)
+            try:
+                self._check_memory_pressure()
+            except Exception:  # noqa: BLE001 — monitor must never die
+                logger.exception("memory monitor check failed")
 
     def _kill_worker(self, w: WorkerHandle, reason: str) -> None:
         self._on_worker_death(w, reason=reason)

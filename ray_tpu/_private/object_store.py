@@ -103,6 +103,9 @@ class ObjectRegistry:
         # set by the head Node when the native arena backs local objects:
         # oid -> free the arena allocation
         self.arena_delete = None
+        # set by the head Node: called (without the registry lock) for each
+        # fully-deleted object so lineage entries die with the object
+        self.on_delete = None
 
     # -- creation / sealing --------------------------------------------
     def create_pending(self, oid: bytes) -> None:
@@ -146,6 +149,38 @@ class ObjectRegistry:
             self._reap([("shm", unlink)])
         self._reap(dead)
         self._maybe_spill()
+
+    def mark_node_lost(self, node_id: str) -> List[bytes]:
+        """Un-seal every object whose only copy lived on a dead node, so
+        lineage reconstruction (or an ObjectLostError seal) can refill the
+        slot; consumers block on the cleared event meanwhile.  Returns the
+        lost oids (reference: ObjectRecoveryManager's lost-object scan,
+        ``object_recovery_manager.h:41``)."""
+        if not node_id:
+            return []  # head-local objects die with the session, not here
+        lost: List[bytes] = []
+        dead: List[tuple] = []
+        with self._lock:
+            # snapshot: dropping containment refs below can delete entries
+            for oid, e in list(self._objects.items()):
+                if oid not in self._objects:
+                    continue  # deleted by an earlier iteration's ref drop
+                if e.loc is not None and e.loc.node_id == node_id:
+                    # drop contained-ref increments this payload made; a
+                    # successful re-seal will re-add them
+                    for c in e.contained:
+                        self._remove_ref_locked(c, 1, dead)
+                    e.contained = []
+                    e.loc = None
+                    e.sealed = threading.Event()  # fresh event: old waiters
+                    # saw the sealed one; new waiters block until refill
+                    lost.append(oid)
+        self._reap(dead)
+        return lost
+
+    def contains(self, oid: bytes) -> bool:
+        with self._lock:
+            return oid in self._objects
 
     # -- lookup --------------------------------------------------------
     def is_sealed(self, oid: bytes) -> bool:
@@ -208,10 +243,15 @@ class ObjectRegistry:
         del self._objects[oid]
         for c in e.contained:
             self._remove_ref_locked(c, 1, dead)
+        if self.on_delete is not None:
+            dead.append(("hook", oid))
 
     def _reap(self, dead: List[tuple]) -> None:
         for kind, name in dead:
-            if kind == "file":
+            if kind == "hook":
+                if self.on_delete is not None:
+                    self.on_delete(name)
+            elif kind == "file":
                 try:
                     os.unlink(name)
                 except OSError:

@@ -14,60 +14,48 @@ import jax
 import jax.numpy as jnp
 
 
+def _dense_params(key, n_in, n_out, scale=1.0):
+    """Scaled-normal weights, zero bias (final layers down-scaled as in
+    PPO practice) — shared by every model family in this module."""
+    w = jax.random.normal(key, (n_in, n_out)) * scale / jnp.sqrt(n_in)
+    return {"w": w, "b": jnp.zeros((n_out,))}
+
+
+def _mlp(layers, x):
+    """tanh MLP with a linear last layer."""
+    for layer in layers[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return x @ last["w"] + last["b"]
+
+
 def init_actor_critic(
     rng: jax.Array, obs_dim: int, num_actions: int,
     hiddens: Sequence[int] = (64, 64),
 ) -> Dict:
-    """Params for policy and value MLPs (orthogonal-ish init: scaled
-    normal, zeros bias; final layers down-scaled as in PPO practice)."""
-
-    def dense(key, n_in, n_out, scale):
-        w_key, _ = jax.random.split(key)
-        w = jax.random.normal(w_key, (n_in, n_out)) * scale / jnp.sqrt(n_in)
-        return {"w": w, "b": jnp.zeros((n_out,))}
-
+    """Params for policy and value MLPs."""
     keys = jax.random.split(rng, 2 * len(hiddens) + 2)
     pi, vf = [], []
     n_in = obs_dim
     for i, h in enumerate(hiddens):
-        pi.append(dense(keys[2 * i], n_in, h, 1.0))
-        vf.append(dense(keys[2 * i + 1], n_in, h, 1.0))
+        pi.append(_dense_params(keys[2 * i], n_in, h))
+        vf.append(_dense_params(keys[2 * i + 1], n_in, h))
         n_in = h
-    pi.append(dense(keys[-2], n_in, num_actions, 0.01))
-    vf.append(dense(keys[-1], n_in, 1, 1.0))
+    pi.append(_dense_params(keys[-2], n_in, num_actions, 0.01))
+    vf.append(_dense_params(keys[-1], n_in, 1))
     return {"pi": pi, "vf": vf}
 
 
 def apply_actor_critic(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """obs [B, obs_dim] -> (logits [B, A], value [B])."""
-
-    def mlp(layers, x):
-        for layer in layers[:-1]:
-            x = jnp.tanh(x @ layer["w"] + layer["b"])
-        last = layers[-1]
-        return x @ last["w"] + last["b"]
-
-    logits = mlp(params["pi"], obs)
-    value = mlp(params["vf"], obs)[..., 0]
+    logits = _mlp(params["pi"], obs)
+    value = _mlp(params["vf"], obs)[..., 0]
     return logits, value
 
 
 # ---------------------------------------------------------------------------
 # continuous-control nets (SAC): squashed-Gaussian actor + state-action Q
 # ---------------------------------------------------------------------------
-
-
-def _dense_params(key, n_in, n_out, scale=1.0):
-    w = jax.random.normal(key, (n_in, n_out)) * scale / jnp.sqrt(n_in)
-    return {"w": w, "b": jnp.zeros((n_out,))}
-
-
-def _mlp(layers, x, final_linear=True):
-    for layer in layers[:-1]:
-        x = jnp.tanh(x @ layer["w"] + layer["b"])
-    last = layers[-1]
-    out = x @ last["w"] + last["b"]
-    return out if final_linear else jnp.tanh(out)
 
 
 def init_gaussian_actor(rng, obs_dim: int, act_dim: int,

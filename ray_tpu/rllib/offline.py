@@ -46,8 +46,18 @@ class JsonWriter:
         os.makedirs(path, exist_ok=True)
         self._worker = worker_index
         self._max_bytes = max_file_size
-        self._file_idx = 0
-        self._bytes = 0
+        # resume after existing files from a prior run of this worker so
+        # the roll threshold accounts for bytes already on disk
+        existing = sorted(glob.glob(os.path.join(
+            path, f"output-worker_{worker_index}-*.json"
+        )))
+        if existing:
+            last = existing[-1]
+            self._file_idx = int(last.rsplit("-", 1)[1].removesuffix(".json"))
+            self._bytes = os.path.getsize(last)
+        else:
+            self._file_idx = 0
+            self._bytes = 0
 
     def _path(self) -> str:
         return os.path.join(
@@ -80,12 +90,18 @@ class JsonReader:
 
     def _lines(self) -> Iterator[SampleBatch]:
         while True:  # cycle
+            yielded = 0
             for fp in self._files:
                 with open(fp) as f:
                     for line in f:
                         line = line.strip()
                         if line:
+                            yielded += 1
                             yield _decode(line)
+            if yielded == 0:
+                raise RuntimeError(
+                    f"offline input files contain no batches: {self._files}"
+                )
 
     def next(self) -> SampleBatch:
         if self._iter is None:

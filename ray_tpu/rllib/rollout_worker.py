@@ -213,9 +213,11 @@ class RolloutWorker:
         return batch
 
     # ------------------------------------------------------------------
-    def evaluate_episodes(self, num_episodes: int) -> Dict[str, Any]:
+    def evaluate_episodes(self, num_episodes: int,
+                          max_steps_per_episode: int = 10_000) -> Dict[str, Any]:
         """Greedy evaluation on a dedicated cached env (``evaluation_config``'s
-        explore=False path)."""
+        explore=False path).  The step cap guards envs with no TimeLimit —
+        training is fragment-bounded but this loop would otherwise hang."""
         env = getattr(self, "_eval_env", None)
         if env is None:
             env = self._eval_env = self._make_env()
@@ -223,7 +225,7 @@ class RolloutWorker:
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=977 + ep)
             total, steps = 0.0, 0
-            while True:
+            while steps < max_steps_per_episode:
                 a = self.policy.greedy_action(
                     np.asarray(obs, np.float32).reshape(1, -1)
                 )[0]

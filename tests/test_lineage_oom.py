@@ -1,0 +1,144 @@
+"""Lineage reconstruction + memory monitor / OOM killing policy
+(reference: object_recovery_manager.h:41, task_manager.h:87 lineage;
+memory_monitor.h:52 + worker_killing_policy.h:30)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.exceptions import ObjectLostError
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+@pytest.fixture
+def real_cluster():
+    # real node processes with private shm namespaces: removing the node
+    # genuinely destroys its object copies (fake in-process nodes share the
+    # head's namespace, so nothing would be lost)
+    cluster = Cluster(
+        initialize_head=True,
+        head_node_args={"num_cpus": 2, "num_tpus": 0},
+        real_processes=True,
+    )
+    yield cluster
+    cluster.shutdown()
+
+
+def test_lost_object_reconstructed(real_cluster):
+    """An object whose only copy lived on a dead node is recomputed from
+    its creating task's spec."""
+    cluster = real_cluster
+    nid = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(nid))
+    def produce(seed):
+        # big enough to live in shm on the producing node, deterministic
+        return np.full((200_000,), seed, np.float32)
+
+    ref = produce.remote(7)
+    first = ray_tpu.get(ref, timeout=120)
+    assert first[0] == 7 and first.shape == (200_000,)
+
+    cluster.remove_node(nid)
+    # the copy died with the node; lineage resubmits produce(7)
+    again = ray_tpu.get(ref, timeout=180)
+    np.testing.assert_array_equal(again, first)
+
+
+def test_lost_chain_reconstructed(real_cluster):
+    """Reconstruction recurses through dependencies lost in the same node
+    failure."""
+    cluster = real_cluster
+    nid = cluster.add_node(num_cpus=2)
+    strat = NodeAffinitySchedulingStrategy(nid)
+
+    @ray_tpu.remote(scheduling_strategy=strat)
+    def base():
+        return np.arange(150_000, dtype=np.int64)
+
+    @ray_tpu.remote(scheduling_strategy=strat)
+    def double(x):
+        return x * 2
+
+    b = base.remote()
+    d = double.remote(b)
+    assert ray_tpu.get(d, timeout=120)[-1] == 2 * 149_999
+
+    cluster.remove_node(nid)
+    out = ray_tpu.get(d, timeout=180)
+    assert out[-1] == 2 * 149_999 and out[0] == 0
+
+
+def test_lost_put_object_raises(real_cluster):
+    """ray.put data has no lineage: losing its node surfaces
+    ObjectLostError (reference semantics)."""
+    cluster = real_cluster
+    nid = cluster.add_node(num_cpus=1)
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(nid))
+    def produce_via_put():
+        return ray_tpu.put(np.ones(150_000, np.float32))
+
+    inner = ray_tpu.get(produce_via_put.remote(), timeout=120)
+    assert ray_tpu.get(inner, timeout=120).shape == (150_000,)
+    cluster.remove_node(nid)
+    with pytest.raises(ObjectLostError):
+        ray_tpu.get(inner, timeout=120)
+
+
+def _head():
+    import gc
+
+    from ray_tpu._private import node as node_mod
+
+    # pick the LIVE head — stale Nodes from earlier tests may linger in gc
+    heads = [
+        o for o in gc.get_objects()
+        if isinstance(o, node_mod.Node) and not o._shutdown
+    ]
+    assert heads, "no live head node"
+    return heads[-1]
+
+
+def test_oom_killer_picks_newest_retriable(ray_start_regular):
+    """Under (synthetic) memory pressure the policy kills the newest
+    retriable task's worker; the task retries and completes."""
+    head = _head()
+
+    @ray_tpu.remote(max_retries=2)
+    def retriable(path):
+        import os
+        import time as _t
+
+        if os.path.exists(path):
+            return "done"
+        open(path, "w").close()
+        _t.sleep(300)  # parked until the OOM killer takes this worker
+
+    marker = f"/tmp/rtpu_oom_{time.time()}"
+    ref = retriable.remote(marker)
+    # wait until it's running
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not head.running:
+        time.sleep(0.1)
+    assert head.running
+
+    # force the pressure check with a fake reading over the threshold
+    orig = head._memory_fraction
+    try:
+        head._memory_fraction = lambda: 0.99
+        assert head._check_memory_pressure() is True
+    finally:
+        head._memory_fraction = orig
+    assert ray_tpu.get(ref, timeout=120) == "done"
+
+
+def test_memory_monitor_noop_below_threshold(ray_start_regular):
+    head = _head()
+    frac = head._memory_fraction()
+    assert 0.0 <= frac < 1.0
+    if frac < head.cfg.memory_usage_threshold:
+        assert head._check_memory_pressure() is False
