@@ -517,8 +517,11 @@ class Node:
                     "was already released"
                 )
                 for rid in spec["return_ids"]:
-                    loc, _ = store_value(ObjectRef(rid), err, is_error=True)
-                    self.registry.seal(rid, loc)
+                    # only live entries: sealing a refcount-deleted return
+                    # would resurrect it with a ref nobody holds (leak)
+                    if self.registry.contains(rid):
+                        loc, _ = store_value(ObjectRef(rid), err, is_error=True)
+                        self.registry.seal(rid, loc)
                 continue
             n_rebuilt += 1
             # deps that died in the same event are themselves in `lost` and
@@ -1115,16 +1118,18 @@ class Node:
         """Registry delete hook: drop the object's lineage entry and, when
         the creating task has no live lineage entries left, release the
         argument pins lineage was holding (cascades dep cleanup)."""
-        spec = self.lineage.pop(oid, None)
-        if spec is None:
-            return
-        tid = spec["task_id"]
-        left = self._lineage_refcnt.get(tid, 1) - 1
-        if left > 0:
-            self._lineage_refcnt[tid] = left
-            return
-        self._lineage_refcnt.pop(tid, None)
-        for d in self._lineage_pins.pop(tid, []):
+        with self.lock:  # hook runs on whichever thread dropped the last ref
+            spec = self.lineage.pop(oid, None)
+            if spec is None:
+                return
+            tid = spec["task_id"]
+            left = self._lineage_refcnt.get(tid, 1) - 1
+            if left > 0:
+                self._lineage_refcnt[tid] = left
+                return
+            self._lineage_refcnt.pop(tid, None)
+            pins = self._lineage_pins.pop(tid, [])
+        for d in pins:  # registry calls outside the node lock
             self.registry.remove_ref(d)
 
     def _seal_error_returns(self, spec: dict, err: Exception) -> None:

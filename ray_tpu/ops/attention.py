@@ -12,15 +12,15 @@ docstring):
   blocks; O(block) memory, any length (pads+masks), differentiable; also
   the inner block the ring-attention layer reuses.
 
+- :func:`flash_attention_tpu` — pallas MXU-tiled kernels for BOTH forward
+  and backward (dq/dk/dv rebuilt from the saved logsumexp, recompute-free).
+  Slower than the XLA paths at GPT-2 shapes (d_head=64, T≤4k) but fastest
+  from ~8k tokens — the dispatch selects it for long context on TPU.
+
 Not in the dispatch:
 
 - :func:`mha_reference` — naive O(T²) f32 attention; numerical ground
   truth for tests.
-- :func:`flash_attention_tpu` — our pallas MXU-tiled kernel with a
-  blockwise-recompute backward.  Benchmarked SLOWER than the XLA paths
-  above at GPT-2 shapes (d_head=64) on v5e — kept as an explicit opt-in
-  and as the starting point for long-context kernel work, not selected
-  automatically.
 """
 
 from __future__ import annotations
@@ -134,8 +134,9 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                  *, scale: float, causal: bool, block_q: int, block_k: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, causal: bool, block_q: int, block_k: int,
+                  q_offset: int):
     """Grid = (batch*heads, n_q_blocks, n_k_blocks); the k axis is the
     innermost (sequential) dimension, so the f32 scratch (acc, m, l)
     carries the online softmax across k steps of one q block."""
@@ -156,7 +157,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
     if causal:
         qi = pl.program_id(1)
-        q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
         k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
 
@@ -174,12 +176,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     @pl.when(ki == nk - 1)
     def _():
         o_ref[0] = (acc_ref[:] / l_ref[:, 0][:, None]).astype(o_ref.dtype)
+        # logsumexp residual: the backward kernels rebuild P = exp(S - LSE)
+        # from it without re-running the online softmax.  Kept as a
+        # [bq, 1] column (TPU blocks want the sublane dim divisible by 8).
+        lse_ref[0, :, 0] = m_ref[:, 0] + jnp.log(l_ref[:, 0])
 
 
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, *, causal: bool, scale: float,
     block_q: int, block_k: int, interpret: bool,
-) -> jax.Array:
+):
+    """Returns (out [B,H,Tq,D], lse [B,H,Tq] f32)."""
     b, h, t_q, d = q.shape
     t_k = k.shape[-2]
     bq, bk = min(block_q, t_q), min(block_k, t_k)
@@ -190,9 +197,10 @@ def _flash_forward(
     vr = v.reshape(b * h, t_k, d)
     grid = (b * h, t_q // bq, t_k // bk)
     kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk
+        _flash_kernel, scale=scale, causal=causal, block_q=bq, block_k=bk,
+        q_offset=t_k - t_q,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -200,8 +208,14 @@ def _flash_forward(
             pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, qi, ki: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, qi, ki: (bh, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, t_q, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),   # running max
             pltpu.VMEM((bq, 1), jnp.float32),   # running denom
@@ -209,7 +223,162 @@ def _flash_forward(
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, t_q, d)
+    return out.reshape(b, h, t_q, d), lse.reshape(b, h, t_q)
+
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                     dq_acc, *, scale: float, causal: bool,
+                     block_q: int, block_k: int, q_offset: int):
+    """dQ: grid (bh, n_q, n_k), k innermost; one q block accumulates
+    dQ = sum_k dS @ K with dS = P * (dO Vᵀ - Δ) * scale, P = exp(S - LSE)
+    rebuilt from the forward's logsumexp (recompute-free backward,
+    FlashAttention-2 eq. 13-16)."""
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        qi = pl.program_id(1)
+        q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0])                   # [bq,1] bcast -> [bq, bk]
+    do = do_ref[0]
+    dp = jax.lax.dot_general(                     # dO @ Vᵀ  [bq, bk]
+        do, v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0]) * scale
+    dq_acc[:] += jax.lax.dot_general(             # dS @ K  [bq, d]
+        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                      causal: bool, block_q: int, block_k: int,
+                      q_offset: int):
+    """dK/dV: grid (bh, n_k, n_q), q innermost; one k block accumulates
+    dV = sum_q Pᵀ @ dO and dK = sum_q dSᵀ @ Q."""
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        kbi = pl.program_id(1)
+        q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kbi * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0])                   # [bq,1] bcast -> [bq, bk]
+    do = do_ref[0]
+    dv_acc[:] += jax.lax.dot_general(             # Pᵀ @ dO  [bk, d]
+        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    dp = jax.lax.dot_general(
+        do, v_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_ref[0]) * scale
+    dk_acc[:] += jax.lax.dot_general(             # dSᵀ @ Q  [bk, d]
+        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, *, causal, scale,
+                    block_q, block_k, interpret):
+    b, h, t_q, d = q.shape
+    t_k = k.shape[-2]
+    bq, bk = min(block_q, t_q), min(block_k, t_k)
+    qr = q.reshape(b * h, t_q, d)
+    kr = k.reshape(b * h, t_k, d)
+    vr = v.reshape(b * h, t_k, d)
+    dor = g.reshape(b * h, t_q, d)
+    lser = lse.reshape(b * h, t_q, 1)
+    # Δ = rowsum(dO ⊙ O): one fused elementwise reduce, cheap in XLA
+    delta = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    ).reshape(b * h, t_q, 1)
+
+    q_spec = pl.BlockSpec((1, bq, d), lambda bh, a, b2: (bh, a, 0))
+    row_spec = pl.BlockSpec((1, bq, 1), lambda bh, a, b2: (bh, a, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, q_offset=t_k - t_q),
+        grid=(b * h, t_q // bq, t_k // bk),
+        in_specs=[
+            q_spec,                                                # q by qi
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, qi, ki: (bh, ki, 0)),
+            q_spec,                                                # dO by qi
+            row_spec,                                              # lse
+            row_spec,                                              # delta
+        ],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, t_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    k_spec = pl.BlockSpec((1, bk, d), lambda bh, ki, qi: (bh, ki, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, block_k=bk, q_offset=t_k - t_q),
+        grid=(b * h, t_k // bk, t_q // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),  # q
+            k_spec,                                                    # k
+            k_spec,                                                    # v
+            pl.BlockSpec((1, bq, d), lambda bh, ki, qi: (bh, qi, 0)),  # dO
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),  # lse
+            pl.BlockSpec((1, bq, 1), lambda bh, ki, qi: (bh, qi, 0)),  # delta
+        ],
+        out_specs=[k_spec, k_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, t_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, t_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+    return (
+        dq.reshape(b, h, t_q, d),
+        dk.reshape(b, h, t_k, d),
+        dv.reshape(b, h, t_k, d),
+    )
 
 
 @functools.partial(
@@ -220,30 +389,34 @@ def flash_attention_tpu(
     causal: bool = False, scale: Optional[float] = None,
     block_q: int = 128, block_k: int = 128, interpret: bool = False,
 ) -> jax.Array:
-    """Pallas flash attention.  Forward runs the MXU-tiled kernel; backward
-    recomputes with :func:`blockwise_attention` (flash-style memory) and
-    differentiates that."""
+    """Pallas flash attention: MXU-tiled forward AND backward.  The
+    backward is recompute-free — P is rebuilt from the forward's saved
+    logsumexp, never materializing the full score matrix (the standard
+    dq/dk/dv flash backward)."""
     scale = scale if scale is not None else q.shape[-1] ** -0.5
-    return _flash_forward(
+    out, _ = _flash_forward(
         q, k, v, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention_tpu(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: blockwise_attention(
-            q, k, v, causal=causal, scale=scale, block_k=block_k
-        ),
-        q, k, v,
+    q, k, v, out, lse = res
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash_backward(
+        q, k, v, out, lse, g, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return vjp(g)
 
 
 flash_attention_tpu.defvjp(_flash_fwd, _flash_bwd)
@@ -266,6 +439,18 @@ def attention(
         if causal and t_q == t_k and t_q % 256 == 0 and t_q >= 512:
             return causal_skip_attention(q, k, v, scale=scale, block=256)
         return full_attention(q, k, v, causal=causal, scale=scale)
+    if (
+        _HAS_PALLAS
+        and q.ndim == 4
+        and t_k >= 8192  # measured crossover vs the XLA paths on v5e
+        and t_q % block_q == 0
+        and t_k % block_k == 0
+        and jax.default_backend() == "tpu"
+    ):
+        # long context: the pallas kernel pair (fwd + recompute-free bwd)
+        return flash_attention_tpu(
+            q, k, v, causal, scale, block_q, block_k, False
+        )
     return blockwise_attention(
         q, k, v, causal=causal, scale=scale, block_k=block_k
     )

@@ -48,9 +48,10 @@ class JsonWriter:
         self._max_bytes = max_file_size
         # resume after existing files from a prior run of this worker so
         # the roll threshold accounts for bytes already on disk
-        existing = sorted(glob.glob(os.path.join(
-            path, f"output-worker_{worker_index}-*.json"
-        )))
+        existing = sorted(
+            glob.glob(os.path.join(path, f"output-worker_{worker_index}-*.json")),
+            key=lambda p: int(p.rsplit("-", 1)[1].removesuffix(".json")),
+        )
         if existing:
             last = existing[-1]
             self._file_idx = int(last.rsplit("-", 1)[1].removesuffix(".json"))
