@@ -500,7 +500,7 @@ class Node:
                     "reconstructable)"
                 )
                 loc, _ = store_value(ObjectRef(oid), err, is_error=True)
-                self.registry.seal(oid, loc)
+                self.registry.seal(oid, loc, only_if_live=True)
                 continue
             tid = spec["task_id"]
             if tid in resubmitted:
@@ -517,11 +517,10 @@ class Node:
                     "was already released"
                 )
                 for rid in spec["return_ids"]:
-                    # only live entries: sealing a refcount-deleted return
-                    # would resurrect it with a ref nobody holds (leak)
-                    if self.registry.contains(rid):
-                        loc, _ = store_value(ObjectRef(rid), err, is_error=True)
-                        self.registry.seal(rid, loc)
+                    # only live entries, checked atomically inside seal:
+                    # resurrecting a refcount-deleted return would leak
+                    loc, _ = store_value(ObjectRef(rid), err, is_error=True)
+                    self.registry.seal(rid, loc, only_if_live=True)
                 continue
             n_rebuilt += 1
             # deps that died in the same event are themselves in `lost` and

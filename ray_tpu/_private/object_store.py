@@ -114,12 +114,31 @@ class ObjectRegistry:
             self._objects.setdefault(oid, _Entry())
 
     def seal(self, oid: bytes, loc: ObjectLocation,
-             contained: Optional[List[bytes]] = None) -> None:
+             contained: Optional[List[bytes]] = None,
+             only_if_live: bool = False) -> bool:
+        """Seal ``oid`` with ``loc``.  With ``only_if_live``, a concurrent
+        deletion wins atomically: the prepared payload is discarded instead
+        of resurrecting the entry (returns False).  Plain seal returns True."""
         unlink = None
         dead: List[bytes] = []
+        missed = False
         with self._lock:
-            e = self._objects.setdefault(oid, _Entry())
-            if e.loc is not None:
+            if only_if_live:
+                e = self._objects.get(oid)
+            else:
+                e = self._objects.setdefault(oid, _Entry())
+            if e is None:
+                # entry died between the caller's decision and this seal:
+                # reap the orphaned payload (outside the lock — reap
+                # callbacks may take the node lock), don't resurrect
+                missed = True
+                if loc.arena_path:
+                    dead.append(("arena", (loc.arena_key, loc.shm_name)))
+                elif loc.shm_name:
+                    dead.append(("shm", loc.shm_name))
+                elif loc.spilled_path:
+                    dead.append(("file", loc.spilled_path))
+            elif e.loc is not None:
                 # First seal wins (objects are immutable).  A re-seal happens
                 # when a task retried after its worker sealed a return and
                 # then crashed — drop the duplicate payload.  Checked and
@@ -140,15 +159,17 @@ class ObjectRegistry:
                         ce.ref_count += 1
                 if loc.shm_name and not loc.node_id:
                     self._bytes_used += loc.size
-            e.sealed.set()
-            if e.ref_count <= 0:
-                # every handle died before the producer finished (fire-and-
-                # forget): reclaim immediately
-                self._delete_locked(oid, e, dead)
+            if not missed:
+                e.sealed.set()
+                if e.ref_count <= 0:
+                    # every handle died before the producer finished (fire-
+                    # and-forget): reclaim immediately
+                    self._delete_locked(oid, e, dead)
         if unlink:
             self._reap([("shm", unlink)])
         self._reap(dead)
         self._maybe_spill()
+        return not missed
 
     def mark_node_lost(self, node_id: str) -> List[bytes]:
         """Un-seal every object whose only copy lived on a dead node, so

@@ -134,12 +134,37 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 
+def _masked_scores(q_ref, k_ref, qi, ki, *, scale, causal, block_q, block_k,
+                   q_offset):
+    """scale·QKᵀ for one (q block, k block) cell, causal-masked with the
+    bottom-right-aligned diagonal.  Shared by the forward and both backward
+    kernels so masking semantics can never desynchronize."""
+    s = jax.lax.dot_general(
+        q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    if causal:
+        q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    return s
+
+
+def _block_visible(qi, ki, *, block_q, block_k, q_offset):
+    """True iff the (qi, ki) cell has any unmasked element — cells fully
+    above the causal diagonal are skipped (≈2x MXU work saved at long T)."""
+    return ki * block_k <= q_offset + (qi + 1) * block_q - 1
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, scale: float, causal: bool, block_q: int, block_k: int,
                   q_offset: int):
     """Grid = (batch*heads, n_q_blocks, n_k_blocks); the k axis is the
     innermost (sequential) dimension, so the f32 scratch (acc, m, l)
     carries the online softmax across k steps of one q block."""
+    qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -149,29 +174,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0]  # [block_q, d]
-    k = k_ref[0]  # [block_k, d]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-
-    if causal:
-        qi = pl.program_id(1)
-        q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-
-    m_prev = m_ref[:, 0]
-    m_new = jnp.maximum(m_prev, s.max(axis=-1))
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new[:, None])
-    l_ref[:, 0] = l_ref[:, 0] * alpha + p.sum(axis=-1)
-    m_ref[:, 0] = m_new
-    acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
-        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+    visible = (
+        _block_visible(qi, ki, block_q=block_q, block_k=block_k, q_offset=q_offset)
+        if causal else ki >= 0
     )
+
+    @pl.when(visible)
+    def _():
+        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k, q_offset=q_offset)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_ref[:, 0] = l_ref[:, 0] * alpha + p.sum(axis=-1)
+        m_ref[:, 0] = m_new
+        acc_ref[:] = acc_ref[:] * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     @pl.when(ki == nk - 1)
     def _():
@@ -233,6 +254,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dQ = sum_k dS @ K with dS = P * (dO Vᵀ - Δ) * scale, P = exp(S - LSE)
     rebuilt from the forward's logsumexp (recompute-free backward,
     FlashAttention-2 eq. 13-16)."""
+    qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -240,28 +262,27 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        qi = pl.program_id(1)
-        q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    p = jnp.exp(s - lse_ref[0])                   # [bq,1] bcast -> [bq, bk]
-    do = do_ref[0]
-    dp = jax.lax.dot_general(                     # dO @ Vᵀ  [bq, bk]
-        do, v_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
+    visible = (
+        _block_visible(qi, ki, block_q=block_q, block_k=block_k, q_offset=q_offset)
+        if causal else ki >= 0
     )
-    ds = p * (dp - delta_ref[0]) * scale
-    dq_acc[:] += jax.lax.dot_general(             # dS @ K  [bq, d]
-        ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+
+    @pl.when(visible)
+    def _():
+        s = _masked_scores(q_ref, k_ref, qi, ki, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k, q_offset=q_offset)
+        p = jnp.exp(s - lse_ref[0])               # [bq,1] bcast -> [bq, bk]
+        do = do_ref[0]
+        dp = jax.lax.dot_general(                 # dO @ Vᵀ  [bq, bk]
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        k = k_ref[0]
+        dq_acc[:] += jax.lax.dot_general(         # dS @ K  [bq, d]
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     @pl.when(ki == nk - 1)
     def _():
@@ -274,6 +295,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       q_offset: int):
     """dK/dV: grid (bh, n_k, n_q), q innermost; one k block accumulates
     dV = sum_q Pᵀ @ dO and dK = sum_q dSᵀ @ Q."""
+    kbi = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -282,32 +304,31 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    q = q_ref[0]
-    k = k_ref[0]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        kbi = pl.program_id(1)
-        q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = kbi * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    p = jnp.exp(s - lse_ref[0])                   # [bq,1] bcast -> [bq, bk]
-    do = do_ref[0]
-    dv_acc[:] += jax.lax.dot_general(             # Pᵀ @ dO  [bk, d]
-        p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
+    visible = (
+        _block_visible(qi, kbi, block_q=block_q, block_k=block_k, q_offset=q_offset)
+        if causal else qi >= 0
     )
-    dp = jax.lax.dot_general(
-        do, v_ref[0], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    ds = p * (dp - delta_ref[0]) * scale
-    dk_acc[:] += jax.lax.dot_general(             # dSᵀ @ Q  [bk, d]
-        ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
+
+    @pl.when(visible)
+    def _():
+        s = _masked_scores(q_ref, k_ref, qi, kbi, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k, q_offset=q_offset)
+        p = jnp.exp(s - lse_ref[0])               # [bq,1] bcast -> [bq, bk]
+        do = do_ref[0]
+        dv_acc[:] += jax.lax.dot_general(         # Pᵀ @ dO  [bk, d]
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0]) * scale
+        q = q_ref[0]
+        dk_acc[:] += jax.lax.dot_general(         # dSᵀ @ Q  [bk, d]
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
     @pl.when(qi == nq - 1)
     def _():
@@ -431,8 +452,10 @@ def attention(
 
     - causal, square, block-divisible, moderate T → :func:`causal_skip_attention`
     - moderate T → :func:`full_attention` (masked, MXU dtypes)
-    - long T → :func:`blockwise_attention` (O(block) memory, pads+masks
-      any length; ring attention covers sharded-T)
+    - T ≥ 8k on TPU, block-divisible → :func:`flash_attention_tpu`
+      (pallas fwd + recompute-free bwd kernels; measured crossover on v5e)
+    - other long T → :func:`blockwise_attention` (O(block) memory,
+      pads+masks any length; ring attention covers sharded-T)
     """
     t_q, t_k = q.shape[-2], k.shape[-2]
     if t_q <= _MAX_MATERIALIZED_T and t_k <= _MAX_MATERIALIZED_T:
