@@ -69,6 +69,13 @@ def init(
             import json
             import os
 
+            thin = address.startswith("client://")
+            if thin:
+                # Ray Client analog (reference ``ray.init("ray://...")``,
+                # util/client/ARCHITECTURE.md): a remote process that shares
+                # no shm with the cluster; object payloads ride the control
+                # socket both ways, everything else is already socket-based
+                address = "tcp://" + address[len("client://"):]
             if address == "auto":
                 with open("/tmp/ray_tpu/last_session.json") as f:
                     sess = json.load(f)
@@ -89,7 +96,7 @@ def init(
             client = CoreClient(address, authkey)
             from ray_tpu._private import shm as _shm
 
-            if _shm._SESSION_ENV not in os.environ:
+            if not thin and _shm._SESSION_ENV not in os.environ:
                 # adopt the head's shm namespace so this driver's puts are
                 # swept with the session they belong to
                 try:
@@ -103,6 +110,7 @@ def init(
             client = CoreClient(node.address, node.authkey)
         client.register_client()
         global_worker.mode = "driver"
+        global_worker.thin_client = address is not None and thin
         global_worker.node = node
         global_worker.client = client
         global_worker.node_id = node._head_node_id if node else "node-head"
@@ -126,6 +134,7 @@ def shutdown() -> None:
         global_worker.client = None
         global_worker.node = None
         global_worker.mode = None
+        global_worker.thin_client = False
         global_worker.function_cache.clear()
         global_worker.registered_fn_ids.clear()
 

@@ -662,6 +662,33 @@ class Node:
         except (OSError, ValueError):
             pass
 
+    def _on_get_blob(self, conn: Connection, msg: dict) -> None:
+        """Ship an object's serialized payload to a thin client."""
+        from ray_tpu._private.object_store import payload_bytes
+
+        loc = self.registry.wait_sealed_existing(msg["oid"], msg.get("timeout"))
+        if loc == "missing":
+            reply = {"error": f"unknown or released object {msg['oid'].hex()}"}
+        elif loc is None:
+            reply = {"timeout": True}
+        else:
+            try:
+                reply = {"blob": payload_bytes(loc), "is_error": loc.is_error}
+            except FileNotFoundError:
+                # segment spilled/moved between the location read and the
+                # attach — one refetch gets the fresh location (same race
+                # the fat-client get handles)
+                loc = self.registry.wait_sealed_existing(msg["oid"], 5.0)
+                try:
+                    if loc in (None, "missing"):
+                        raise FileNotFoundError(msg["oid"].hex())
+                    reply = {"blob": payload_bytes(loc), "is_error": loc.is_error}
+                except (OSError, ValueError) as e:
+                    reply = {"error": f"payload read failed: {e}"}
+            except (OSError, ValueError) as e:
+                reply = {"error": f"payload read failed: {e}"}
+        self._reply(conn, {"type": "reply", "req_id": msg["req_id"], "value": reply})
+
     def _handle_message(self, conn: Connection, worker: Optional[WorkerHandle], msg: dict) -> None:
         mtype = msg["type"]
         if mtype == "submit_task":
@@ -724,6 +751,23 @@ class Node:
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
                                "value": {"session_id": self.session_id,
                                          "head_node_id": self._head_node_id}})
+        elif mtype == "put_blob":
+            # thin client (Ray Client analog): the payload rode the socket;
+            # store it head-side and seal
+            from ray_tpu._private.object_store import store_blob
+            from ray_tpu._private.object_ref import ObjectRef as _Ref
+
+            loc = store_blob(_Ref(msg["oid"]), msg["blob"],
+                             is_error=msg.get("is_error", False))
+            self.seal_object(msg["oid"], loc, msg.get("contained", []))
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": True})
+        elif mtype == "get_blob":
+            # served off-thread: wait_sealed may block for minutes and this
+            # reader loop must keep handling the connection's other traffic
+            threading.Thread(
+                target=self._on_get_blob, args=(conn, msg), daemon=True
+            ).start()
         elif mtype == "submit_job":
             jid = self.job_manager.submit(
                 msg["entrypoint"], msg.get("runtime_env"), msg.get("job_id"),

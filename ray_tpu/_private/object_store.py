@@ -29,7 +29,7 @@ import os
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple, Union
 
 from ray_tpu._private import serialization
 from ray_tpu._private.config import get_config
@@ -208,6 +208,21 @@ class ObjectRegistry:
         with self._lock:
             e = self._objects.get(oid)
         return e is not None and e.sealed.is_set()
+
+    def wait_sealed_existing(
+        self, oid: bytes, timeout: Optional[float]
+    ) -> Union[ObjectLocation, None, str]:
+        """Like :meth:`wait_sealed` but never creates an entry: returns the
+        sentinel ``"missing"`` for unknown/deleted oids instead of parking a
+        phantom _Entry nobody owns (thin-client get path)."""
+        with self._lock:
+            e = self._objects.get(oid)
+        if e is None:
+            return "missing"
+        if not e.sealed.wait(timeout):
+            return None
+        e.last_access = time.monotonic()
+        return e.loc
 
     def wait_sealed(self, oid: bytes, timeout: Optional[float]) -> Optional[ObjectLocation]:
         with self._lock:
@@ -518,6 +533,74 @@ def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[Obj
         raise
     os.close(fd)
     return ObjectLocation(shm_name=name, size=total, is_error=is_error), refs
+
+
+def store_blob(ref: ObjectRef, blob: bytes, is_error: bool = False) -> ObjectLocation:
+    """Store an already-serialized payload (thin-client put: the client
+    shipped the bytes over the control socket because it shares no shm with
+    this host).  Small blobs stay inline; big ones land in local shm."""
+    cfg = get_config()
+    if len(blob) <= cfg.max_direct_call_object_size:
+        return ObjectLocation(inline=bytes(blob), is_error=is_error)
+    name = session_shm_name(ref.hex())
+    path = ShmSegment.path_for(name)
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+    except FileExistsError:
+        name = f"{name}-r{os.urandom(3).hex()}"
+        path = ShmSegment.path_for(name)
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+    try:
+        view = memoryview(blob)
+        while view:  # os.write caps single writes (~2 GiB on Linux)
+            n = os.write(fd, view)
+            view = view[n:]
+    except BaseException:
+        os.close(fd)
+        os.unlink(path)
+        raise
+    os.close(fd)
+    return ObjectLocation(shm_name=name, size=len(blob), is_error=is_error)
+
+
+def payload_bytes(loc: ObjectLocation) -> bytes:
+    """The serialized payload at ``loc`` as bytes (thin-client get: the
+    caller can't attach this host's shm, so the head reads the bytes out
+    and ships them over the socket).  Remote-node segments are pulled into
+    the local namespace first, exactly like :func:`read_value`."""
+    if loc.inline is not None:
+        return loc.inline
+    if loc.spilled_path is not None:
+        with open(loc.spilled_path, "rb") as f:
+            return f.read()
+    arena_src = None
+    if loc.arena_path is not None:
+        try:
+            view = _arena_view(loc.arena_path)
+            return bytes(view[loc.arena_off:loc.arena_off + loc.size])
+        except FileNotFoundError:
+            if not loc.fetch_addr:
+                raise
+            # remote arena-backed object: the origin serves the arena slice
+            # under the object's shm name (same pull read_value does)
+            arena_src = (loc.arena_path, loc.arena_off)
+    with _ATTACHED_LOCK:
+        seg = _ATTACHED.get(loc.shm_name)
+    if seg is None:
+        try:
+            seg = ShmSegment.attach(loc.shm_name, loc.size)
+        except FileNotFoundError:
+            if not loc.fetch_addr:
+                raise
+            from ray_tpu._private import object_transfer
+
+            object_transfer.pull_object(
+                loc.shm_name, loc.fetch_addr, loc.size, arena=arena_src
+            )
+            seg = ShmSegment.attach(loc.shm_name, loc.size)
+        with _ATTACHED_LOCK:
+            seg = _ATTACHED.setdefault(loc.shm_name, seg)
+    return bytes(seg.buf)
 
 
 def read_value(loc: ObjectLocation, oid: Optional[bytes] = None) -> Any:

@@ -55,6 +55,10 @@ class Worker:
         self.client: Optional[CoreClient] = None
         self.node: Optional["Node"] = None  # driver only: in-process head
         self.node_id: str = ""
+        # thin-client mode (ray_tpu.init("client://...") — Ray Client
+        # analog): this process shares no shm with the cluster, so object
+        # payloads ride the control socket both ways
+        self.thin_client: bool = False
         self.worker_id: bytes = b""
         self.function_cache: Dict[bytes, Any] = {}
         self.registered_fn_ids: set = set()
@@ -158,15 +162,72 @@ class Worker:
     def put(self, value: Any) -> ObjectRef:
         self.flush_removals()
         ref = ObjectRef.random()
-        loc, contained = store_value(ref, value)
-        self.client.seal(ref.binary(), loc, [r.binary() for r in contained])
+        if self.thin_client:
+            self._put_blob(ref, value)
+        else:
+            loc, contained = store_value(ref, value)
+            self.client.seal(ref.binary(), loc, [r.binary() for r in contained])
         return self.track_ref(ref, owned=True)
+
+    def _put_blob(self, ref: ObjectRef, value: Any,
+                  track_contained: bool = True) -> None:
+        """Thin-client put: ship serialized bytes; the head stores them."""
+        meta, buffers, contained = serialization.serialize(value)
+        self.client.request({
+            "type": "put_blob",
+            "oid": ref.binary(),
+            "blob": serialization.to_bytes(meta, buffers),
+            # big-args specs track their refs via pinned_refs instead
+            "contained": [r.binary() for r in contained] if track_contained else [],
+        }, timeout=300)
+
+    def _get_blobs(self, oids: List[bytes], timeout: Optional[float]) -> List[Any]:
+        """Thin-client get: the head ships each payload over the socket.
+        One shared deadline across the batch (fat-client get semantics);
+        fetches run concurrently over the req_id-multiplexed connection."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ray_tpu.exceptions import GetTimeoutError
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+
+        def fetch(oid: bytes):
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise GetTimeoutError(f"Get timed out after {timeout}s")
+            reply = self.client.request(
+                {"type": "get_blob", "oid": oid, "timeout": remaining},
+                timeout=None if remaining is None else remaining + 30,
+            )["value"]
+            if reply.get("timeout"):
+                raise GetTimeoutError(f"Get timed out after {timeout}s")
+            if reply.get("error"):
+                raise RuntimeError(reply["error"])
+            value = serialization.deserialize(memoryview(reply["blob"]))
+            return value, bool(reply.get("is_error"))
+
+        unique = list(dict.fromkeys(oids))
+        if len(unique) == 1:
+            results = [fetch(unique[0])]
+        else:
+            with ThreadPoolExecutor(min(8, len(unique))) as ex:
+                results = list(ex.map(fetch, unique))
+        values: Dict[bytes, Any] = {}
+        for oid, (value, is_error) in zip(unique, results):
+            if is_error:
+                raise value
+            values[oid] = value
+        return [values[oid] for oid in oids]
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
         from ray_tpu.exceptions import GetTimeoutError
 
         self.flush_removals()
         oids = [r.binary() for r in refs]
+        if self.thin_client:
+            return self._get_blobs(oids, timeout)
         blocked = self.mode == "worker" and self.task_depth > 0
         if blocked:
             self.client.notify_blocked()
@@ -274,8 +335,12 @@ class Worker:
             # big args travel via the object store, not the control socket;
             # the spec owns this object's initial refcount
             big_ref = ObjectRef.random()
-            loc, _ = store_value(big_ref, (conv_args, conv_kwargs))
-            self.client.seal(big_ref.binary(), loc, [])
+            if self.thin_client:
+                self._put_blob(big_ref, (conv_args, conv_kwargs),
+                               track_contained=False)
+            else:
+                loc, _ = store_value(big_ref, (conv_args, conv_kwargs))
+                self.client.seal(big_ref.binary(), loc, [])
             args_blob = None
             args_oid = big_ref.binary()
             dep_ids.append(args_oid)

@@ -1,0 +1,77 @@
+"""Thin-client mode (Ray Client analog — reference ``ray.init("ray://...")``,
+``python/ray/util/client/ARCHITECTURE.md``): a process that shares no shm
+with the cluster drives it entirely over the control socket."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import ray_tpu
+
+
+CLIENT_SCRIPT = textwrap.dedent("""
+    import os
+    import numpy as np
+    import ray_tpu
+
+    # simulate a foreign host: force a bogus shm namespace so any
+    # accidental shm sharing would fail loudly
+    os.environ["RAY_TPU_SESSION"] = "thin-client-isolated"
+
+    ray_tpu.init(address=os.environ["THIN_ADDR"],
+                 _authkey=bytes.fromhex(os.environ["THIN_KEY"]))
+    from ray_tpu._private.worker import global_worker
+    assert global_worker.thin_client
+
+    # small put/get (inline path)
+    r = ray_tpu.put({"a": 1})
+    assert ray_tpu.get(r, timeout=60) == {"a": 1}
+
+    # big put/get (blob path: > max_direct_call_object_size)
+    arr = np.arange(300_000, dtype=np.float32)
+    big = ray_tpu.put(arr)
+    out = ray_tpu.get(big, timeout=120)
+    np.testing.assert_array_equal(out, arr)
+
+    # task with big args and big return, executed on the cluster
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    res = ray_tpu.get(double.remote(arr), timeout=180)
+    np.testing.assert_array_equal(res, arr * 2)
+
+    # actor round trip
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+        def add(self, k):
+            self.n += k
+            return self.n
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.add.remote(5), timeout=120) == 5
+    assert ray_tpu.get(c.add.remote(7), timeout=120) == 12
+    print("THIN_CLIENT_OK")
+""")
+
+
+def test_thin_client_end_to_end(ray_start_regular):
+    from ray_tpu._private.worker import global_worker
+
+    node = global_worker.node
+    host, port = node.tcp_address
+    env = dict(os.environ)
+    env["THIN_ADDR"] = f"client://{host}:{port}"
+    env["THIN_KEY"] = node.authkey.hex()
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", CLIENT_SCRIPT],
+        capture_output=True, text=True, timeout=420, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert "THIN_CLIENT_OK" in proc.stdout, (
+        f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-3000:]}"
+    )
