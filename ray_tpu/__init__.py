@@ -65,6 +65,7 @@ def init(
             if ignore_reinit_error:
                 return
             raise RuntimeError("ray_tpu.init() called twice")
+        thin = False
         if address is not None:
             import json
             import os
@@ -110,7 +111,7 @@ def init(
             client = CoreClient(node.address, node.authkey)
         client.register_client()
         global_worker.mode = "driver"
-        global_worker.thin_client = address is not None and thin
+        global_worker.thin_client = thin
         global_worker.node = node
         global_worker.client = client
         global_worker.node_id = node._head_node_id if node else "node-head"

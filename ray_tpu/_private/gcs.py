@@ -57,6 +57,9 @@ class TaskInfo:
     exec_start: Optional[float] = None
     exec_end: Optional[float] = None
     worker_pid: Optional[int] = None
+    # distributed trace context (util.tracing): set when the submitter was
+    # inside a trace() block; the timeline draws flow arrows from it
+    trace_ctx: Optional[dict] = None
 
 
 @dataclass

@@ -662,6 +662,23 @@ class Node:
         except (OSError, ValueError):
             pass
 
+    def _on_put_blob(self, conn: Connection, msg: dict) -> None:
+        """Store a thin client's shipped payload head-side and seal it
+        (Ray Client put).  Failures reply as errors — they must not tear
+        down the connection's serve loop."""
+        from ray_tpu._private.object_store import store_blob
+        from ray_tpu._private.object_ref import ObjectRef as _Ref
+
+        try:
+            loc = store_blob(_Ref(msg["oid"]), msg["blob"],
+                             is_error=msg.get("is_error", False))
+            self.seal_object(msg["oid"], loc, msg.get("contained", []))
+            value = True
+        except (OSError, ValueError) as e:
+            value = {"error": f"put failed: {e}"}
+        self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                           "value": value})
+
     def _on_get_blob(self, conn: Connection, msg: dict) -> None:
         """Ship an object's serialized payload to a thin client."""
         from ray_tpu._private.object_store import payload_bytes
@@ -752,16 +769,12 @@ class Node:
                                "value": {"session_id": self.session_id,
                                          "head_node_id": self._head_node_id}})
         elif mtype == "put_blob":
-            # thin client (Ray Client analog): the payload rode the socket;
-            # store it head-side and seal
-            from ray_tpu._private.object_store import store_blob
-            from ray_tpu._private.object_ref import ObjectRef as _Ref
-
-            loc = store_blob(_Ref(msg["oid"]), msg["blob"],
-                             is_error=msg.get("is_error", False))
-            self.seal_object(msg["oid"], loc, msg.get("contained", []))
-            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
-                               "value": True})
+            # off-thread like get_blob: a multi-GB shm write must not stall
+            # this connection's reader loop (the client multiplexes
+            # concurrent requests over it)
+            threading.Thread(
+                target=self._on_put_blob, args=(conn, msg), daemon=True
+            ).start()
         elif mtype == "get_blob":
             # served off-thread: wait_sealed may block for minutes and this
             # reader loop must keep handling the connection's other traffic
@@ -1134,7 +1147,8 @@ class Node:
         with self.lock:
             if not _resubmit:
                 self.gcs.tasks[spec["task_id"]] = TaskInfo(
-                    task_id=spec["task_id"], name=spec.get("name", "task")
+                    task_id=spec["task_id"], name=spec.get("name", "task"),
+                    trace_ctx=spec.get("trace_ctx"),
                 )
                 track = (
                     not spec.get("actor_id")
@@ -1812,7 +1826,10 @@ class Node:
                 err = RayActorError(f"Actor is dead: {cause}")
                 threading.Thread(target=self._seal_error_returns, args=(spec, err), daemon=True).start()
                 return
-            self.gcs.tasks[spec["task_id"]] = TaskInfo(task_id=spec["task_id"], name=spec.get("name", "actor_task"))
+            self.gcs.tasks[spec["task_id"]] = TaskInfo(
+                task_id=spec["task_id"], name=spec.get("name", "actor_task"),
+                trace_ctx=spec.get("trace_ctx"),
+            )
             art.queue.append(spec)
             self.cond.notify_all()
 

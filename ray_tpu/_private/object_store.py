@@ -514,25 +514,35 @@ def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[Obj
         # arena full: fall through to the per-object-file path
     # producer side writes through the fd (page-allocation path, ~2.4x the
     # mmap-memcpy bandwidth on tmpfs); consumers still mmap zero-copy
+    name = _write_segment(
+        name, lambda fd: serialization.write_to_fd(fd, meta, buffers), total
+    )
+    return ObjectLocation(shm_name=name, size=total, is_error=is_error), refs
+
+
+def _write_segment(name: str, write_fn, expected: int) -> str:
+    """Exclusive-create a named shm segment and fill it via ``write_fn(fd)``.
+
+    A name collision means a prior attempt of the same task created the
+    segment; it may be a SEALED live object — never unlink or rewrite it.
+    This attempt publishes under a unique name and first-seal-wins reaps
+    the loser.  Any write failure unlinks the partial file."""
     path = ShmSegment.path_for(name)
     try:
         fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
     except FileExistsError:
-        # a prior attempt of this task created this segment; it may be a
-        # SEALED live object — never unlink or rewrite it.  Publish this
-        # attempt under a unique name; first-seal-wins reaps the loser.
         name = f"{name}-r{os.urandom(3).hex()}"
         path = ShmSegment.path_for(name)
         fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
     try:
-        written = serialization.write_to_fd(fd, meta, buffers)
-        assert written == total, f"wrote {written}, expected {total}"
+        written = write_fn(fd)
+        assert written == expected, f"wrote {written}, expected {expected}"
     except BaseException:
         os.close(fd)
         os.unlink(path)
         raise
     os.close(fd)
-    return ObjectLocation(shm_name=name, size=total, is_error=is_error), refs
+    return name
 
 
 def store_blob(ref: ObjectRef, blob: bytes, is_error: bool = False) -> ObjectLocation:
@@ -542,24 +552,17 @@ def store_blob(ref: ObjectRef, blob: bytes, is_error: bool = False) -> ObjectLoc
     cfg = get_config()
     if len(blob) <= cfg.max_direct_call_object_size:
         return ObjectLocation(inline=bytes(blob), is_error=is_error)
-    name = session_shm_name(ref.hex())
-    path = ShmSegment.path_for(name)
-    try:
-        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
-    except FileExistsError:
-        name = f"{name}-r{os.urandom(3).hex()}"
-        path = ShmSegment.path_for(name)
-        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
-    try:
+
+    def write_all(fd: int) -> int:
         view = memoryview(blob)
+        total = 0
         while view:  # os.write caps single writes (~2 GiB on Linux)
             n = os.write(fd, view)
             view = view[n:]
-    except BaseException:
-        os.close(fd)
-        os.unlink(path)
-        raise
-    os.close(fd)
+            total += n
+        return total
+
+    name = _write_segment(session_shm_name(ref.hex()), write_all, len(blob))
     return ObjectLocation(shm_name=name, size=len(blob), is_error=is_error)
 
 

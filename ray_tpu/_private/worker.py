@@ -173,13 +173,15 @@ class Worker:
                   track_contained: bool = True) -> None:
         """Thin-client put: ship serialized bytes; the head stores them."""
         meta, buffers, contained = serialization.serialize(value)
-        self.client.request({
+        reply = self.client.request({
             "type": "put_blob",
             "oid": ref.binary(),
             "blob": serialization.to_bytes(meta, buffers),
             # big-args specs track their refs via pinned_refs instead
             "contained": [r.binary() for r in contained] if track_contained else [],
-        }, timeout=300)
+        }, timeout=300)["value"]
+        if isinstance(reply, dict) and reply.get("error"):
+            raise RuntimeError(reply["error"])
 
     def _get_blobs(self, oids: List[bytes], timeout: Optional[float]) -> List[Any]:
         """Thin-client get: the head ships each payload over the socket.
@@ -371,6 +373,11 @@ class Worker:
             "max_concurrency": max_concurrency,
             "release_cpu_after_start": release_cpu_after_start,
         }
+        from ray_tpu.util import tracing
+
+        trace_ctx = tracing.child_context_for_task(name)
+        if trace_ctx is not None:
+            spec["trace_ctx"] = trace_ctx
         return spec, [
             self.track_ref(ObjectRef(oid), owned=True) for oid in return_ids
         ]
@@ -456,6 +463,12 @@ def _execute_task(msg: dict) -> None:
         os.environ.pop("TPU_VISIBLE_CHIPS", None)
         os.environ.pop("RAY_TPU_ASSIGNED_TPUS", None)
     w.current_task_id = spec["task_id"]
+    # continue the submitter's trace: nested submissions from this thread
+    # chain under it (tracing_helper.py span-resume analog).  Set even when
+    # None — a pooled worker must not leak the previous task's context.
+    from ray_tpu.util import tracing
+
+    tracing._current.set(spec.get("trace_ctx"))
     exec_start = time.time()  # profile event (core_worker profiling.h:30)
     failed = False
     error_str = None

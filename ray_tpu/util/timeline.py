@@ -42,6 +42,11 @@ def timeline_events() -> List[dict]:
                 "args": {"task_id": t.get("task_id")},
             })
             start, end = exec_start, exec_end or now
+        args = {"state": t.get("state"), "task_id": t.get("task_id")}
+        tc = t.get("trace_ctx")
+        if tc:
+            args.update(trace_id=tc.get("trace_id"), span_id=tc.get("span_id"),
+                        parent_span_id=tc.get("parent_span_id"))
         events.append({
             "name": t.get("name", "task"),
             "cat": "task",
@@ -50,7 +55,34 @@ def timeline_events() -> List[dict]:
             "dur": max(0.0, (end - start) * 1e6),
             "pid": pid,
             "tid": tid,
-            "args": {"state": t.get("state"), "task_id": t.get("task_id")},
+            "args": args,
+        })
+        if tc:
+            # flow arrows: submitter span -> this task (chrome flow events
+            # bind on matching id; the parent task emits the "s" below)
+            events.append({
+                "name": tc.get("name", "submit"), "cat": "trace", "ph": "f",
+                "bp": "e", "id": tc.get("span_id"),
+                "ts": start * 1e6, "pid": pid, "tid": tid,
+            })
+    # emit flow starts from each parent task's exec window
+    by_span = {
+        (t.get("trace_ctx") or {}).get("span_id"): t
+        for t in tasks if t.get("trace_ctx")
+    }
+    for t in tasks:
+        tc = t.get("trace_ctx")
+        if not tc:
+            continue
+        parent = by_span.get(tc.get("parent_span_id"))
+        if parent is None or parent.get("start_time") is None:
+            continue
+        ts = (parent.get("exec_start") or parent["start_time"]) * 1e6
+        events.append({
+            "name": tc.get("name", "submit"), "cat": "trace", "ph": "s",
+            "id": tc.get("span_id"), "ts": ts,
+            "pid": parent.get("node_id") or "pending",
+            "tid": parent.get("worker_pid") or (parent.get("task_id") or "")[:8],
         })
     return events
 

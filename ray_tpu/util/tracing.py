@@ -1,0 +1,75 @@
+"""Distributed trace-context propagation across task/actor boundaries.
+
+Analog of the reference's ``python/ray/util/tracing/tracing_helper.py``
+(monkey-patched remote calls inject OpenTelemetry span contexts into task
+metadata; workers resume the trace).  Here propagation is first-class
+instead of patched on: when tracing is enabled, every task spec carries the
+submitter's trace context, the executing worker adopts it for the duration
+of the task (so nested submissions chain), and the head records it on
+TaskInfo — ``ray_tpu timeline`` then emits chrome-trace flow arrows linking
+parents to children.  If the OpenTelemetry SDK is importable, real spans
+are started as well (the reference's lazy-import pattern).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import uuid
+from typing import Any, Dict, Iterator, Optional
+
+_current: contextvars.ContextVar[Optional[Dict[str, str]]] = contextvars.ContextVar(
+    "ray_tpu_trace", default=None
+)
+
+
+def current_context() -> Optional[Dict[str, str]]:
+    """The active trace context, or None (outside any trace).  Presence of
+    a context IS the enable signal — specs stay clean when tracing is
+    unused, and workers propagate whenever a spec carries one."""
+    return _current.get()
+
+
+@contextlib.contextmanager
+def trace(name: str, attributes: Optional[dict] = None) -> Iterator[Dict[str, str]]:
+    """Open a span.  Tasks submitted inside the block carry its context;
+    their workers continue the same trace."""
+    parent = _current.get()
+    ctx = {
+        "trace_id": parent["trace_id"] if parent else uuid.uuid4().hex,
+        "span_id": uuid.uuid4().hex[:16],
+        "parent_span_id": parent["span_id"] if parent else "",
+        "name": name,
+    }
+    token = _current.set(ctx)
+    otel_cm = _otel_span(name, attributes)
+    try:
+        with otel_cm:
+            yield ctx
+    finally:
+        _current.reset(token)
+
+
+def _otel_span(name: str, attributes: Optional[dict]):
+    """A real OpenTelemetry span when the SDK is importable, else a no-op
+    (``tracing_helper.py:53-59`` lazy import)."""
+    try:
+        from opentelemetry import trace as otel  # type: ignore
+    except ImportError:
+        return contextlib.nullcontext()
+    tracer = otel.get_tracer("ray_tpu")
+    return tracer.start_as_current_span(name, attributes=attributes or {})
+
+
+def child_context_for_task(task_name: str) -> Optional[Dict[str, str]]:
+    """Context to embed in an outgoing task spec: a fresh span chained
+    under the caller's (None when tracing is off — specs stay clean)."""
+    parent = current_context()
+    if parent is None:
+        return None
+    return {
+        "trace_id": parent["trace_id"],
+        "span_id": uuid.uuid4().hex[:16],
+        "parent_span_id": parent["span_id"],
+        "name": task_name,
+    }

@@ -293,3 +293,53 @@ def test_usage_report_written(tmp_path):
     report = json.load(open(os.path.join(session_dir, "usage_report.json")))
     assert "unit-test-feature" in report["features_used"]
     assert report["counters"]["tasks_total"] >= 1
+
+
+def test_trace_context_propagates_across_tasks(ray_start_regular):
+    """util.tracing: tasks submitted inside trace() carry the context;
+    nested submissions in workers chain under the same trace (the
+    reference's tracing_helper span-injection analog)."""
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    @ray_tpu.remote
+    def child():
+        from ray_tpu.util import tracing as t
+
+        return t.current_context()
+
+    @ray_tpu.remote
+    def parent():
+        from ray_tpu.util import tracing as t
+
+        ctx = t.current_context()
+        nested = ray_tpu.get(child.remote(), timeout=120)
+        return ctx, nested
+
+    with tracing.trace("experiment") as root:
+        ref = parent.remote()
+    p_ctx, c_ctx = ray_tpu.get(ref, timeout=120)
+    assert p_ctx["trace_id"] == root["trace_id"]
+    assert p_ctx["parent_span_id"] == root["span_id"]
+    # nested task chains under the parent task's span, same trace
+    assert c_ctx["trace_id"] == root["trace_id"]
+    assert c_ctx["parent_span_id"] == p_ctx["span_id"]
+
+    # untraced tasks carry nothing
+    @ray_tpu.remote
+    def plain():
+        from ray_tpu.util import tracing as t
+
+        return t.current_context()
+
+    assert ray_tpu.get(plain.remote(), timeout=120) is None
+
+    # head recorded the context; the timeline links parent -> child
+    from ray_tpu.util.timeline import timeline_events
+
+    events = timeline_events()
+    traced = [e for e in events
+              if e.get("args", {}).get("trace_id") == root["trace_id"]]
+    assert len(traced) >= 2
+    flows = [e for e in events if e.get("cat") == "trace" and e["ph"] in ("s", "f")]
+    assert any(e["ph"] == "s" for e in flows) and any(e["ph"] == "f" for e in flows)
