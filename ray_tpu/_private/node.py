@@ -674,8 +674,9 @@ class Node:
                              is_error=msg.get("is_error", False))
             self.seal_object(msg["oid"], loc, msg.get("contained", []))
             value = True
-        except (OSError, ValueError) as e:
-            value = {"error": f"put failed: {e}"}
+        except Exception as e:  # noqa: BLE001 — ANY failure must reply,
+            # or the client blocks on its 300 s request timeout
+            value = {"error": f"put failed: {type(e).__name__}: {e}"}
         self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
                            "value": value})
 

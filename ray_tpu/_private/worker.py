@@ -407,7 +407,14 @@ def _get_async_loop() -> asyncio.AbstractEventLoop:
     return _async_loop
 
 
-async def _ensure_coro(awaitable):
+async def _ensure_coro(awaitable, trace_ctx=None):
+    if trace_ctx is not None:
+        # run_coroutine_threadsafe creates the Task with the LOOP thread's
+        # context, not the submitting executor thread's — re-adopt here so
+        # nested submissions from async actor methods stay in the trace
+        from ray_tpu.util import tracing
+
+        tracing._current.set(trace_ctx)
     return await awaitable
 
 
@@ -502,7 +509,7 @@ def _execute_task(msg: dict) -> None:
                     # the loop, not the executor pool — 1000 awaiting calls
                     # cost 1000 loop tasks, not 1000 threads.
                     fut = asyncio.run_coroutine_threadsafe(
-                        _ensure_coro(out), _get_async_loop()
+                        _ensure_coro(out, spec.get("trace_ctx")), _get_async_loop()
                     )
 
                     def _complete(f, spec=spec, exec_start=exec_start):
@@ -536,7 +543,7 @@ def _execute_task(msg: dict) -> None:
                 out = fn(*args, **kwargs)
                 if inspect.isawaitable(out):  # async remote function
                     out = asyncio.run_coroutine_threadsafe(
-                        _ensure_coro(out), _get_async_loop()
+                        _ensure_coro(out, spec.get("trace_ctx")), _get_async_loop()
                     ).result()
             finally:
                 w.task_depth -= 1

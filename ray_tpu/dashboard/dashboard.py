@@ -122,11 +122,39 @@ class Dashboard:
                 "num_tasks": len(snap["tasks"]),
                 "num_workers": num_workers,
             })
+        if what == "serve/applications":
+            return self._serve_status()
         try:
             # the state-API backend takes the right locks and strips blobs
             return _jsonable(node._list_state(what, limit))
         except ValueError:
             return None
+
+    def _serve_status(self):
+        """Serve REST module (``dashboard/modules/serve`` analog): live
+        deployment + autoscaling state pulled from the controller actor.
+        No controller -> {}; a broken/slow controller -> explicit error
+        payload (an operator must be able to tell the two apart)."""
+        import ray_tpu
+        from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+        try:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:
+            return {}  # serve not running
+        try:
+            status = ray_tpu.get(controller.get_status.remote(), timeout=10)
+            # independent per-deployment calls: submit all, one shared get
+            refs = {
+                name: controller.get_autoscaling_metrics.remote(name)
+                for name in status
+            }
+            metrics = ray_tpu.get(list(refs.values()), timeout=10)
+            for (name, _), m in zip(refs.items(), metrics):
+                status[name]["autoscaling_metrics"] = m
+            return _jsonable(status)
+        except Exception as e:  # noqa: BLE001
+            return {"error": f"serve controller unavailable: {type(e).__name__}: {e}"}
 
     def _metrics_text(self) -> str:
         node = self.node

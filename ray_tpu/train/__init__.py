@@ -10,6 +10,7 @@ global SPMD program.
 from ray_tpu.air import Checkpoint, Result, RunConfig, ScalingConfig
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
 from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
+from ray_tpu.train.sklearn_trainer import SklearnPredictor, SklearnTrainer
 from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
 from ray_tpu.train.worker_group import WorkerGroup
 from ray_tpu.train import jax_utils
@@ -18,6 +19,8 @@ __all__ = [
     "Predictor",
     "JaxPredictor",
     "BatchPredictor",
+    "SklearnTrainer",
+    "SklearnPredictor",
     "Backend",
     "BackendConfig",
     "JaxConfig",

@@ -64,3 +64,41 @@ def test_predictor_base_raises(ray_start_regular, linear_checkpoint):
         Predictor.from_checkpoint(linear_checkpoint)
     with pytest.raises(TypeError):
         BatchPredictor.from_checkpoint(linear_checkpoint, dict)
+
+
+def test_sklearn_trainer_and_predictor(ray_start_regular):
+    """SklearnTrainer fits a gradient-boosted model under Tune and the
+    checkpoint scores Datasets via BatchPredictor (the GBDT trainer-family
+    analog — sklearn HistGradientBoosting in this image)."""
+    from sklearn.ensemble import HistGradientBoostingClassifier
+
+    from ray_tpu.train import SklearnPredictor, SklearnTrainer
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((300, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(int)
+    rows = [{"f0": a, "f1": b, "f2": c, "f3": d, "label": int(t)}
+            for (a, b, c, d), t in zip(X, y)]
+    train_ds = read_api.from_items(rows[:240])
+    valid_ds = read_api.from_items(rows[240:])
+
+    trainer = SklearnTrainer(
+        estimator=HistGradientBoostingClassifier(max_iter=30, random_state=0),
+        datasets={"train": train_ds, "valid": valid_ds},
+        label_column="label",
+    )
+    result = trainer.fit()
+    assert result.metrics["fit_rows"] == 240
+    assert result.metrics["valid_score"] > 0.85
+    est = SklearnTrainer.get_model(result.checkpoint)
+    assert est.predict(X[:5]).shape == (5,)
+
+    bp = BatchPredictor.from_checkpoint(result.checkpoint, SklearnPredictor)
+    score_ds = read_api.from_items(
+        [{"f0": a, "f1": b, "f2": c, "f3": d}
+         for a, b, c, d in X[:40]]
+    )
+    out = bp.predict(score_ds, batch_size=16, max_scoring_workers=1)
+    preds = np.concatenate([np.atleast_1d(r["predictions"]) for r in out.take_all()])
+    assert preds.shape == (40,)
+    assert (preds == y[:40]).mean() > 0.8

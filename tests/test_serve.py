@@ -330,3 +330,28 @@ def test_long_poll_membership_propagation(serve_instance):
         pids.add(ray_tpu.get(handle.remote(), timeout=60))
     assert len(pids) == 2
     serve.delete("Pid")
+
+
+def test_serve_dashboard_rest(serve_instance):
+    """Serve status is exposed on the head dashboard REST API
+    (dashboard/modules/serve analog)."""
+    import gc
+
+    from ray_tpu._private import node as node_mod
+
+    @serve.deployment
+    class Ping:
+        def __call__(self, request=None):
+            return "pong"
+
+    serve.run(Ping.bind(), port=0)
+    heads = [o for o in gc.get_objects()
+             if isinstance(o, node_mod.Node) and not o._shutdown]
+    dash = heads[-1].dashboard
+    host, port = dash.address
+    status, body = _http("/api/serve/applications", port=port)
+    assert status == 200
+    apps = json.loads(body)
+    assert apps["Ping"]["status"] in ("HEALTHY", "UPDATING")
+    assert "autoscaling_metrics" in apps["Ping"]
+    serve.delete("Ping")
