@@ -48,7 +48,10 @@ class Config:
     # ray_config_def.h scheduler_spread_threshold).
     scheduler_spread_threshold: float = 0.5
     # Max workers a node will keep warm beyond its CPU count.
-    maximum_startup_concurrency: int = 8
+    # Simultaneous worker spawns per runtime-env key (the reference's
+    # maximum_startup_concurrency role): python boots are expensive on
+    # small hosts, so starts are staggered.
+    maximum_startup_concurrency: int = 2
     # Seconds an idle worker is kept before being reaped.
     idle_worker_killing_time_threshold_s: float = 300.0
     # Agent liveness probing (GcsHealthCheckManager analog): ping period

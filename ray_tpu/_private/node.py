@@ -208,6 +208,23 @@ class PGRuntime:
     ready_oid: Optional[bytes] = None
 
 
+def _placement_shape(spec: dict):
+    """Hashable (resources, strategy) placement identity: two specs with
+    the same shape place identically, so one failure covers both within a
+    scheduling pass."""
+    strat = spec.get("scheduling_strategy")
+    skey = None
+    if isinstance(strat, dict):
+        skey = (
+            strat.get("kind"),
+            strat.get("node_id"),
+            strat.get("pg_id"),
+            strat.get("bundle_index"),
+            strat.get("soft"),
+        )
+    return (tuple(sorted(spec.get("resources", {}).items())), skey)
+
+
 @dataclass
 class _PendingGet:
     req_id: int
@@ -216,6 +233,11 @@ class _PendingGet:
     deadline: Optional[float]
     kind: str = "get"  # get | wait
     num_returns: int = 0
+    # oids not yet sealed, maintained by _notify_sealed so a seal touches
+    # only the gets waiting on that oid (O(1) instead of rescanning every
+    # waiter's full oid list — the old path was O(waiters x oids) per seal)
+    unsealed: Any = None  # set[bytes]
+    done: bool = False
 
 
 class Node:
@@ -316,6 +338,8 @@ class Node:
         self.running: Dict[bytes, dict] = {}  # task_id -> {spec, worker, node_id, held, tpu_ids}
         self.workers: Dict[bytes, WorkerHandle] = {}
         self.pending_gets: List[_PendingGet] = []
+        # oid -> waiters parked on it (seal-driven O(1) get/wait wakeups)
+        self._get_waiters: Dict[bytes, List[_PendingGet]] = {}
         # pubsub channels: long-poll publisher/subscriber analog
         # (src/ray/pubsub/ — node_change/error/log + app channels)
         self.subscribers: Dict[str, List[Connection]] = {}
@@ -501,6 +525,7 @@ class Node:
                 )
                 loc, _ = store_value(ObjectRef(oid), err, is_error=True)
                 self.registry.seal(oid, loc, only_if_live=True)
+                self._notify_sealed(oid)
                 continue
             tid = spec["task_id"]
             if tid in resubmitted:
@@ -521,6 +546,7 @@ class Node:
                     # resurrecting a refcount-deleted return would leak
                     loc, _ = store_value(ObjectRef(rid), err, is_error=True)
                     self.registry.seal(rid, loc, only_if_live=True)
+                    self._notify_sealed(rid)
                 continue
             n_rebuilt += 1
             # deps that died in the same event are themselves in `lost` and
@@ -1034,7 +1060,7 @@ class Node:
         # contained refs are counted (and remembered for cascade-decrement
         # when this object dies) inside the registry
         self.registry.seal(oid, loc, contained)
-        self._service_pending_gets()
+        self._notify_sealed(oid)
         with self.lock:
             self.cond.notify_all()
 
@@ -1050,58 +1076,132 @@ class Node:
         for oid in spec.pop("owned_oids", None) or []:
             self.registry.remove_ref(oid)
 
+    def _register_pending_get(self, pg: _PendingGet) -> None:
+        replies = []
+        with self.lock:
+            pg.unsealed = {
+                oid for oid in pg.oids if not self.registry.is_sealed(oid)
+            }
+            reply = self._try_complete(pg, time.monotonic())
+            if reply is not None:
+                pg.done = True
+                replies.append((pg, reply))
+            else:
+                self.pending_gets.append(pg)
+                for oid in pg.unsealed:
+                    lst = self._get_waiters.get(oid)
+                    if lst is None:
+                        self._get_waiters[oid] = [pg]
+                    else:
+                        # compact completed waiters on touch — without this
+                        # a poll loop on a never-sealing oid grows the list
+                        # one dead entry per poll, forever
+                        lst[:] = [p for p in lst if not p.done]
+                        lst.append(pg)
+        for pg, reply in replies:
+            pg.conn_send(reply)
+
     def _on_get_request(self, conn: Connection, msg: dict, worker: Optional[WorkerHandle]) -> None:
         oids = msg["oids"]
         timeout = msg.get("timeout")
         deadline = time.monotonic() + timeout if timeout is not None else None
-        pg = _PendingGet(
+        self._register_pending_get(_PendingGet(
             req_id=msg["req_id"],
             conn_send=lambda m: self._reply(conn, m),
             oids=oids,
             deadline=deadline,
-        )
-        with self.lock:
-            self.pending_gets.append(pg)
-        self._service_pending_gets()
+        ))
 
     def _on_wait_request(self, conn: Connection, msg: dict, worker: Optional[WorkerHandle]) -> None:
         timeout = msg.get("timeout")
         deadline = time.monotonic() + timeout if timeout is not None else None
-        pg = _PendingGet(
+        self._register_pending_get(_PendingGet(
             req_id=msg["req_id"],
             conn_send=lambda m: self._reply(conn, m),
             oids=msg["oids"],
             deadline=deadline,
             kind="wait",
             num_returns=msg["num_returns"],
-        )
+        ))
+
+    def _try_complete(self, pg: _PendingGet, now: float) -> Optional[dict]:
+        """Completion/expiry check for one waiter using its cached unsealed
+        set (lock held).  Returns the reply, or None to keep waiting."""
+        expired = pg.deadline is not None and now >= pg.deadline
+        if pg.kind == "get":
+            if not pg.unsealed:
+                locs = {oid: self.registry.get_location(oid) for oid in pg.oids}
+                if any(v is None for v in locs.values()):
+                    # an oid un-sealed again (node loss between seal and
+                    # completion): recompute and keep waiting
+                    pg.unsealed = {
+                        oid for oid in pg.oids if not self.registry.is_sealed(oid)
+                    }
+                    for oid in pg.unsealed:
+                        self._get_waiters.setdefault(oid, []).append(pg)
+                    if pg.unsealed:
+                        if expired:
+                            return {"type": "reply", "req_id": pg.req_id,
+                                    "timeout": True}
+                        return None
+                    locs = {oid: self.registry.get_location(oid)
+                            for oid in pg.oids}
+                return {"type": "reply", "req_id": pg.req_id, "locations": locs}
+            if expired:
+                return {"type": "reply", "req_id": pg.req_id, "timeout": True}
+            return None
+        # wait — the cached set can overstate sealing (node loss un-seals),
+        # so completion is always confirmed against the registry
+        n_sealed = len(pg.oids) - len(pg.unsealed)
+        if n_sealed >= pg.num_returns or expired:
+            sealed = [oid for oid in pg.oids if self.registry.is_sealed(oid)]
+            if len(sealed) < pg.num_returns and not expired:
+                pg.unsealed = {
+                    oid for oid in pg.oids if not self.registry.is_sealed(oid)
+                }
+                for oid in pg.unsealed:
+                    self._get_waiters.setdefault(oid, []).append(pg)
+                return None
+            locs = {oid: self.registry.get_location(oid) for oid in sealed}
+            return {"type": "reply", "req_id": pg.req_id,
+                    "ready": sealed, "locations": locs}
+        return None
+
+    def _notify_sealed(self, oid: bytes) -> None:
+        """A seal wakes only the waiters parked on that oid."""
+        now = time.monotonic()
+        replies: List[Tuple[_PendingGet, dict]] = []
         with self.lock:
-            self.pending_gets.append(pg)
-        self._service_pending_gets()
+            waiters = self._get_waiters.pop(oid, None)
+            if not waiters:
+                return
+            for pg in waiters:
+                if pg.done:
+                    continue
+                pg.unsealed.discard(oid)
+                reply = self._try_complete(pg, now)
+                if reply is not None:
+                    pg.done = True
+                    replies.append((pg, reply))
+        for pg, reply in replies:
+            pg.conn_send(reply)
 
     def _service_pending_gets(self, now: Optional[float] = None) -> None:
+        """Periodic sweep: deadline expiry + pruning of completed waiters
+        (seal-driven wakeups go through _notify_sealed)."""
         now = now or time.monotonic()
         done: List[Tuple[_PendingGet, dict]] = []
         with self.lock:
             remaining = []
             for pg in self.pending_gets:
-                sealed = [oid for oid in pg.oids if self.registry.is_sealed(oid)]
-                expired = pg.deadline is not None and now >= pg.deadline
-                if pg.kind == "get":
-                    if len(sealed) == len(pg.oids):
-                        locs = {oid: self.registry.get_location(oid) for oid in pg.oids}
-                        done.append((pg, {"type": "reply", "req_id": pg.req_id, "locations": locs}))
-                    elif expired:
-                        done.append((pg, {"type": "reply", "req_id": pg.req_id, "timeout": True}))
-                    else:
-                        remaining.append(pg)
-                else:  # wait
-                    if len(sealed) >= pg.num_returns or expired:
-                        locs = {oid: self.registry.get_location(oid) for oid in sealed}
-                        done.append((pg, {"type": "reply", "req_id": pg.req_id,
-                                          "ready": sealed, "locations": locs}))
-                    else:
-                        remaining.append(pg)
+                if pg.done:
+                    continue  # prune: replied via _notify_sealed
+                reply = self._try_complete(pg, now)
+                if reply is not None:
+                    pg.done = True
+                    done.append((pg, reply))
+                else:
+                    remaining.append(pg)
             self.pending_gets = remaining
         for pg, reply in done:
             pg.conn_send(reply)
@@ -1198,6 +1298,7 @@ class Node:
         for oid in spec["return_ids"]:
             loc, _ = store_value(ObjectRef(oid), err, is_error=True)
             self.registry.seal(oid, loc)
+            self._notify_sealed(oid)
         self.publish("error", {"task": spec.get("name"),
                                "task_id": spec["task_id"].hex(),
                                "error": str(err)})
@@ -1206,7 +1307,6 @@ class Node:
             if ti:
                 ti.state = "FAILED"
                 ti.end_time = time.time()
-        self._service_pending_gets()
 
     def _deps_ready(self, spec: dict) -> bool:
         return all(self.registry.is_sealed(d) for d in spec.get("dep_ids", []))
@@ -1463,9 +1563,18 @@ class Node:
         with self.lock:
             still_pending = deque()
             failed_specs = []
+            # per-pass memo: once a (resources, strategy) shape fails to
+            # place, identical later specs skip _select_node — a long
+            # homogeneous backlog costs O(1) per spec instead of a full
+            # node scan each (the 1M-queued-tasks envelope depends on this)
+            stuck_shapes = set()
             while self.pending_tasks:
                 spec = self.pending_tasks.popleft()
                 if not self._deps_ready(spec):
+                    still_pending.append(spec)
+                    continue
+                shape = _placement_shape(spec)
+                if shape in stuck_shapes:
                     still_pending.append(spec)
                     continue
                 try:
@@ -1477,6 +1586,7 @@ class Node:
                     failed_specs.append((spec, e))
                     continue
                 if sel is None:
+                    stuck_shapes.add(shape)
                     still_pending.append(spec)
                     continue
                 ns, bundle = sel
@@ -1509,12 +1619,20 @@ class Node:
                     ns.idle.remove(w)
                     self._dispatch(ns, w, spec, tpu_ids, bundle)
                 if deferred:
-                    cap = int(ns.total.get(CPU, 1)) + self.cfg.maximum_startup_concurrency
-                    n_workers = sum(
-                        1
-                        for w in self.workers.values()
-                        if w.node_id == ns.node_id and w.state != "dead" and not w.is_actor_worker
-                    )
+                    # Pool size is resource-feasible, not a fixed headroom:
+                    # workers beyond the CPU count can never dispatch (the
+                    # resource gate holds them) but their spawns starve a
+                    # small host.  Blocked workers released their CPUs, so
+                    # each one justifies a replacement (nested-get progress).
+                    n_workers = 0
+                    blocked = 0
+                    for w in self.workers.values():
+                        if (w.node_id == ns.node_id and w.state != "dead"
+                                and not w.is_actor_worker):
+                            n_workers += 1
+                            if w.block_depth > 0:
+                                blocked += 1
+                    cap = int(ns.total.get(CPU, 1)) + blocked
                     # Spawn only what the queues need; python startup is
                     # expensive, so never boot more than 2 at a time per env.
                     need_by_key: Dict[Optional[str], int] = {}
@@ -1528,7 +1646,7 @@ class Node:
                         starting = ns.starting_by_key.get(key, 0)
                         while (
                             need > starting
-                            and starting < 2
+                            and starting < self.cfg.maximum_startup_concurrency
                             and n_workers + ns.starting < max(1, cap)
                         ):
                             self._spawn_worker(ns, runtime_env=env_by_key[key])
@@ -1649,11 +1767,43 @@ class Node:
                 if ns and ns.alive:
                     w.idle_since = time.time()
                     ns.idle.append(w)
+                    # OnWorkerIdle fast path (direct_task_transport.cc:174):
+                    # hand this worker the next compatible pending task
+                    # right here, skipping a scheduler-thread round trip
+                    # per completion (the hot-loop latency of a task wave)
+                    self._fast_redispatch(ns, w)
             if w.is_actor_worker and w.actor_id in self.actors:
                 art = self.actors[w.actor_id]
                 if not is_creation:
                     art.inflight.pop(tid, None)
             self.cond.notify_all()
+
+    def _fast_redispatch(self, ns: NodeState, w: WorkerHandle) -> None:
+        """Dispatch the first plain pending task this idle worker can run
+        (lock held).  Only strategy-free CPU-only specs qualify — anything
+        with affinity/PG/TPU placement goes through the full scheduler."""
+        if w.state != "idle" or not ns.alive or not self.pending_tasks:
+            return
+        # only the queue head: skipping past it would reorder submissions
+        spec = self.pending_tasks[0]
+        req = spec.get("resources", {})
+        if (
+            spec.get("scheduling_strategy") is not None
+            or req.get(TPU, 0)
+            or _runtime_env_key(spec.get("runtime_env")) != w.runtime_env_key
+            or not self._deps_ready(spec)
+            or not _fits(req, ns.available)
+        ):
+            return  # needs the real scheduler pass
+        self.pending_tasks.popleft()
+        _acquire(req, ns.available)
+        try:
+            ns.idle.remove(w)
+        except ValueError:
+            _release(req, ns.available)
+            self.pending_tasks.appendleft(spec)
+            return
+        self._dispatch(ns, w, spec, [], None)
 
     # ------------------------------------------------------------------
     # actors (GcsActorManager FSM analog)

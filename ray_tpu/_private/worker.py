@@ -624,7 +624,13 @@ def main() -> None:
     client = CoreClient(address, authkey, worker_id=worker_id, node_id=node_id)
     client._exec_queue = queue.Queue()
     w.client = client
-    client.register_worker()
+    try:
+        client.register_worker()
+    except (BrokenPipeError, ConnectionError, OSError, EOFError):
+        # our head died while we were booting (or we're a straggler from a
+        # killed session whose port got reused): exit quietly — a traceback
+        # on the inherited stderr reads like a live-session failure
+        os._exit(0)
 
     # app metrics recorded in this worker flow to the head's /metrics
     from ray_tpu.util.metrics import MetricsPusher
