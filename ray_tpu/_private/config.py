@@ -52,6 +52,11 @@ class Config:
     # maximum_startup_concurrency role): python boots are expensive on
     # small hosts, so starts are staggered.
     maximum_startup_concurrency: int = 2
+    # Plain workers forked at head start (WorkerPool prestart,
+    # num_prestart_python_workers analog); -1 = min(num_cpus, 4).  Booting
+    # them while the session is idle matters: under load, forked
+    # interpreters are starved and the pool never ramps.
+    num_prestart_workers: int = -1
     # Seconds an idle worker is kept before being reaped.
     idle_worker_killing_time_threshold_s: float = 300.0
     # Agent liveness probing (GcsHealthCheckManager analog): ping period

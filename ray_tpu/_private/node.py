@@ -417,10 +417,18 @@ class Node:
                 logger.warning("dashboard failed to start:\n%s", traceback.format_exc())
         # session discovery for `ray_tpu.init(address="auto")` / the CLI
         self._write_session_file()
-        # Prestart one warm worker (WorkerPool prestart analog) to hide
-        # interpreter boot latency on first task.
+        # Prestart the plain worker pool up to the CPU count (WorkerPool
+        # prestart, reference worker_pool.h:156 num_prestart_python_workers).
+        # Boots overlap with early driver work; spawning lazily instead
+        # means later parallel load starves the forked interpreters of CPU
+        # and the pool never ramps while the cluster is busy.
         with self.lock:
-            self._spawn_worker(self.nodes[self._head_node_id])
+            head_ns = self.nodes[self._head_node_id]
+            n_prestart = self.cfg.num_prestart_workers
+            if n_prestart < 0:
+                n_prestart = max(1, min(int(head_ns.total.get(CPU, 1)), 4))
+            for _ in range(n_prestart):
+                self._spawn_worker(head_ns)
 
     def _write_session_file(self) -> None:
         """Discovery record for address="auto" drivers and the CLI (the
@@ -1624,10 +1632,15 @@ class Node:
                     # resource gate holds them) but their spawns starve a
                     # small host.  Blocked workers released their CPUs, so
                     # each one justifies a replacement (nested-get progress).
+                    # count REGISTERED workers only — in-flight boots are
+                    # already in ns.starting, and counting them twice makes
+                    # each one eat two cap slots (stalling env spawns for
+                    # the whole prestart boot window)
                     n_workers = 0
                     blocked = 0
                     for w in self.workers.values():
-                        if (w.node_id == ns.node_id and w.state != "dead"
+                        if (w.node_id == ns.node_id
+                                and w.state not in ("dead", "starting")
                                 and not w.is_actor_worker):
                             n_workers += 1
                             if w.block_depth > 0:

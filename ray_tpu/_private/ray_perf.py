@@ -46,6 +46,19 @@ def main(quick: bool = False) -> List[Dict]:
     min_t = 0.3 if quick else 1.0
     ray_tpu.init(num_cpus=4, num_tpus=0)
     try:
+        # settle: the prestarted worker pool boots concurrently with init;
+        # benching against half-booted interpreters starves them of CPU
+        # and skews every number
+        from ray_tpu._private.worker import global_worker as _gw0
+
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            rows = _gw0.client.request(
+                {"type": "list_state", "what": "workers"}, timeout=10
+            )["value"]
+            if sum(1 for r in rows if r.get("state") in ("idle", "busy")) >= 4:
+                break
+            time.sleep(0.3)
         # -------------------------------------------------- put/get small
         small = b"x" * 1024
 
@@ -102,12 +115,18 @@ def main(quick: bool = False) -> List[Dict]:
 
         timeit("task_round_trip", task_rt, min_time_s=min_t, results=results)
 
-        # pipelined wave (throughput with the pool warm)
+        # pipelined wave (throughput with the pool warm).  Worker boot is
+        # ~2s on a small host while one wave is ~100ms, so ramp the pool
+        # with un-timed waves first — otherwise the window measures 1-3
+        # workers with 2 still booting and underreports ~3x.
         wave = 20 if quick else 100
 
         def task_wave():
             ray_tpu.get([noop.remote() for _ in range(wave)], timeout=120)
 
+        ramp_until = time.perf_counter() + (1.0 if quick else 3.0)
+        while time.perf_counter() < ramp_until:
+            task_wave()
         timeit("task_throughput", task_wave, multiplier=wave,
                min_time_s=min_t, results=results)
 

@@ -621,15 +621,18 @@ def main() -> None:
     from ray_tpu._private import object_transfer
 
     object_transfer.configure(authkey)  # cross-node pulls (SURVEY §3.3)
-    client = CoreClient(address, authkey, worker_id=worker_id, node_id=node_id)
-    client._exec_queue = queue.Queue()
-    w.client = client
+    from multiprocessing import AuthenticationError
+
     try:
+        client = CoreClient(address, authkey, worker_id=worker_id, node_id=node_id)
+        client._exec_queue = queue.Queue()
+        w.client = client
         client.register_worker()
-    except (BrokenPipeError, ConnectionError, OSError, EOFError):
-        # our head died while we were booting (or we're a straggler from a
-        # killed session whose port got reused): exit quietly — a traceback
-        # on the inherited stderr reads like a live-session failure
+    except (OSError, EOFError, AuthenticationError):
+        # our head died while we were booting (connect refused / reset) or
+        # we're a straggler from a killed session whose port got reused
+        # (authkey mismatch): exit quietly — a traceback on the inherited
+        # stderr reads like a live-session failure
         os._exit(0)
 
     # app metrics recorded in this worker flow to the head's /metrics
