@@ -19,6 +19,7 @@ from ray_tpu.serve.api import (
     start,
     status,
 )
+from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from ray_tpu.serve.handle import DeploymentHandle
 from ray_tpu.serve._private.http_util import Request
@@ -28,6 +29,7 @@ __all__ = [
     "Deployment",
     "DeploymentConfig",
     "AutoscalingConfig",
+    "batch",
     "Application",
     "run",
     "start",

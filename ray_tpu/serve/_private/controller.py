@@ -345,6 +345,14 @@ class ServeController:
         goal = state.goal
         tag = f"{state.name}#{uuid.uuid4().hex[:8]}"
         options = dict(goal["config"].ray_actor_options or {})
+        if goal.get("uses_batching"):
+            # @serve.batch replicas execute up to their query cap
+            # concurrently so batches can form; user code still runs on
+            # the single batcher thread.  Plain deployments stay
+            # serialized — unsynchronized state must not start racing.
+            options.setdefault(
+                "max_concurrency", goal["config"].max_concurrent_queries
+            )
         handle = ray_tpu.remote(ServeReplica).options(**options).remote(
             state.name,
             tag,

@@ -36,13 +36,18 @@ class ServeReplica:
             self._is_function = True
         if user_config is not None:
             self.reconfigure(user_config)
+        # itertools.count is GIL-atomic — batched replicas serve requests
+        # from concurrent threads
+        import itertools
+
+        self._request_counter = itertools.count(1)
         self._num_requests = 0
         self._start_time = time.time()
 
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict) -> Any:
         """Run one request (``replica.py:250`` handle_request analog).
         ``method_name='__call__'`` hits the callable itself."""
-        self._num_requests += 1
+        self._num_requests = next(self._request_counter)
         if self._is_function:
             if method_name not in ("__call__", None):
                 raise AttributeError(

@@ -208,6 +208,8 @@ def _deploy_application(
 
     args = tuple(resolve(a) for a in app.args)
     kwargs = {k: resolve(v) for k, v in app.kwargs.items()}
+    from ray_tpu.serve.batching import uses_batching
+
     d = app.deployment
     goal = {
         "serialized_def": cloudpickle.dumps(d._func_or_class),
@@ -215,6 +217,9 @@ def _deploy_application(
         "init_kwargs": kwargs,
         "config": d.config,
         "route_prefix": d.route_prefix,
+        # @serve.batch needs concurrent request threads to form batches;
+        # plain deployments keep serialized execution (no surprise races)
+        "uses_batching": uses_batching(d._func_or_class),
     }
     ray_tpu.get(client.controller.deploy.remote(d.name, goal), timeout=60)
     if deployed_names is not None:

@@ -355,3 +355,65 @@ def test_serve_dashboard_rest(serve_instance):
     assert apps["Ping"]["status"] in ("HEALTHY", "UPDATING")
     assert "autoscaling_metrics" in apps["Ping"]
     serve.delete("Ping")
+
+
+def test_serve_batch_decorator(serve_instance):
+    """@serve.batch: concurrent handle calls coalesce into one model
+    invocation (serve/batching.py analog — the TPU-shaped inference path)."""
+
+    @serve.deployment(max_concurrent_queries=32)
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.05)
+        def __call__(self, requests):
+            self.batch_sizes.append(len(requests))
+            return [r * 2 for r in requests]
+
+        def seen(self):
+            return self.batch_sizes
+
+    serve.run(Batched.bind(), port=0)
+    handle = serve.get_deployment_handle("Batched")
+    refs = [handle.remote(i) for i in range(24)]
+    out = ray_tpu.get(refs, timeout=120)
+    assert out == [i * 2 for i in range(24)]
+    sizes = ray_tpu.get(handle.seen.remote(), timeout=60)
+    assert sum(sizes) == 24
+    assert max(sizes) > 1, f"no batching happened: {sizes}"
+    serve.delete("Batched")
+
+
+def test_serve_batch_function_deployment(serve_instance):
+    """@serve.batch on a function deployment (not just methods)."""
+
+    @serve.deployment(max_concurrent_queries=16)
+    @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.05)
+    def triple(requests):
+        return [r * 3 for r in requests]
+
+    serve.run(triple.bind(), port=0)
+    handle = serve.get_deployment_handle("triple")
+    out = ray_tpu.get([handle.remote(i) for i in range(12)], timeout=120)
+    assert out == [i * 3 for i in range(12)]
+    serve.delete("triple")
+
+
+def test_serve_batch_sustained_load(serve_instance):
+    """Sustained arrivals never starve early callers (batcher-thread
+    design: no leader recursion)."""
+
+    @serve.deployment(max_concurrent_queries=32)
+    class Slowish:
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.01)
+        def __call__(self, requests):
+            time.sleep(0.02)  # service slower than arrivals
+            return [r + 1 for r in requests]
+
+    serve.run(Slowish.bind(), port=0)
+    handle = serve.get_deployment_handle("Slowish")
+    refs = [handle.remote(i) for i in range(60)]
+    out = ray_tpu.get(refs, timeout=180)
+    assert out == [i + 1 for i in range(60)]
+    serve.delete("Slowish")
