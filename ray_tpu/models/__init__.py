@@ -8,12 +8,18 @@ rules in :mod:`ray_tpu.parallel.sharding` apply mechanically.  Families:
   GPT-2 125M, FSDP/TP/SP-shardable, ring attention for long context).
 - :mod:`ray_tpu.models.bert` — bidirectional encoder classifier
   (BASELINE config 5: the Serve replica model).
+- :mod:`ray_tpu.models.llama` — Llama-family decoder (RMSNorm/RoPE/
+  SwiGLU/grouped-query attention; long-context + GQA KV savings).
 - :mod:`ray_tpu.models.mlp` — MNIST-class MLP (BASELINE config 2).
 """
 
-from ray_tpu.models import bert, gpt2, mlp  # noqa: F401
+from ray_tpu.models import bert, gpt2, llama, mlp  # noqa: F401
 from ray_tpu.models.gpt2 import GPT2Config
 from ray_tpu.models.bert import BertConfig
+from ray_tpu.models.llama import LlamaConfig
 from ray_tpu.models.mlp import MLPConfig
 
-__all__ = ["gpt2", "bert", "mlp", "GPT2Config", "BertConfig", "MLPConfig"]
+__all__ = [
+    "gpt2", "bert", "llama", "mlp",
+    "GPT2Config", "BertConfig", "LlamaConfig", "MLPConfig",
+]

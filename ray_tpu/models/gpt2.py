@@ -123,16 +123,9 @@ def make_optimizer(lr: float = 3e-4, weight_decay: float = 0.1,
 def make_train_step(cfg: GPT2Config, optimizer, mesh: Optional[Mesh] = None):
     """Returns train_step(state, batch) -> (state, metrics); jit/pjit-able,
     donate state for in-place updates."""
+    from ray_tpu.models.transformer import make_train_step_from_loss
 
-    def train_step(state, batch):
-        params, opt_state, step = state["params"], state["opt_state"], state["step"]
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        new_state = {"params": params, "opt_state": opt_state, "step": step + 1}
-        return new_state, {"loss": loss, "step": step + 1}
-
-    return train_step
+    return make_train_step_from_loss(loss_fn, cfg, optimizer, mesh)
 
 
 def init_state(cfg: GPT2Config, key: jax.Array, optimizer) -> Dict[str, Any]:

@@ -1,0 +1,200 @@
+"""Llama-family decoder LM: RMSNorm + RoPE + SwiGLU + grouped-query
+attention, pure jax.
+
+Same design rules as :mod:`ray_tpu.models.gpt2` (the reference delegates
+model parallelism to torch; here sharding annotations ARE the
+parallelism): stacked ``[L, ...]`` block params scanned with one remat'd
+body, bf16 compute over f32 master weights, logical axes feeding
+:mod:`ray_tpu.parallel.sharding` (heads/mlp → tp, embed → fsdp, sequence →
+sp ring attention when the mesh has an ``sp`` axis).  GQA shares each KV
+head across ``n_heads // n_kv_heads`` query heads — the standard
+long-context memory saver (KV cache and KV projections shrink by that
+factor).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from ray_tpu.models.gpt2 import make_optimizer  # same AdamW recipe
+from ray_tpu.models.transformer import make_train_step_from_loss
+from ray_tpu.ops.layers import cross_entropy_loss, rmsnorm, rope
+from ray_tpu.parallel.sharding import ShardingRules, logical_to_sharding
+
+__all__ = [
+    "LlamaConfig", "init", "apply", "loss_fn", "make_train_step",
+    "init_state", "num_params", "logical_axes", "param_shardings",
+    "make_optimizer",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32_000
+    n_layers: int = 12
+    n_heads: int = 12
+    n_kv_heads: int = 4
+    d_model: int = 768
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    rope_base: float = 10_000.0
+    rms_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        assert self.n_heads % self.n_kv_heads == 0
+        return self.n_heads // self.n_kv_heads
+
+    @staticmethod
+    def llama_125m(**kw) -> "LlamaConfig":
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        kw.setdefault("remat", False)
+        return LlamaConfig(
+            vocab_size=512, n_layers=2, n_heads=4, n_kv_heads=2,
+            d_model=64, d_ff=128, max_seq_len=128, **kw,
+        )
+
+
+def _dense(key, n_in, n_out, scale=1.0):
+    return jax.random.normal(key, (n_in, n_out)) * scale / jnp.sqrt(n_in)
+
+
+def init(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Stacked block params: every leaf carries a leading [L] axis."""
+    k_emb, k_blocks = jax.random.split(key)
+    L, D, H, KV, hd, F = (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                          cfg.n_kv_heads, cfg.head_dim, cfg.d_ff)
+    ks = jax.random.split(k_blocks, 7)
+
+    def stack(k, *shape, scale=1.0):
+        keys = jax.random.split(k, L)
+        return jnp.stack([_dense(kk, *shape, scale=scale) for kk in keys])
+
+    blocks = {
+        "wq": stack(ks[0], D, H * hd),
+        "wk": stack(ks[1], D, KV * hd),
+        "wv": stack(ks[2], D, KV * hd),
+        "wo": stack(ks[3], H * hd, D, scale=0.02),
+        # SwiGLU: gate + up fused side by side, then down
+        "w_gate": stack(ks[4], D, F),
+        "w_up": stack(ks[5], D, F),
+        "w_down": stack(ks[6], F, D, scale=0.02),
+        "attn_norm": jnp.ones((L, D)),
+        "ffn_norm": jnp.ones((L, D)),
+    }
+    return {
+        "tok_emb": jax.random.normal(k_emb, (cfg.vocab_size, D)) * 0.02,
+        "blocks": blocks,
+        "final_norm": jnp.ones(D),
+    }
+
+
+def logical_axes(cfg: Optional[LlamaConfig] = None) -> Dict[str, Any]:
+    return {
+        "tok_emb": ("vocab", "embed"),
+        "blocks": {
+            "wq": (None, "embed", "heads"),
+            "wk": (None, "embed", "heads"),
+            "wv": (None, "embed", "heads"),
+            "wo": (None, "heads", "embed"),
+            "w_gate": (None, "embed", "mlp"),
+            "w_up": (None, "embed", "mlp"),
+            "w_down": (None, "mlp", "embed"),
+            "attn_norm": (None, "embed"),
+            "ffn_norm": (None, "embed"),
+        },
+        "final_norm": ("embed",),
+    }
+
+
+def param_shardings(mesh: Mesh, rules: ShardingRules, cfg: Optional[LlamaConfig] = None):
+    return logical_to_sharding(logical_axes(cfg), mesh, rules)
+
+
+def _attend_llama(q, k, v, mesh: Optional[Mesh]):
+    """[B, H, T, hd] causal attention; the shared transformer-core seam
+    handles the shard_map-wrapped ring attention when the mesh has sp>1."""
+    from ray_tpu.models.transformer import _attend
+
+    return _attend(q, k, v, causal=True, mesh=mesh)
+
+
+def _block(x, p, cfg: LlamaConfig, mesh: Optional[Mesh], positions):
+    B, T, D = x.shape
+    H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = rmsnorm(x, p["attn_norm"].astype(dt), eps=cfg.rms_eps)
+    q = (h @ p["wq"].astype(dt)).reshape(B, T, H, hd)
+    k = (h @ p["wk"].astype(dt)).reshape(B, T, KV, hd)
+    v = (h @ p["wv"].astype(dt)).reshape(B, T, KV, hd)
+    q = rope(q.transpose(0, 2, 1, 3), positions, base=cfg.rope_base)  # [B,H,T,hd]
+    k = rope(k.transpose(0, 2, 1, 3), positions, base=cfg.rope_base)  # [B,KV,T,hd]
+    v = v.transpose(0, 2, 1, 3)
+    # GQA: each KV head serves q_per_kv query heads
+    if KV != H:
+        k = jnp.repeat(k, cfg.q_per_kv, axis=1)
+        v = jnp.repeat(v, cfg.q_per_kv, axis=1)
+    o = _attend_llama(q, k, v, mesh)  # [B, H, T, hd]
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
+    x = x + o @ p["wo"].astype(dt)
+
+    h = rmsnorm(x, p["ffn_norm"].astype(dt), eps=cfg.rms_eps)
+    gated = jax.nn.silu(h @ p["w_gate"].astype(dt)) * (h @ p["w_up"].astype(dt))
+    return x + gated @ p["w_down"].astype(dt)
+
+
+def apply(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
+          mesh: Optional[Mesh] = None) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, V] f32 (tied embeddings)."""
+    B, T = tokens.shape
+    x = params["tok_emb"][tokens].astype(cfg.dtype)
+    positions = jnp.arange(T)
+
+    def body(h, layer_params):
+        return _block(h, layer_params, cfg, mesh, positions), None
+
+    if cfg.remat:
+        body = jax.checkpoint(body)
+    x, _ = jax.lax.scan(body, x, params["blocks"])
+    x = rmsnorm(x, params["final_norm"].astype(cfg.dtype), eps=cfg.rms_eps)
+    return (x @ params["tok_emb"].T.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
+    if "tokens" in batch:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    else:
+        inputs, targets = batch["inputs"], batch["targets"]
+    return cross_entropy_loss(apply(params, inputs, cfg, mesh), targets)
+
+
+def make_train_step(cfg: LlamaConfig, optimizer, mesh: Optional[Mesh] = None):
+    return make_train_step_from_loss(loss_fn, cfg, optimizer, mesh)
+
+
+def init_state(cfg: LlamaConfig, key: jax.Array, optimizer) -> Dict[str, Any]:
+    params = init(cfg, key)
+    return {"params": params, "opt_state": optimizer.init(params),
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def num_params(params: Dict[str, Any]) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))

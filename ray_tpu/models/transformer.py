@@ -116,6 +116,23 @@ def block_logical_axes(n_experts: int = 0) -> Dict[str, Tuple]:
     return axes
 
 
+def make_train_step_from_loss(loss_fn, cfg, optimizer, mesh: Optional[Mesh] = None):
+    """Shared train-step recipe for every model family: value_and_grad of
+    ``loss_fn(params, batch, cfg, mesh)`` + optimizer update.  One place to
+    fix donation/metrics for all models."""
+    import optax
+
+    def train_step(state, batch):
+        params, opt_state, step = state["params"], state["opt_state"], state["step"]
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch, cfg, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return ({"params": params, "opt_state": opt_state, "step": step + 1},
+                {"loss": loss, "step": step + 1})
+
+    return train_step
+
+
 def _attend(q, k, v, *, causal: bool, mesh: Optional[Mesh]) -> jax.Array:
     """Pick the sequence-parallel path when the mesh has an sp axis."""
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:

@@ -47,7 +47,8 @@ class ServeReplica:
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict) -> Any:
         """Run one request (``replica.py:250`` handle_request analog).
         ``method_name='__call__'`` hits the callable itself."""
-        self._num_requests = next(self._request_counter)
+        # max(): a preempted thread's stale write must not regress the stat
+        self._num_requests = max(self._num_requests, next(self._request_counter))
         if self._is_function:
             if method_name not in ("__call__", None):
                 raise AttributeError(

@@ -29,13 +29,14 @@ BATCH_ATTR = "_ray_tpu_serve_batch"
 
 
 class _Slot:
-    __slots__ = ("item", "event", "result", "error")
+    __slots__ = ("item", "event", "result", "error", "enqueued_at")
 
     def __init__(self, item):
         self.item = item
         self.event = threading.Event()
         self.result = None
         self.error: Optional[BaseException] = None
+        self.enqueued_at = time.monotonic()
 
 
 class _Batcher:
@@ -50,13 +51,20 @@ class _Batcher:
         self._lock = threading.Lock()
         self._nonempty = threading.Condition(self._lock)
         self._queue: List[_Slot] = []
-        threading.Thread(
-            target=self._loop, daemon=True, name="serve-batcher"
-        ).start()
+        self._thread_started = False
 
     def submit(self, item):
         slot = _Slot(item)
         with self._nonempty:
+            if not self._thread_started:
+                # lazily here, not in __init__: racing first callers may
+                # each construct a _Batcher and only setdefault's winner
+                # survives — an eagerly-started loser thread would park on
+                # its empty queue forever
+                self._thread_started = True
+                threading.Thread(
+                    target=self._loop, daemon=True, name="serve-batcher"
+                ).start()
             self._queue.append(slot)
             self._nonempty.notify()
         slot.event.wait()
@@ -69,9 +77,11 @@ class _Batcher:
             with self._nonempty:
                 while not self._queue:
                     self._nonempty.wait()
-                # batch window opens at the first queued item; predicate
-                # loop guards against spurious wakeups forming tiny batches
-                deadline = time.monotonic() + self._timeout
+                # the batch window opens when the OLDEST item was enqueued
+                # (items that aged while the previous batch executed don't
+                # pay a fresh full wait); predicate loop guards against
+                # spurious wakeups forming tiny batches
+                deadline = self._queue[0].enqueued_at + self._timeout
                 while len(self._queue) < self._max:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -103,9 +113,10 @@ def uses_batching(func_or_class) -> bool:
     if getattr(func_or_class, BATCH_ATTR, False):
         return True
     if isinstance(func_or_class, type):
+        # dir() walks the MRO — inherited @serve.batch methods count too
         return any(
-            getattr(m, BATCH_ATTR, False)
-            for m in vars(func_or_class).values()
+            getattr(getattr(func_or_class, name, None), BATCH_ATTR, False)
+            for name in dir(func_or_class)
         )
     return False
 
