@@ -15,12 +15,10 @@ factor).
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax.sharding import Mesh
 
 from ray_tpu.models.gpt2 import make_optimizer  # same AdamW recipe
@@ -48,6 +46,10 @@ class LlamaConfig:
     rms_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "dots" saves matmul outputs and recomputes elementwise (measured
+    # +3-6% over full remat at these shapes on v5e — same policy the
+    # shared transformer core uses); "full" recomputes everything
+    remat_policy: str = "dots"
 
     @property
     def head_dim(self) -> int:
@@ -128,14 +130,6 @@ def param_shardings(mesh: Mesh, rules: ShardingRules, cfg: Optional[LlamaConfig]
     return logical_to_sharding(logical_axes(cfg), mesh, rules)
 
 
-def _attend_llama(q, k, v, mesh: Optional[Mesh]):
-    """[B, H, T, hd] causal attention; the shared transformer-core seam
-    handles the shard_map-wrapped ring attention when the mesh has sp>1."""
-    from ray_tpu.models.transformer import _attend
-
-    return _attend(q, k, v, causal=True, mesh=mesh)
-
-
 def _block(x, p, cfg: LlamaConfig, mesh: Optional[Mesh], positions):
     B, T, D = x.shape
     H, KV, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -152,7 +146,11 @@ def _block(x, p, cfg: LlamaConfig, mesh: Optional[Mesh], positions):
     if KV != H:
         k = jnp.repeat(k, cfg.q_per_kv, axis=1)
         v = jnp.repeat(v, cfg.q_per_kv, axis=1)
-    o = _attend_llama(q, k, v, mesh)  # [B, H, T, hd]
+    # the shared transformer-core seam shard_maps ring attention when the
+    # mesh has sp > 1
+    from ray_tpu.models.transformer import _attend
+
+    o = _attend(q, k, v, causal=True, mesh=mesh)  # [B, H, T, hd]
     o = o.transpose(0, 2, 1, 3).reshape(B, T, H * hd)
     x = x + o @ p["wo"].astype(dt)
 
@@ -172,7 +170,13 @@ def apply(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
         return _block(h, layer_params, cfg, mesh, positions), None
 
     if cfg.remat:
-        body = jax.checkpoint(body)
+        if cfg.remat_policy == "dots":
+            body = jax.checkpoint(
+                body,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        else:
+            body = jax.checkpoint(body)
     x, _ = jax.lax.scan(body, x, params["blocks"])
     x = rmsnorm(x, params["final_norm"].astype(cfg.dtype), eps=cfg.rms_eps)
     return (x @ params["tok_emb"].T.astype(cfg.dtype)).astype(jnp.float32)

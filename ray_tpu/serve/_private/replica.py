@@ -36,19 +36,20 @@ class ServeReplica:
             self._is_function = True
         if user_config is not None:
             self.reconfigure(user_config)
-        # itertools.count is GIL-atomic — batched replicas serve requests
-        # from concurrent threads
-        import itertools
+        # lock-guarded: batched replicas serve requests from concurrent
+        # threads, and a bare += (or a max() read-modify-write) can lose or
+        # regress counts under preemption
+        import threading
 
-        self._request_counter = itertools.count(1)
+        self._stats_lock = threading.Lock()
         self._num_requests = 0
         self._start_time = time.time()
 
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict) -> Any:
         """Run one request (``replica.py:250`` handle_request analog).
         ``method_name='__call__'`` hits the callable itself."""
-        # max(): a preempted thread's stale write must not regress the stat
-        self._num_requests = max(self._num_requests, next(self._request_counter))
+        with self._stats_lock:
+            self._num_requests += 1
         if self._is_function:
             if method_name not in ("__call__", None):
                 raise AttributeError(
