@@ -175,8 +175,12 @@ def apply(params: Dict[str, Any], tokens: jax.Array, cfg: LlamaConfig,
                 body,
                 policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             )
-        else:
+        elif cfg.remat_policy == "full":
             body = jax.checkpoint(body)
+        else:
+            raise ValueError(
+                f"unknown remat_policy {cfg.remat_policy!r} (use 'dots' or 'full')"
+            )
     x, _ = jax.lax.scan(body, x, params["blocks"])
     x = rmsnorm(x, params["final_norm"].astype(cfg.dtype), eps=cfg.rms_eps)
     return (x @ params["tok_emb"].T.astype(cfg.dtype)).astype(jnp.float32)

@@ -120,11 +120,15 @@ def test_oom_killer_picks_newest_retriable(ray_start_regular):
 
     marker = f"/tmp/rtpu_oom_{time.time()}"
     ref = retriable.remote(marker)
-    # wait until it's running
+    # wait until the task BODY has run past the marker write — killing at
+    # dispatch time (head.running is set then) would burn the one synthetic
+    # reading before the retry could ever observe the marker
+    import os
+
     deadline = time.monotonic() + 60
-    while time.monotonic() < deadline and not head.running:
+    while time.monotonic() < deadline and not os.path.exists(marker):
         time.sleep(0.1)
-    assert head.running
+    assert os.path.exists(marker) and head.running
 
     # one synthetic over-threshold reading; the iterator-with-default means
     # the background monitor thread racing us can consume it at most once

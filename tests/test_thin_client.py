@@ -75,3 +75,58 @@ def test_thin_client_end_to_end(ray_start_regular):
     assert "THIN_CLIENT_OK" in proc.stdout, (
         f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-3000:]}"
     )
+
+
+REMOTE_OBJ_SCRIPT = textwrap.dedent("""
+    import os
+    import numpy as np
+    import ray_tpu
+
+    os.environ["RAY_TPU_SESSION"] = "thin-client-isolated-2"
+    ray_tpu.init(address=os.environ["THIN_ADDR"],
+                 _authkey=bytes.fromhex(os.environ["THIN_KEY"]))
+    from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+    @ray_tpu.remote(scheduling_strategy=NodeAffinitySchedulingStrategy(
+        os.environ["TARGET_NODE"]))
+    def produce():
+        return np.arange(200_000, dtype=np.float32)
+
+    # the object lives on node B's private shm; the head must pull it
+    # before shipping the payload bytes to this thin client
+    out = ray_tpu.get(produce.remote(), timeout=180)
+    assert out.shape == (200_000,) and out[-1] == 199_999.0
+    print("THIN_REMOTE_OK")
+""")
+
+
+def test_thin_client_remote_node_object():
+    """Thin-client get of an object produced on a real second node: head
+    pulls the payload cross-node, then ships bytes over the socket."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu._private.worker import global_worker
+
+    cluster = Cluster(
+        initialize_head=True,
+        head_node_args={"num_cpus": 2, "num_tpus": 0},
+        real_processes=True,
+    )
+    try:
+        node_b = cluster.add_node(num_cpus=2)
+        node = global_worker.node
+        host, port = node.tcp_address
+        env = dict(os.environ)
+        env["THIN_ADDR"] = f"client://{host}:{port}"
+        env["THIN_KEY"] = node.authkey.hex()
+        env["TARGET_NODE"] = node_b
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, "-c", REMOTE_OBJ_SCRIPT],
+            capture_output=True, text=True, timeout=420, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert "THIN_REMOTE_OK" in proc.stdout, (
+            f"stdout:\n{proc.stdout[-2000:]}\nstderr:\n{proc.stderr[-3000:]}"
+        )
+    finally:
+        cluster.shutdown()
