@@ -12,6 +12,7 @@ Reference: ``python/ray/scripts/scripts.py`` (cluster lifecycle) and
     submit -- <entrypoint...>                  submit a job
     job-logs <job_id> / job-stop <job_id>
     timeline [--out FILE]                      chrome-trace of task events
+    serve-status                               serve deployments + autoscaling
 """
 
 from __future__ import annotations
@@ -155,6 +156,29 @@ def cmd_timeline(args) -> None:
     print(f"wrote chrome trace to {path} (open in chrome://tracing)")
 
 
+def cmd_serve_status(_args) -> None:
+    """``serve status`` analog over the running cluster."""
+    rt = _connect()
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+    try:
+        controller = rt.get_actor(CONTROLLER_NAME)
+    except Exception:
+        print(json.dumps({}))  # serve not running
+        return
+    status = rt.get(controller.get_status.remote(), timeout=30)
+    # submit all metric fetches, one shared deadline (dashboard._serve_status
+    # shape — a slow controller costs one timeout, not one per deployment)
+    refs = {n: controller.get_autoscaling_metrics.remote(n) for n in status}
+    try:
+        metrics = rt.get(list(refs.values()), timeout=10)
+        for (name, _), m in zip(refs.items(), metrics):
+            status[name]["autoscaling_metrics"] = m
+    except Exception as e:  # noqa: BLE001
+        status["_autoscaling_metrics_error"] = f"{type(e).__name__}: {e}"
+    print(json.dumps(status, indent=2, default=repr))
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(prog="ray_tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -194,6 +218,10 @@ def main(argv=None) -> None:
     s = sub.add_parser("timeline", help="dump chrome-trace task timeline")
     s.add_argument("--out", default=None)
     s.set_defaults(fn=cmd_timeline)
+
+    sub.add_parser(
+        "serve-status", help="serve deployments + autoscaling state"
+    ).set_defaults(fn=cmd_serve_status)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -417,3 +417,24 @@ def test_serve_batch_sustained_load(serve_instance):
     out = ray_tpu.get(refs, timeout=180)
     assert out == [i + 1 for i in range(60)]
     serve.delete("Slowish")
+
+
+def test_serve_status_cli(serve_instance):
+    """`python -m ray_tpu serve-status` against the running instance."""
+    import io
+    from contextlib import redirect_stdout
+
+    from ray_tpu.scripts import cli
+
+    @serve.deployment
+    class Up:
+        def __call__(self, request=None):
+            return "up"
+
+    serve.run(Up.bind(), port=0)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        cli.cmd_serve_status(None)
+    out = json.loads(buf.getvalue())
+    assert out["Up"]["status"] in ("HEALTHY", "UPDATING")
+    serve.delete("Up")
