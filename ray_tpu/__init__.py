@@ -197,6 +197,22 @@ def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
     global_worker.client.kill_actor(actor._actor_id, no_restart=no_restart)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    """Cancel the task that produces ``ref`` (reference
+    ``python/ray/_private/worker.py:2573``).
+
+    Queued tasks are dequeued; running tasks get a KeyboardInterrupt
+    (``force=True`` SIGKILLs the worker instead — not allowed for actor
+    tasks); finished tasks are untouched.  ``recursive`` also cancels
+    tasks the cancelled task submitted.  Cancelled returns raise
+    :class:`ray_tpu.exceptions.TaskCancelledError` on ``get``."""
+    _ensure_connected()
+    if not isinstance(ref, ObjectRef):
+        raise TypeError(f"cancel() expects an ObjectRef, got {type(ref)}")
+    global_worker.client.cancel_task(ref.binary(), force=force,
+                                     recursive=recursive)
+
+
 def cluster_resources() -> Dict[str, float]:
     _ensure_connected()
     snap = global_worker.client.state_snapshot()

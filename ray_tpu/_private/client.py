@@ -43,7 +43,21 @@ class CoreClient:
         self._req_ids = itertools.count(1)
         self._pending: Dict[int, dict] = {}
         self._pending_lock = threading.Lock()
+        # Submit coalescing: task/actor-task submissions buffer here and
+        # ride one "submit_batch" message (the pipelined-pushes idea of the
+        # reference's direct submitters, direct_actor_task_submitter.h:67,
+        # applied to the wire).  Every other send flushes first, so
+        # cross-message ordering on this connection is preserved; a 1 ms
+        # side flusher bounds the latency of fire-and-forget submits.
+        self._submit_buf: List[tuple] = []
+        self._submit_lock = threading.Lock()
+        self._flush_event = threading.Event()
+        self._flush_thread: Optional[threading.Thread] = None
         self._exec_queue: "queue.Queue[dict]" = None  # set by worker loop
+        # worker-side cancellation hook: runs ON the recv thread so a
+        # cancel can interrupt the main thread mid-task (the exec queue
+        # would only deliver it after the task finished)
+        self._cancel_handler = None
         self._subscriptions: Dict[str, list] = {}  # channel -> callbacks
         self._pubsub_queue = None  # created on first subscribe
         self._pubsub_lock = threading.Lock()
@@ -56,7 +70,56 @@ class CoreClient:
     # -- plumbing ----------------------------------------------------------
     def send(self, msg: dict) -> None:
         with self.send_lock:
+            if self._submit_buf:
+                self._flush_submits_locked()
             self.conn.send(msg)
+
+    _SUBMIT_FLUSH_THRESHOLD = 32
+
+    def _buffer_submit(self, kind: str, spec: dict) -> None:
+        with self._submit_lock:
+            self._submit_buf.append((kind, spec))
+            n = len(self._submit_buf)
+        if n >= self._SUBMIT_FLUSH_THRESHOLD:
+            self.flush_submits()
+        elif n == 1:
+            # arm the deferred flush only on the empty->nonempty transition;
+            # re-setting per submit made the flusher spin at 1 kHz
+            if self._flush_thread is None:
+                with self._submit_lock:  # two transitions racing must not
+                    if self._flush_thread is None:  # start two flushers
+                        self._flush_thread = threading.Thread(
+                            target=self._flush_loop, daemon=True,
+                            name="submit-flush")
+                        self._flush_thread.start()
+            self._flush_event.set()
+
+    def flush_submits(self) -> None:
+        with self.send_lock:
+            if self._submit_buf:
+                self._flush_submits_locked()
+
+    def _flush_submits_locked(self) -> None:
+        """send_lock held.  Lock order is always send_lock -> _submit_lock."""
+        with self._submit_lock:
+            batch, self._submit_buf = self._submit_buf, []
+        if batch:
+            try:
+                self.conn.send({"type": "submit_batch", "batch": batch})
+            except (OSError, ValueError):
+                pass  # connection gone; recv loop surfaces it
+
+    def _flush_loop(self) -> None:
+        while not self.closed:
+            self._flush_event.wait()
+            time.sleep(0.001)
+            self._flush_event.clear()
+            if not self._submit_buf:
+                continue  # threshold flush already drained it
+            try:
+                self.flush_submits()
+            except Exception:
+                pass
 
     def _recv_loop(self) -> None:
         while not self.closed:
@@ -83,6 +146,11 @@ class CoreClient:
                 # a request must not block the only thread that can ever
                 # deliver that request's reply
                 self._pubsub_dispatch(msg)
+            elif msg.get("type") == "cancel" and self._cancel_handler is not None:
+                try:
+                    self._cancel_handler(msg)
+                except Exception:
+                    pass
             elif self._exec_queue is not None:
                 self._exec_queue.put(msg)
 
@@ -163,16 +231,24 @@ class CoreClient:
         })
 
     def submit_task(self, spec: dict) -> None:
-        self.send({"type": "submit_task", "spec": spec})
+        self._buffer_submit("task", spec)
 
     def create_actor(self, spec: dict) -> None:
         self.send({"type": "create_actor", "spec": spec})
 
     def submit_actor_task(self, spec: dict) -> None:
-        self.send({"type": "submit_actor_task", "spec": spec})
+        self._buffer_submit("actor_task", spec)
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
         self.send({"type": "kill_actor", "actor_id": actor_id, "no_restart": no_restart})
+
+    def cancel_task(self, oid: bytes, force: bool = False,
+                    recursive: bool = True) -> None:
+        reply = self.request({"type": "cancel_task", "oid": oid,
+                              "force": force, "recursive": recursive})
+        err = reply.get("value")
+        if err:
+            raise ValueError(err)
 
     def seal(self, oid: bytes, loc: ObjectLocation, contained: List[bytes]) -> None:
         self.send({"type": "seal", "oid": oid, "loc": loc, "contained": contained})
@@ -225,7 +301,12 @@ class CoreClient:
         return self.request({"type": "state_snapshot"})["value"]
 
     def close(self) -> None:
+        try:
+            self.flush_submits()
+        except Exception:
+            pass
         self.closed = True
+        self._flush_event.set()  # let the flusher thread exit
         if self._pubsub_queue is not None:
             self._pubsub_queue.put(None)  # end the dispatcher thread
         try:

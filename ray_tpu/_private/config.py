@@ -59,6 +59,17 @@ class Config:
     num_prestart_workers: int = -1
     # Seconds an idle worker is kept before being reaped.
     idle_worker_killing_time_threshold_s: float = 300.0
+    # Extra actor method calls pushed to a worker beyond max_concurrency so
+    # its local queue is never empty between completions (the reference's
+    # pipelined actor submitter window, direct_actor_task_submitter.h:67).
+    # On a small host this converts one context switch per call into one
+    # per burst.
+    actor_pipeline_depth: int = 8
+    # Same idea for plain tasks: follow-on tasks with an identical resource
+    # shape ride to a busy worker's local queue ahead of completion (the
+    # reference's worker-lease reuse, direct_task_transport.cc:174); they
+    # hold no resources until promoted at the predecessor's completion.
+    task_pipeline_depth: int = 8
     # Agent liveness probing (GcsHealthCheckManager analog): ping period
     # and the silence window after which a node is declared dead.
     health_check_period_s: float = 2.0

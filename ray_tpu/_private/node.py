@@ -110,7 +110,7 @@ def _release(req: Dict[str, float], avail: Dict[str, float]) -> None:
         avail[k] = avail.get(k, 0.0) + v
 
 
-@dataclass
+@dataclass(eq=False)  # identity semantics: handles live in sets/lists
 class WorkerHandle:
     worker_id: bytes
     node_id: str
@@ -127,6 +127,13 @@ class WorkerHandle:
     runtime_env_key: Optional[str] = None
     # wall time this worker last became idle (idle-pool reaping)
     idle_since: float = 0.0
+    # same-shape tasks sent ahead of completion (lease-reuse pipelining);
+    # they hold no resources until promoted in _on_task_done
+    pipeline: deque = field(default_factory=deque)
+    # messages queued under the node lock, written to the pipe outside it
+    # by Node._flush_sends — pickling+write syscalls must not extend lock
+    # hold times (they were the head's main source of lock contention)
+    outbox: deque = field(default_factory=deque)
 
     def send(self, msg: dict) -> None:
         with self.send_lock:
@@ -349,6 +356,18 @@ class Node:
         self._req_counter = 0
         self._shutdown = False
         self._head_node_id: str
+        # Scheduler wakeup coalescing: N notifications during one pass
+        # collapse into a single follow-up pass (the flag survives the
+        # notify, so a wake that lands mid-pass is never lost).  The loop
+        # also self-polls every 0.2s, so a missed wake costs bounded
+        # latency, never a hang.
+        self._sched_work = False
+        # actors whose next queued method is dep-blocked; a seal retries
+        # them inline instead of waking the scheduler (direct actor
+        # dispatch stays off the scheduler thread)
+        self._dep_blocked_actors: set = set()
+        # workers with queued outbox messages awaiting a flush
+        self._outbox_pending: set = set()
 
         total, tpus = autodetect_resources(num_cpus, num_tpus, resources)
         self._head_node_id = "node-head"
@@ -471,7 +490,7 @@ class Node:
             )
             self.nodes[node_id] = ns
             self.gcs.nodes[node_id] = NodeInfo(node_id=node_id, resources=dict(total))
-            self.cond.notify_all()
+            self._wake_scheduler()
 
     def remove_node_state(self, node_id: str) -> None:
         """Simulate node death (Cluster.remove_node / chaos NodeKiller analog)."""
@@ -505,7 +524,7 @@ class Node:
         self.publish("node_change", {"node_id": node_id, "alive": False})
         self._reconstruct_lost_objects(node_id)
         with self.lock:
-            self.cond.notify_all()
+            self._wake_scheduler()
 
     def _reconstruct_lost_objects(self, node_id: str) -> None:
         """Lineage reconstruction (ObjectRecoveryManager +
@@ -667,7 +686,7 @@ class Node:
             ns.agent_conn = conn
             ns.agent_send_lock = self._conn_lock(conn)
             ns.fetch_addr = tuple(msg["fetch_addr"]) if msg.get("fetch_addr") else None
-            self.cond.notify_all()
+            self._wake_scheduler()
         logger.info("node %s joined with %s", node_id, msg["resources"])
         self.publish("node_change", {"node_id": node_id, "alive": True,
                                      "resources": msg["resources"]})
@@ -688,6 +707,58 @@ class Node:
     def _conn_lock(self, conn: Connection) -> threading.Lock:
         with self.lock:
             return self._conn_locks.setdefault(id(conn), threading.Lock())
+
+    # execute-message spec subset: everything the worker's executor reads
+    # (ray_tpu/_private/worker.py _execute_task/_seal_and_report); head-only
+    # bookkeeping fields (pins, retries, placement) stay off the wire
+    _EXEC_KEYS = (
+        "task_id", "name", "fn_id", "args_blob", "args_oid",
+        "is_actor_creation", "actor_id", "method_name",
+        "num_returns", "return_ids", "trace_ctx",
+    )
+
+    def _queue_execute(self, w: WorkerHandle, spec: dict,
+                       dep_locs: Dict[bytes, ObjectLocation],
+                       tpu_ids: List[int]) -> None:
+        """Queue an execute message for ``w`` (node lock held).  The actual
+        pipe write happens in _flush_sends, outside the lock; per-worker
+        FIFO order is the outbox append order, which the lock serializes."""
+        spec_wire = {k: spec[k] for k in self._EXEC_KEYS
+                     if spec.get(k) is not None}
+        msg = {"type": "execute", "spec": spec_wire}
+        if dep_locs:
+            msg["dep_locs"] = dep_locs
+        if tpu_ids:
+            msg["tpu_ids"] = tpu_ids
+        w.outbox.append(msg)
+        self._outbox_pending.add(w)
+
+    def _flush_sends(self) -> None:
+        """Drain queued worker messages outside the node lock.  Safe to call
+        from any thread; concurrent flushers serialize per worker on its
+        send_lock, and deque append/popleft are GIL-atomic, so per-worker
+        order is preserved.  Send failures surface as worker death."""
+        with self.lock:
+            if not self._outbox_pending:
+                return
+            pending = list(self._outbox_pending)
+            self._outbox_pending.clear()
+        dead: List[WorkerHandle] = []
+        for w in pending:
+            with w.send_lock:
+                while w.outbox:
+                    try:
+                        msg = w.outbox.popleft()
+                    except IndexError:
+                        break
+                    try:
+                        w.conn.send(msg)
+                    except (OSError, ValueError, AttributeError):
+                        w.outbox.clear()
+                        dead.append(w)
+                        break
+        for w in dead:
+            self._on_worker_death(w, reason="send failed")
 
     def _reply(self, conn: Connection, msg: dict) -> None:
         try:
@@ -713,6 +784,7 @@ class Node:
             value = {"error": f"put failed: {type(e).__name__}: {e}"}
         self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
                            "value": value})
+        self._flush_sends()  # the seal may have unblocked actor dispatches
 
     def _on_get_blob(self, conn: Connection, msg: dict) -> None:
         """Ship an object's serialized payload to a thin client."""
@@ -745,6 +817,13 @@ class Node:
         mtype = msg["type"]
         if mtype == "submit_task":
             self.submit_task(msg["spec"])
+        elif mtype == "submit_batch":
+            # coalesced submissions from one client, in submission order
+            for kind, spec in msg["batch"]:
+                if kind == "task":
+                    self.submit_task(spec)
+                else:
+                    self.submit_actor_task(spec)
         elif mtype == "seal":
             self.seal_object(msg["oid"], msg["loc"], msg.get("contained", []),
                              sealer=worker)
@@ -753,6 +832,10 @@ class Node:
         elif mtype == "wait":
             self._on_wait_request(conn, msg, worker)
         elif mtype == "task_done":
+            # returns travel inside the done message (one send per task);
+            # seal them first so dependents and parked gets wake in order
+            for oid, loc, contained in msg.get("seals", ()):
+                self.seal_object(oid, loc, contained, sealer=worker)
             self._on_task_done(worker, msg)
         elif mtype == "create_actor":
             self.create_actor(msg["spec"])
@@ -760,6 +843,15 @@ class Node:
             self.submit_actor_task(msg["spec"])
         elif mtype == "kill_actor":
             self.kill_actor(msg["actor_id"], no_restart=msg.get("no_restart", True))
+        elif mtype == "cancel_task":
+            try:
+                self.cancel_task(msg["oid"], force=msg.get("force", False),
+                                 recursive=msg.get("recursive", True))
+                err = None
+            except ValueError as e:
+                err = str(e)
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": err})
         elif mtype == "kv_put":
             self.gcs.kv_put(msg["ns"], msg["key"], msg["value"])
         elif mtype == "kv_get":
@@ -839,6 +931,9 @@ class Node:
             logging_utils.emit_worker_log(msg)
         else:
             logger.warning("unknown message type %s", mtype)
+        # write out any execute messages this message's handling queued
+        # (dispatches happen under the node lock; pipe writes here, outside)
+        self._flush_sends()
 
     # ------------------------------------------------------------------
     # workers
@@ -953,7 +1048,7 @@ class Node:
                     ns.spawn_failures.pop(k, None)  # a successful boot resets
                     h.idle_since = time.time()
                     ns.idle.append(h)
-            self.cond.notify_all()
+            self._wake_scheduler()
         return h
 
     def _on_worker_death(self, h: WorkerHandle, reason: str) -> None:
@@ -979,27 +1074,38 @@ class Node:
                     ns.spawn_failures[k] = ns.spawn_failures.get(k, 0) + 1
             spec = h.current_task
             h.current_task = None
+            pipelined = list(h.pipeline)
+            h.pipeline.clear()
         if self._shutdown:
             return
         if h.actor_id is not None:
             self._on_actor_worker_death(h, reason)
-        elif spec is not None:
-            tid = spec["task_id"]
-            with self.lock:
-                rt = self.running.pop(tid, None)
-            if rt is not None:
-                self._release_task_resources(rt)
-            if spec.get("retries_left", 0) > 0:
-                spec["retries_left"] -= 1
-                logger.warning("task %s failed (%s); retrying", spec.get("name"), reason)
-                self.submit_task(spec, _resubmit=True)
-            else:
-                err = WorkerCrashedError(
-                    f"Worker died while running task {spec.get('name')}: {reason}"
-                )
-                self._seal_error_returns(spec, err)
+        elif spec is not None or pipelined:
+            if spec is not None:
+                tid = spec["task_id"]
+                with self.lock:
+                    rt = self.running.pop(tid, None)
+                if rt is not None:
+                    self._release_task_resources(rt)
+            if spec is not None:
+                if spec.get("retries_left", 0) > 0:
+                    spec["retries_left"] -= 1
+                    logger.warning("task %s failed (%s); retrying", spec.get("name"), reason)
+                    self.submit_task(spec, _resubmit=True)
+                else:
+                    err = WorkerCrashedError(
+                        f"Worker died while running task {spec.get('name')}: {reason}"
+                    )
+                    self._seal_error_returns(spec, err)
+            # pipelined specs never started executing (only the promoted
+            # task runs): resubmit them WITHOUT spending a retry, the way
+            # the reference requeues leased-but-unpushed tasks — otherwise
+            # one worker kill burns up to pipeline_depth+1 retry budgets
+            for s in pipelined:
+                self.submit_task(s, _resubmit=True)
         with self.lock:
-            self.cond.notify_all()
+            self._wake_scheduler()
+        self._flush_sends()  # resubmits may have queued execute messages
 
     def _on_blocked(self, h: Optional[WorkerHandle], blocked: bool) -> None:
         """Release a blocked worker's CPUs so dependents can run — the
@@ -1040,7 +1146,7 @@ class Node:
                 _release(cpus, ns.available)
             else:
                 _acquire(cpus, ns.available)
-            self.cond.notify_all()
+            self._wake_scheduler()
 
     # ------------------------------------------------------------------
     # objects
@@ -1070,7 +1176,18 @@ class Node:
         self.registry.seal(oid, loc, contained)
         self._notify_sealed(oid)
         with self.lock:
-            self.cond.notify_all()
+            # retry dep-blocked actor queues inline (the seal may be the
+            # missing dependency); wake the scheduler only when something
+            # it owns can actually make progress — a blanket notify here
+            # was one scheduler pass per sealed object under load
+            if self._dep_blocked_actors:
+                for aid in list(self._dep_blocked_actors):
+                    self._dep_blocked_actors.discard(aid)
+                    art = self.actors.get(aid)
+                    if art is not None:
+                        self._dispatch_actor_next_locked(art)
+            if self.pending_tasks or self.pending_pgs:
+                self._wake_scheduler()
 
     def _release_spec_pins(self, spec: dict) -> None:
         """Release a task spec's argument pins (idempotent — pops the
@@ -1278,7 +1395,55 @@ class Node:
                     if track:
                         self.lineage[oid] = spec
             self.pending_tasks.append(spec)
-            self.cond.notify_all()
+            # inline dispatch on the submitting thread (idle worker or a
+            # same-shape lease) skips the scheduler hop for the hot path;
+            # anything it can't place falls back to a scheduler pass
+            if not self._try_inline_dispatch():
+                self._wake_scheduler()
+
+    def _try_inline_dispatch(self) -> bool:
+        """Dispatch the pending-queue head inline if a worker can take it
+        now (lock held).  Returns True when the head moved — plain
+        strategy-free CPU specs only, FIFO order preserved because only
+        the head is ever considered."""
+        spec = self.pending_tasks[0] if self.pending_tasks else None
+        if spec is None:
+            return True
+        req = spec.get("resources", {})
+        if (
+            spec.get("scheduling_strategy") is not None
+            or req.get(TPU, 0)
+            or not self._deps_ready(spec)
+        ):
+            return False
+        key = _runtime_env_key(spec.get("runtime_env"))
+        for ns in self.nodes.values():
+            if not ns.alive:
+                continue
+            w = next((c for c in ns.idle if c.runtime_env_key == key), None)
+            if w is not None and _fits(req, ns.available):
+                self.pending_tasks.popleft()
+                _acquire(req, ns.available)
+                ns.idle.remove(w)
+                self._dispatch(ns, w, spec, [], None)
+                self._pipeline_topup(ns, w)
+                return True
+        # no idle worker: try riding an existing same-shape lease
+        for w2 in self.workers.values():
+            if (
+                w2.state == "busy"
+                and not w2.is_actor_worker
+                and w2.current_task is not None
+                and len(w2.pipeline) < self.cfg.task_pipeline_depth
+            ):
+                ns2 = self.nodes.get(w2.node_id)
+                if ns2 is None or not ns2.alive:
+                    continue
+                before = len(self.pending_tasks)
+                self._pipeline_topup(ns2, w2)
+                if len(self.pending_tasks) < before:
+                    return True
+        return False
 
     def _on_object_deleted(self, oid: bytes) -> None:
         """Registry delete hook: drop the object's lineage entry and, when
@@ -1363,13 +1528,32 @@ class Node:
             best = min(avail, key=lambda n: n.utilization())
         return best, None
 
+    def _wake_scheduler(self) -> None:
+        """Mark scheduler work and wake the loop (lock must be held).  The
+        loop clears the flag before each pass, so skipping the notify while
+        it is still set can never lose a wake — it just coalesces them."""
+        if not self._sched_work:
+            self._sched_work = True
+            self.cond.notify_all()
+
     def _scheduler_loop(self) -> None:
+        last_sweep = 0.0
         while not self._shutdown:
             with self.lock:
-                self.cond.wait(timeout=0.2)
+                if not self._sched_work:
+                    self.cond.wait(timeout=0.2)
+                self._sched_work = False
             try:
-                self._sweep_workers()
+                now = time.time()
+                # sweeping polls every worker proc (a syscall each) — rate
+                # limit it so a wake storm doesn't turn into a poll storm
+                if now - last_sweep >= 0.2:
+                    last_sweep = now
+                    self._sweep_workers()
                 self._schedule_once()
+                # also the safety net for any queue site missing a flush:
+                # the loop runs at least every 0.2s
+                self._flush_sends()
             except Exception:
                 logger.error("scheduler error:\n%s", traceback.format_exc())
 
@@ -1626,6 +1810,8 @@ class Node:
                         continue
                     ns.idle.remove(w)
                     self._dispatch(ns, w, spec, tpu_ids, bundle)
+                    if bundle is None and not tpu_ids:
+                        self._pipeline_topup(ns, w)
                 if deferred:
                     # Pool size is resource-feasible, not a fixed headroom:
                     # workers beyond the CPU count can never dispatch (the
@@ -1712,31 +1898,25 @@ class Node:
         if ti:
             ti.state = "RUNNING"
             ti.node_id = ns.node_id
-        exec_msg = {
-            "type": "execute",
-            "spec": spec,
-            "dep_locs": self._dep_locations(spec),
-            "tpu_ids": tpu_ids,
-        }
-        try:
-            w.send(exec_msg)
-        except (OSError, ValueError):
-            self._on_worker_death(w, reason="send failed")
+        self._queue_execute(w, spec, self._dep_locations(spec), tpu_ids)
 
     def _release_task_resources(self, rt: dict) -> None:
         with self.lock:
-            ns = self.nodes.get(rt["node_id"])
-            if ns is None:
-                return
-            held = dict(rt["held"])
-            if rt["worker"].block_depth > 0:
-                held[CPU] = 0.0  # CPUs already released by the blocked path
-                rt["worker"].block_depth = 0
-            bundle = rt.get("bundle")
-            pool = bundle.available if bundle is not None and not bundle.detached else ns.available
-            _release(held, pool)
-            ns.tpu_free.extend(rt.get("tpu_ids", []))
-            self.cond.notify_all()
+            self._release_task_resources_locked(rt)
+
+    def _release_task_resources_locked(self, rt: dict) -> None:
+        ns = self.nodes.get(rt["node_id"])
+        if ns is None:
+            return
+        held = dict(rt["held"])
+        if rt["worker"].block_depth > 0:
+            held[CPU] = 0.0  # CPUs already released by the blocked path
+            rt["worker"].block_depth = 0
+        bundle = rt.get("bundle")
+        pool = bundle.available if bundle is not None and not bundle.detached else ns.available
+        _release(held, pool)
+        ns.tpu_free.extend(rt.get("tpu_ids", []))
+        self._wake_scheduler()
 
     def _on_task_done(self, w: WorkerHandle, msg: dict) -> None:
         spec = msg["spec_ref"]
@@ -1767,29 +1947,57 @@ class Node:
                 ti.exec_end = msg.get("exec_end")
                 ti.worker_pid = msg.get("worker_pid")
                 ti.end_time = time.time()
-        if rt is not None:
-            self._release_task_resources(rt)
         # return objects were sealed by the worker via "seal" messages already
         is_creation = spec.get("is_actor_creation")
         if is_creation:
+            if rt is not None:
+                self._release_task_resources(rt)
             self._on_actor_started(spec, w, failed=msg.get("failed"), error=msg.get("error_str"))
         with self.lock:
+            # release + pipeline promotion under ONE lock hold: releasing
+            # first and re-acquiring in a separate critical section lets a
+            # concurrent dispatch take the freed CPUs and the promotion's
+            # "identical shape always fits" invariant would oversubscribe
+            if rt is not None and not is_creation:
+                self._release_task_resources_locked(rt)
             if w.state == "busy" and not w.is_actor_worker:
-                w.state = "idle"
                 ns = self.nodes.get(w.node_id)
-                if ns and ns.alive:
-                    w.idle_since = time.time()
-                    ns.idle.append(w)
-                    # OnWorkerIdle fast path (direct_task_transport.cc:174):
-                    # hand this worker the next compatible pending task
-                    # right here, skipping a scheduler-thread round trip
-                    # per completion (the hot-loop latency of a task wave)
-                    self._fast_redispatch(ns, w)
+                nxt = None
+                if ns and ns.alive and w.pipeline:
+                    nxt = w.pipeline.popleft()
+                if nxt is not None:
+                    # promote the pipelined successor: the completed task's
+                    # identical resource shape was released above, so this
+                    # acquire always fits; the worker is already executing it
+                    _acquire(nxt.get("resources", {}), ns.available)
+                    w.current_task = nxt
+                    self.running[nxt["task_id"]] = {
+                        "spec": nxt,
+                        "worker": w,
+                        "node_id": ns.node_id,
+                        "held": dict(nxt.get("resources", {})),
+                        "tpu_ids": [],
+                        "bundle": None,
+                    }
+                    self._pipeline_topup(ns, w)
+                else:
+                    w.state = "idle"
+                    if ns and ns.alive:
+                        w.idle_since = time.time()
+                        ns.idle.append(w)
+                        # OnWorkerIdle fast path (direct_task_transport.cc:174):
+                        # hand this worker the next compatible pending task
+                        # right here, skipping a scheduler-thread round trip
+                        # per completion (the hot-loop latency of a task wave)
+                        self._fast_redispatch(ns, w)
             if w.is_actor_worker and w.actor_id in self.actors:
                 art = self.actors[w.actor_id]
                 if not is_creation:
                     art.inflight.pop(tid, None)
-            self.cond.notify_all()
+                    # a concurrency slot opened: dispatch the next queued
+                    # method right here (no scheduler wake — resources
+                    # didn't change, only this actor's pipeline advanced)
+                    self._dispatch_actor_next_locked(art)
 
     def _fast_redispatch(self, ns: NodeState, w: WorkerHandle) -> None:
         """Dispatch the first plain pending task this idle worker can run
@@ -1817,6 +2025,45 @@ class Node:
             self.pending_tasks.appendleft(spec)
             return
         self._dispatch(ns, w, spec, [], None)
+        self._pipeline_topup(ns, w)
+
+    def _pipeline_topup(self, ns: NodeState, w: WorkerHandle) -> None:
+        """Send up to task_pipeline_depth follow-on pending tasks to a busy
+        plain worker's local queue (lock held).  Only strategy-free,
+        TPU-free specs with the SAME resource shape as the running task
+        qualify — promotion at completion then swaps the released resources
+        for the promoted task's identical request, so accounting never goes
+        negative.  The worker executes its queue FIFO, so ordering holds."""
+        cur = w.current_task
+        if cur is None or w.is_actor_worker:
+            return
+        req = cur.get("resources", {})
+        if req.get(TPU, 0):
+            return
+        # pipeline only when the cluster is saturated for this shape — if
+        # any node could run the task NOW, committing it to this busy
+        # worker would defeat spreading (a remote node would sit idle
+        # while tasks queue behind a local lease)
+        if any(n.alive and _fits(req, n.available) for n in self.nodes.values()):
+            self._wake_scheduler()
+            return
+        depth = self.cfg.task_pipeline_depth
+        while len(w.pipeline) < depth and self.pending_tasks:
+            spec = self.pending_tasks[0]
+            if (
+                spec.get("scheduling_strategy") is not None
+                or spec.get("resources", {}) != req
+                or _runtime_env_key(spec.get("runtime_env")) != w.runtime_env_key
+                or not self._deps_ready(spec)
+            ):
+                return
+            self.pending_tasks.popleft()
+            w.pipeline.append(spec)
+            ti = self.gcs.tasks.get(spec["task_id"])
+            if ti:
+                ti.state = "RUNNING"
+                ti.node_id = ns.node_id
+            self._queue_execute(w, spec, self._dep_locations(spec), [])
 
     # ------------------------------------------------------------------
     # actors (GcsActorManager FSM analog)
@@ -1837,7 +2084,7 @@ class Node:
             self.actors[spec["actor_id"]] = ActorRuntime(info=info)
             for oid in spec["return_ids"]:
                 self.registry.create_pending(oid)
-            self.cond.notify_all()
+            self._wake_scheduler()
 
     def _schedule_actor_creations_and_tasks(self) -> None:
         spawn_failed: List[Tuple[ActorRuntime, List[dict], Exception]] = []
@@ -1921,34 +2168,38 @@ class Node:
                         w.state = "busy"
                         spec = art.info.creation_spec
                         w.current_task = spec
-                        try:
-                            w.send({
-                                "type": "execute",
-                                "spec": spec,
-                                "dep_locs": self._dep_locations(spec),
-                                "tpu_ids": art.tpu_ids,
-                            })
-                            art.info.state = "STARTING"
-                        except (OSError, ValueError):
-                            pass
+                        self._queue_execute(
+                            w, spec, self._dep_locations(spec), art.tpu_ids
+                        )
+                        art.info.state = "STARTING"
                 elif art.info.state == "ALIVE":
-                    # pipeline up to max_concurrency in-flight methods
-                    # (threaded/async actors run them concurrently worker-side)
-                    while art.queue and len(art.inflight) < art.max_concurrency:
-                        spec = art.queue.popleft()
-                        if not self._deps_ready(spec):
-                            art.queue.appendleft(spec)
-                            break
-                        art.inflight[spec["task_id"]] = spec
-                        try:
-                            w.send({
-                                "type": "execute",
-                                "spec": spec,
-                                "dep_locs": self._dep_locations(spec),
-                                "tpu_ids": art.tpu_ids,
-                            })
-                        except (OSError, ValueError):
-                            break
+                    self._dispatch_actor_next_locked(art)
+
+    def _dispatch_actor_next_locked(self, art: ActorRuntime) -> None:
+        """Pipeline queued methods straight to the actor's worker, up to
+        max_concurrency in-flight (the direct actor task submitter fast
+        path, reference ``direct_actor_task_submitter.h:67``).  Runs on
+        whichever thread made the actor dispatchable — submit, task_done,
+        dep seal — so a method call never waits on a scheduler-thread
+        round trip.  Caller holds self.lock; per-actor FIFO order is
+        preserved because every dispatch site pops under that lock."""
+        w = art.worker
+        if (w is None or w.conn is None or w.state == "dead"
+                or art.info.state != "ALIVE"):
+            return
+        # dispatch window = concurrency + pipeline headroom: the worker
+        # bounds actual execution concurrency itself (inline loop or its
+        # BoundedExecutor pool), so the extra calls just wait in its local
+        # queue instead of across a head round trip
+        window = art.max_concurrency + self.cfg.actor_pipeline_depth
+        while art.queue and len(art.inflight) < window:
+            spec = art.queue[0]
+            if not self._deps_ready(spec):
+                self._dep_blocked_actors.add(art.info.actor_id)
+                break
+            art.queue.popleft()
+            art.inflight[spec["task_id"]] = spec
+            self._queue_execute(w, spec, self._dep_locations(spec), art.tpu_ids)
 
     def _on_actor_started(self, spec: dict, w: WorkerHandle, failed: bool, error: Optional[str]) -> None:
         with self.lock:
@@ -1974,7 +2225,9 @@ class Node:
                     if pool is not None and w.block_depth == 0:
                         _release({CPU: art.held[CPU]}, pool)
                     art.held[CPU] = 0.0
-            self.cond.notify_all()
+                # methods queued while the actor was starting dispatch now
+                self._dispatch_actor_next_locked(art)
+            self._wake_scheduler()
         if failed:
             self._release_spec_pins(art.info.creation_spec)
 
@@ -1995,7 +2248,11 @@ class Node:
                 trace_ctx=spec.get("trace_ctx"),
             )
             art.queue.append(spec)
-            self.cond.notify_all()
+            # direct dispatch on the submitting connection's reader thread;
+            # the scheduler is only needed while the actor isn't placed yet
+            self._dispatch_actor_next_locked(art)
+            if art.queue and (art.worker is None or art.info.state != "ALIVE"):
+                self._wake_scheduler()
 
     def _on_actor_worker_death(self, w: WorkerHandle, reason: str) -> None:
         from ray_tpu.exceptions import RayActorError
@@ -2058,13 +2315,139 @@ class Node:
                 info.death_cause = reason
                 failed_specs.extend(art.queue)
                 art.queue.clear()
-            self.cond.notify_all()
+            self._wake_scheduler()
         if info.state == "DEAD":
             # permanently gone: creation-spec arg pins drop now
             self._release_spec_pins(info.creation_spec)
         err = RayActorError(f"Actor {info.class_name} died: {reason}")
         for spec in failed_specs:
             self._seal_error_returns(spec, err)
+
+    # ------------------------------------------------------------------
+    # task cancellation (reference ``python/ray/_private/worker.py:2573``
+    # ``cancel`` + the core worker's CancelTask RPC)
+    # ------------------------------------------------------------------
+    def cancel_task(self, oid: bytes, force: bool = False,
+                    recursive: bool = True) -> None:
+        """Cancel the task that produces ``oid``.
+
+        - queued anywhere head-side (pending/ready/actor queue): dequeued,
+          returns sealed with TaskCancelledError, resources released;
+        - dispatched to a worker (running or pipelined): returns pre-sealed
+          with TaskCancelledError, then the worker is told to skip/interrupt
+          it (``force=True`` SIGKILLs the worker instead — plain tasks only;
+          the reference likewise refuses force-cancel of actor tasks);
+        - finished/unknown: no-op.
+
+        ``recursive`` also cancels tasks submitted BY the cancelled task
+        (tracked via the spec's ``parent_task_id``).
+        """
+        from ray_tpu.exceptions import TaskCancelledError
+
+        queue = deque([oid])
+        seen = set()
+        while queue:
+            o = queue.popleft()
+            if o in seen:
+                continue
+            seen.add(o)
+            with self.lock:
+                found = self._cancel_locked(o, force)
+            if found is None:
+                continue
+            action, spec, w = found
+            tid = spec["task_id"]
+            if action == "dequeued":
+                self._seal_error_returns(
+                    spec, TaskCancelledError(
+                        f"task {spec.get('name')} was cancelled before it started"))
+            elif action == "at_worker":
+                # pre-seal so callers unblock now; the worker's own late
+                # seal (if it finishes anyway) loses first-seal-wins
+                self._seal_error_returns(
+                    spec, TaskCancelledError(
+                        f"task {spec.get('name')} was cancelled"))
+                if force:
+                    self._kill_worker(w, reason="task force-cancelled")
+                else:
+                    try:
+                        w.send({"type": "cancel", "task_id": tid})
+                    except (OSError, ValueError):
+                        pass
+            if recursive:
+                with self.lock:
+                    queue.extend(self._children_return_oids_locked(tid))
+
+    def _cancel_locked(self, oid: bytes, force: bool):
+        """Locate the task producing ``oid`` and dequeue it if still
+        head-side.  Returns (action, spec, worker|None) or None.  Lock held."""
+
+        def produces(spec):
+            return oid in spec.get("return_ids", ())
+
+        # 1. cluster-pending
+        for spec in self.pending_tasks:
+            if produces(spec):
+                self.pending_tasks.remove(spec)
+                return ("dequeued", spec, None)
+        # 2. staged on a node (resources held)
+        for ns in self.nodes.values():
+            for entry in ns.ready_queue:
+                spec, tpu_ids, bundle = entry
+                if produces(spec):
+                    ns.ready_queue.remove(entry)
+                    pool = bundle.available if bundle is not None else ns.available
+                    _release(spec.get("resources", {}), pool)
+                    ns.tpu_free.extend(tpu_ids)
+                    return ("dequeued", spec, None)
+        # 3. actor method queues
+        for art in self.actors.values():
+            for spec in art.queue:
+                if produces(spec):
+                    art.queue.remove(spec)
+                    return ("dequeued", spec, None)
+            for spec in art.inflight.values():
+                if produces(spec):
+                    if force:
+                        raise ValueError(
+                            "force=True is not supported for actor tasks")
+                    return ("at_worker", spec, art.worker)
+        # 4. at a worker: running or pipelined behind the running task
+        for tid, rt in self.running.items():
+            if produces(rt["spec"]):
+                rt["spec"]["retries_left"] = 0  # a cancel never retries
+                return ("at_worker", rt["spec"], rt["worker"])
+        for w in self.workers.values():
+            for spec in w.pipeline:
+                if produces(spec):
+                    spec["retries_left"] = 0
+                    return ("at_worker", spec, w)
+        return None
+
+    def _children_return_oids_locked(self, tid: bytes) -> List[bytes]:
+        """First return oid of every task submitted by task ``tid``."""
+        out = []
+
+        def scan(spec):
+            if spec.get("parent_task_id") == tid and spec.get("return_ids"):
+                out.append(spec["return_ids"][0])
+
+        for spec in self.pending_tasks:
+            scan(spec)
+        for ns in self.nodes.values():
+            for spec, _, _ in ns.ready_queue:
+                scan(spec)
+        for rt in self.running.values():
+            scan(rt["spec"])
+        for w in self.workers.values():
+            for spec in w.pipeline:
+                scan(spec)
+        for art in self.actors.values():
+            for spec in art.queue:
+                scan(spec)
+            for spec in art.inflight.values():
+                scan(spec)
+        return out
 
     def kill_actor(self, actor_id: bytes, no_restart: bool = True) -> None:
         from ray_tpu.exceptions import RayActorError
@@ -2096,7 +2479,7 @@ class Node:
                     ns.tpu_free.extend(art.tpu_ids)
                     art.held = {}
                     art.tpu_ids = []
-                self.cond.notify_all()
+                self._wake_scheduler()
         if art.info.state == "DEAD":
             self._release_spec_pins(art.info.creation_spec)
         err = RayActorError(f"Actor {art.info.class_name} was killed before creation")
@@ -2125,7 +2508,7 @@ class Node:
             if rt.ready_oid:
                 self.registry.create_pending(rt.ready_oid)
             self.pending_pgs.append(rt.info.pg_id)
-            self.cond.notify_all()
+            self._wake_scheduler()
 
     def _schedule_pgs(self) -> None:
         """Bundle placement: STRICT_PACK / PACK / SPREAD / STRICT_SPREAD
@@ -2209,7 +2592,7 @@ class Node:
                     # finish (the detached flag reroutes their release).
                     _release(b.available, ns.available)
                     b.available = {}
-            self.cond.notify_all()
+            self._wake_scheduler()
 
     # ------------------------------------------------------------------
     # introspection

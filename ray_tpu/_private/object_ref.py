@@ -8,7 +8,9 @@ per-task counter the way the reference embeds lineage in object IDs.
 
 from __future__ import annotations
 
+import itertools
 import os
+import struct
 
 
 class ObjectRef:
@@ -23,7 +25,7 @@ class ObjectRef:
 
     @classmethod
     def random(cls) -> "ObjectRef":
-        return cls(os.urandom(16))
+        return cls(new_id())
 
     @classmethod
     def from_hex(cls, h: str) -> "ObjectRef":
@@ -70,5 +72,26 @@ class ObjectRef:
         return (ObjectRef, (self._id,))
 
 
+# IDs are a per-process random prefix + a monotonically increasing counter
+# (the reference also derives object IDs from the task counter, id.h).  One
+# urandom syscall per PROCESS instead of per id — new_id was the single
+# hottest driver-side frame in a submission wave.  ``next()`` on an
+# itertools.count is a single C call, so it is atomic under the GIL.
+_prefix: bytes = os.urandom(8)
+_counter = itertools.count(1)
+
+
+def _reseed_after_fork() -> None:
+    global _prefix, _counter
+    _prefix = os.urandom(8)
+    _counter = itertools.count(1)
+
+
+if hasattr(os, "register_at_fork"):
+    os.register_at_fork(after_in_child=_reseed_after_fork)
+
+
 def new_id(n: int = 16) -> bytes:
-    return os.urandom(n)
+    if n != 16:
+        return os.urandom(n)
+    return _prefix + struct.pack(">Q", next(_counter))

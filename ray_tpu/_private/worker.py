@@ -372,6 +372,16 @@ class Worker:
             "runtime_env": runtime_env,
             "max_concurrency": max_concurrency,
             "release_cpu_after_start": release_cpu_after_start,
+            # lineage edge for recursive cancellation (the reference embeds
+            # the parent in the task id itself, src/ray/common/id.h)
+            "parent_task_id": self.current_task_id,
+        }
+        # strip default/absent fields off the wire — every consumer reads
+        # optionals with .get(); a plain task's spec shrinks ~2x
+        spec = {
+            k: v for k, v in spec.items()
+            if not (v is None or v == [] or v is False or v == 0)
+            or k in ("task_id", "name", "return_ids", "num_returns")
         }
         from ray_tpu.util import tracing
 
@@ -385,6 +395,46 @@ class Worker:
 
 global_worker = Worker()
 
+# -- cancellation state (worker mode) ---------------------------------------
+# ids cancelled before they started: the exec loop skips them.  Async
+# in-flight coroutines register here so a cancel can .cancel() them.
+_cancelled_ids: set = set()
+_async_futs: Dict[bytes, Any] = {}
+_async_futs_lock = threading.Lock()
+# main-thread execution state for interruption: "tid" is set only while
+# user code for that task is running ON the main thread (the only thread
+# interrupt_main can reach); "spec" outlives it until task_done is sent so
+# the main loop can recover a report if a late KeyboardInterrupt lands
+# between the user code finishing and the report going out.
+_main_exec: Dict[str, Any] = {"tid": None, "spec": None}
+
+
+def _on_cancel_message(msg: dict) -> None:
+    """Runs on the client's recv thread (ray_tpu cancel -> CancelTask RPC
+    analog).  Three cases: not started yet (skip via _cancelled_ids),
+    running on the main thread (KeyboardInterrupt via interrupt_main — the
+    reference raises the same into the worker), running as a coroutine
+    (Future.cancel)."""
+    tid = msg["task_id"]
+    _cancelled_ids.add(tid)
+    with _async_futs_lock:
+        fut = _async_futs.get(tid)
+    if fut is not None:
+        fut.cancel()
+        _cancelled_ids.discard(tid)  # consumed; nothing else will skip it
+        return
+    # interrupt only while the TARGET task's user code is on the main
+    # thread — checking current_task_id alone could interrupt whatever ran
+    # next (sealing, or an unrelated pipelined task)
+    if _main_exec["tid"] == tid:
+        import _thread
+
+        _thread.interrupt_main()
+    if len(_cancelled_ids) > 10_000:
+        # unconsumed ids (cancels that raced completion) must not grow
+        # forever; losing 10k-old skip markers is harmless
+        _cancelled_ids.clear()
+
 
 # ---------------------------------------------------------------------------
 # Task execution (worker process)
@@ -392,6 +442,7 @@ global_worker = Worker()
 
 _async_loop: Optional[asyncio.AbstractEventLoop] = None
 _async_loop_lock = threading.Lock()
+_async_sem: Optional[asyncio.Semaphore] = None
 
 
 def _get_async_loop() -> asyncio.AbstractEventLoop:
@@ -415,7 +466,17 @@ async def _ensure_coro(awaitable, trace_ctx=None):
         from ray_tpu.util import tracing
 
         tracing._current.set(trace_ctx)
-    return await awaitable
+    # max_concurrency must bound RUNNING coroutines, not just threads: the
+    # head pipelines extra calls beyond max_concurrency (actor_pipeline_depth)
+    # and an async method frees its executor thread immediately, so without
+    # this gate pipelined coroutines would interleave past the user's limit
+    # (an async actor declared max_concurrency=1 expects serial execution).
+    global _async_sem
+    if _async_sem is None:
+        _async_sem = asyncio.Semaphore(
+            int(os.environ.get("RAY_TPU_MAX_CONCURRENCY", "1")))
+    async with _async_sem:
+        return await awaitable
 
 
 _completion_pool = None
@@ -457,6 +518,17 @@ def _execute_task(msg: dict) -> None:
 
     w = global_worker
     spec = msg["spec"]
+    if spec["task_id"] in _cancelled_ids:
+        # cancelled while queued at this worker: report without executing
+        # (the head pre-sealed the returns; our duplicate seal is dropped)
+        from ray_tpu.exceptions import TaskCancelledError
+
+        _cancelled_ids.discard(spec["task_id"])
+        _seal_and_report(
+            w, spec,
+            [TaskCancelledError("task was cancelled")] * spec["num_returns"],
+            True, "TaskCancelledError: cancelled before start", time.time())
+        return
     dep_locs = msg.get("dep_locs", {})
     tpu_ids = msg.get("tpu_ids", [])
     # Overwrite (not setdefault): a pooled worker may be reused for a task
@@ -479,6 +551,10 @@ def _execute_task(msg: dict) -> None:
     exec_start = time.time()  # profile event (core_worker profiling.h:30)
     failed = False
     error_str = None
+    on_main = threading.current_thread() is threading.main_thread()
+    if on_main:
+        _main_exec["spec"] = spec
+        _main_exec["tid"] = spec["task_id"]
     try:
         try:
             args, kwargs = _resolve_args(spec, dep_locs)
@@ -511,8 +587,14 @@ def _execute_task(msg: dict) -> None:
                     fut = asyncio.run_coroutine_threadsafe(
                         _ensure_coro(out, spec.get("trace_ctx")), _get_async_loop()
                     )
+                    with _async_futs_lock:
+                        _async_futs[spec["task_id"]] = fut
+                        if spec["task_id"] in _cancelled_ids:
+                            fut.cancel()  # cancel raced the registration
 
                     def _complete(f, spec=spec, exec_start=exec_start):
+                        with _async_futs_lock:
+                            _async_futs.pop(spec["task_id"], None)
                         # runs on the loop thread: compute the outcome only,
                         # then seal on a side thread — result serialization
                         # must never stall the other in-flight coroutines
@@ -532,6 +614,8 @@ def _execute_task(msg: dict) -> None:
                         )
 
                     fut.add_done_callback(_complete)
+                    if on_main:  # the coroutine owns reporting from here
+                        _main_exec["spec"] = None
                     return
             finally:
                 w.task_depth -= 1
@@ -556,6 +640,9 @@ def _execute_task(msg: dict) -> None:
             f"Task {spec.get('name')} failed:\n{tb}", cause=e
         )
         results = [err] * spec["num_returns"]
+    finally:
+        if on_main:  # close the cancellation-interrupt window
+            _main_exec["tid"] = None
     _seal_and_report(w, spec, results, failed, error_str, exec_start)
 
 
@@ -567,6 +654,7 @@ def _seal_and_report(w, spec: dict, results: List[Any], failed: bool,
     add_done_callback) for async actor methods."""
     from ray_tpu.exceptions import RayTaskError
 
+    seals = []
     for oid, value in zip(spec["return_ids"], results):
         ref = ObjectRef(oid)
         try:
@@ -576,9 +664,12 @@ def _seal_and_report(w, spec: dict, results: List[Any], failed: bool,
                 ref, RayTaskError(f"Failed to serialize result of {spec.get('name')}: {e}"),
                 is_error=True,
             )
-        w.client.seal(oid, loc, [r.binary() for r in contained])
+        seals.append((oid, loc, [r.binary() for r in contained]))
+    # returns ride inside task_done — one message per task instead of
+    # num_returns+1; the head seals them before the done bookkeeping
     w.client.send({
         "type": "task_done",
+        "seals": seals,
         "spec_ref": {
             "task_id": spec["task_id"],
             "return_ids": spec["return_ids"],
@@ -595,6 +686,8 @@ def _seal_and_report(w, spec: dict, results: List[Any], failed: bool,
         "worker_pid": os.getpid(),
     })
     w.current_task_id = None
+    if threading.current_thread() is threading.main_thread():
+        _main_exec["spec"] = None  # reported; nothing left to recover
 
 
 def _split_returns(out: Any, num_returns: int) -> List[Any]:
@@ -635,6 +728,27 @@ def main() -> None:
         # stderr reads like a live-session failure
         os._exit(0)
 
+    # ad-hoc worker profiling: RAY_TPU_SAMPLE_PROFILE=/path/prefix dumps a
+    # sampled stack report to <prefix>-<pid>.txt at exit
+    _profiler = None
+    _profile_prefix = os.environ.get("RAY_TPU_SAMPLE_PROFILE")
+    if _profile_prefix:
+        from ray_tpu._private.sampling_profiler import SamplingProfiler
+
+        _profiler = SamplingProfiler().start()
+
+        import atexit
+
+        def _dump_profile():
+            _profiler.stop()
+            try:
+                with open(f"{_profile_prefix}-{os.getpid()}.txt", "w") as f:
+                    f.write(_profiler.report_text())
+            except OSError:
+                pass
+
+        atexit.register(_dump_profile)
+
     # app metrics recorded in this worker flow to the head's /metrics
     from ray_tpu.util.metrics import MetricsPusher
 
@@ -655,22 +769,47 @@ def main() -> None:
             max_workers=max_concurrency, thread_name_prefix="actor-exec"
         )
 
+    client._cancel_handler = _on_cancel_message
     while True:
-        msg = client._exec_queue.get()
-        if msg["type"] == "exit":
-            break
-        if msg["type"] == "execute":
-            spec = msg["spec"]
-            if (
-                pool is not None
-                and spec.get("actor_id") is not None
-                and not spec.get("is_actor_creation")
-            ):
-                pool.submit(_execute_task, msg)
-            else:
-                _execute_task(msg)
+        try:
+            msg = client._exec_queue.get()
+            if msg["type"] == "exit":
+                break
+            if msg["type"] == "execute":
+                spec = msg["spec"]
+                if (
+                    pool is not None
+                    and spec.get("actor_id") is not None
+                    and not spec.get("is_actor_creation")
+                ):
+                    pool.submit(_execute_task, msg)
+                else:
+                    _execute_task(msg)
+        except KeyboardInterrupt:
+            # a cancel's interrupt_main landed outside user code — either
+            # between tasks (harmless) or in the tiny window between the
+            # user code finishing and task_done going out.  In the latter
+            # case the head still thinks the task is running: send the
+            # report it was owed so dispatch bookkeeping stays in sync.
+            spec = _main_exec.get("spec")
+            _main_exec["spec"] = None
+            _main_exec["tid"] = None
+            if spec is not None:
+                from ray_tpu.exceptions import TaskCancelledError
+
+                try:
+                    _seal_and_report(
+                        w, spec,
+                        [TaskCancelledError("task was cancelled")]
+                        * spec["num_returns"],
+                        True, "TaskCancelledError: cancelled", time.time())
+                except Exception:
+                    pass
+            continue
     if pool is not None:
         pool.shutdown(wait=False)
+    if _profiler is not None:
+        _dump_profile()  # os._exit skips atexit
     client.close()
     os._exit(0)
 

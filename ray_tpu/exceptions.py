@@ -37,3 +37,10 @@ class ObjectLostError(RayError):
 
 class ActorDiedError(RayActorError):
     pass
+
+
+class TaskCancelledError(RayError):
+    """The task was cancelled via ``ray_tpu.cancel`` (reference
+    ``python/ray/exceptions.py`` TaskCancelledError; cancel path
+    ``python/ray/_private/worker.py:2573``).  Raised by ``get`` on the
+    cancelled task's returns."""

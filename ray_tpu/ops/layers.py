@@ -37,10 +37,17 @@ def layernorm(
 def rope(
     x: jax.Array, positions: jax.Array, *, base: float = 10000.0,
 ) -> jax.Array:
-    """Rotary position embedding. x: [..., T, D] with D even; positions: [T]."""
+    """Rotary position embedding. x: [..., T, D] with D even.
+
+    positions: [T] (shared across batch — training) or [B, T] (per-sequence
+    absolute positions — KV-cache decode, where each slot sits at its own
+    offset).  x is [B, H, T, D] in the batched case."""
     d = x.shape[-1]
     inv_freq = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]  # [T, D/2]
+    if positions.ndim == 2:  # [B, T] -> angles [B, 1, T, D/2]
+        angles = positions.astype(jnp.float32)[:, None, :, None] * inv_freq
+    else:
+        angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = x[..., 0::2], x[..., 1::2]
     out1 = x1 * cos - x2 * sin

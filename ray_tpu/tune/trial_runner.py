@@ -138,6 +138,14 @@ class TrialRunner:
                     ray_tpu.get(trial.actor.stop.remote(), timeout=60)
                 except Exception:
                     pass
+            elif trial.future is not None:
+                # deadline kill: cancel the wedged train() call first (the
+                # core cancellation primitive) so its future resolves with
+                # TaskCancelledError instead of dangling until actor death
+                try:
+                    ray_tpu.cancel(trial.future, recursive=True)
+                except Exception:
+                    pass
             try:
                 ray_tpu.kill(trial.actor)
             except Exception:

@@ -1,0 +1,159 @@
+"""``ray_tpu.cancel`` — pending/running/finished/actor/recursive cases
+(reference cancel semantics, ``python/ray/_private/worker.py:2573``)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskCancelledError
+
+
+def test_cancel_pending_task(ray_start_regular):
+    @ray_tpu.remote(num_cpus=1)
+    def hog():
+        time.sleep(5)
+        return "hog"
+
+    @ray_tpu.remote(num_cpus=1)
+    def victim():
+        return "ran"
+
+    hogs = [hog.remote() for _ in range(4)]  # saturate the 4 CPUs
+    time.sleep(0.5)
+    v = victim.remote()  # must be queued behind the hogs
+    ray_tpu.cancel(v)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(v, timeout=30)
+    for r in hogs:
+        ray_tpu.cancel(r, force=True)
+
+
+def test_cancel_running_task_interrupts(ray_start_regular):
+    @ray_tpu.remote
+    def sleeper():
+        time.sleep(60)
+        return "done"
+
+    r = sleeper.remote()
+    time.sleep(1.0)  # let it start
+    t0 = time.time()
+    ray_tpu.cancel(r)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(r, timeout=30)
+    assert time.time() - t0 < 10, "cancel did not unblock the caller promptly"
+
+    # the worker pool survives the interrupt: later tasks run fine
+    @ray_tpu.remote
+    def ok():
+        return 42
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == 42
+
+
+def test_cancel_force_kills_worker(ray_start_regular):
+    @ray_tpu.remote
+    def stubborn():
+        while True:  # ignores KeyboardInterrupt-free pure spin? no — sleep
+            time.sleep(0.5)
+
+    r = stubborn.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(r, force=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(r, timeout=30)
+
+    @ray_tpu.remote
+    def ok():
+        return "alive"
+
+    assert ray_tpu.get(ok.remote(), timeout=60) == "alive"
+
+
+def test_cancel_finished_task_is_noop(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 7
+
+    r = f.remote()
+    assert ray_tpu.get(r, timeout=60) == 7
+    ray_tpu.cancel(r)  # no-op
+    assert ray_tpu.get(r, timeout=60) == 7
+
+
+def test_cancel_queued_actor_method(ray_start_regular):
+    @ray_tpu.remote
+    class Slow:
+        def busy(self):
+            time.sleep(4)
+            return "busy"
+
+        def quick(self):
+            return "quick"
+
+    a = Slow.remote()
+    b = a.busy.remote()
+    time.sleep(0.5)
+    q = a.quick.remote()  # queued behind busy (max_concurrency=1)
+    ray_tpu.cancel(q)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(q, timeout=30)
+    assert ray_tpu.get(b, timeout=60) == "busy"  # the running one completes
+
+
+def test_cancel_async_actor_method(ray_start_regular):
+    @ray_tpu.remote(max_concurrency=8)
+    class Async:
+        async def forever(self):
+            import asyncio
+
+            await asyncio.sleep(3600)
+
+        async def ping(self):
+            return "pong"
+
+    a = Async.remote()
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+    r = a.forever.remote()
+    time.sleep(1.0)
+    ray_tpu.cancel(r)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(r, timeout=30)
+    # the actor still serves
+    assert ray_tpu.get(a.ping.remote(), timeout=60) == "pong"
+
+
+def test_cancel_force_on_actor_task_rejected(ray_start_regular):
+    @ray_tpu.remote
+    class A:
+        def ready(self):
+            return True
+
+        def slow(self):
+            time.sleep(5)
+
+    a = A.remote()
+    assert ray_tpu.get(a.ready.remote(), timeout=60)  # actor is up
+    r = a.slow.remote()
+    time.sleep(0.5)  # now the method is inflight, not queued
+    with pytest.raises(ValueError):
+        ray_tpu.cancel(r, force=True)
+    ray_tpu.get(r, timeout=60)  # unaffected
+
+
+def test_cancel_recursive_cancels_children(ray_start_regular):
+    @ray_tpu.remote
+    def child():
+        time.sleep(60)
+        return "child"
+
+    @ray_tpu.remote
+    def parent():
+        c = child.remote()
+        return ray_tpu.get(c, timeout=120)
+
+    r = parent.remote()
+    time.sleep(1.5)  # parent is blocked on the child
+    ray_tpu.cancel(r, recursive=True)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(r, timeout=30)
