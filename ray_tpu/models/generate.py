@@ -1,0 +1,302 @@
+"""KV-cache autoregressive generation for the decoder LMs (GPT-2, Llama).
+
+The reference snapshot has no inference engine at all — serving wraps a
+plain forward (``python/ray/serve/_private/replica.py:250`` calls the user
+callable); generation/KV-cache is delegated to user code.  Here decode is a
+first-class TPU path, designed for XLA:
+
+- **Static shapes everywhere**: the cache is a fixed ``[L, B, KV, S, dh]``
+  buffer; positions are dynamic *values*, never dynamic shapes, so the
+  decode step compiles once and runs for every token.
+- **Layer-stacked cache + ``lax.scan``**: the per-layer cache rides the
+  same scan as the stacked block params — one compiled block body.
+- **Per-slot positions**: each batch slot sits at its own offset (``pos``
+  vector), which is what iteration-level continuous batching needs
+  (Orca-style; see :mod:`ray_tpu.serve.llm`).
+- **Chunked decode**: ``decode_chunk`` runs N decode+sample steps inside
+  one device computation (``lax.scan``) so the host syncs once per chunk,
+  not per token — host<->device latency is the decode killer on a
+  tunneled chip.
+
+Cache writes land at each slot's current position via a vmapped
+``dynamic_update_slice``; finished/idle slots simply keep writing at their
+frozen position, which is harmless because a slot's attention mask never
+reaches an index its own ``pos`` hasn't covered and prefill overwrites
+``[0, len)`` when a slot is reused.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.models.gpt2 import GPT2Config
+from ray_tpu.models.llama import LlamaConfig
+from ray_tpu.ops.layers import layernorm, rmsnorm, rope
+
+
+def family_of(cfg) -> str:
+    if isinstance(cfg, LlamaConfig):
+        return "llama"
+    if isinstance(cfg, GPT2Config):
+        return "gpt2"
+    raise TypeError(f"no generation support for config {type(cfg).__name__}")
+
+
+def kv_heads(cfg) -> int:
+    return cfg.n_kv_heads if isinstance(cfg, LlamaConfig) else cfg.n_heads
+
+
+def init_cache(cfg, n_slots: int, max_len: int) -> Dict[str, jax.Array]:
+    """Fixed-size KV cache: k/v ``[L, B, KV, S, dh]`` plus per-slot ``pos``."""
+    shape = (cfg.n_layers, n_slots, kv_heads(cfg), max_len, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+        "pos": jnp.zeros((n_slots,), jnp.int32),
+    }
+
+
+def _write_kv(cache_l: jax.Array, new: jax.Array, pos: jax.Array) -> jax.Array:
+    """Write ``new [B, KV, T, dh]`` into ``cache_l [B, KV, S, dh]`` at each
+    slot's ``pos [B]`` (vmapped dynamic_update_slice -> one scatter)."""
+
+    def upd(c, n, p):
+        return lax.dynamic_update_slice(c, n.astype(c.dtype), (0, p, 0))
+
+    return jax.vmap(upd)(cache_l, new, pos)
+
+
+def _decode_attend(q, k_cache, v_cache, pos) -> jax.Array:
+    """q ``[B, H, 1, dh]`` against the full cache ``[B, KV, S, dh]`` with a
+    per-slot length mask ``j <= pos``.  GQA folds the query heads onto
+    their KV head by reshape (no materialized repeat)."""
+    B, H, _, dh = q.shape
+    KV = k_cache.shape[1]
+    S = k_cache.shape[2]
+    q = q.reshape(B, KV, H // KV, dh)
+    scores = jnp.einsum(
+        "bkgd,bksd->bkgs", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+    ) / (dh ** 0.5)
+    mask = jnp.arange(S)[None, None, None, :] <= pos[:, None, None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    w = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bksd->bkgd", w, v_cache.astype(jnp.float32))
+    return out.reshape(B, H, 1, dh)
+
+
+# ---------------------------------------------------------------------------
+# per-family block math (prefill captures K/V; decode reads the cache)
+# ---------------------------------------------------------------------------
+
+def _gpt2_block(x, p, cfg: GPT2Config, *, cache_kv=None, pos=None):
+    """One GPT-2 block.  Prefill mode (cache_kv None): full causal self-
+    attention over ``x [B, T, D]``, returns ``(x, (k, v))``.  Decode mode:
+    ``x [B, 1, D]`` attends over the cache, returns ``(x, (k_cache,
+    v_cache))`` with the new K/V written at ``pos``."""
+    B, T, D = x.shape
+    H, dh = cfg.n_heads, cfg.head_dim
+    c = lambda w: w.astype(cfg.dtype)
+
+    h = layernorm(x, c(p["ln1_w"]), c(p["ln1_b"]))
+    qkv = h @ c(p["wqkv"]) + c(p["bqkv"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    to_heads = lambda t: t.reshape(B, T, H, dh).transpose(0, 2, 1, 3)
+    q, k, v = to_heads(q), to_heads(k), to_heads(v)
+    if cache_kv is None:
+        from ray_tpu.ops.attention import attention
+
+        out = attention(q, k, v, causal=True)
+        saved = (k, v)
+    else:
+        k_cache = _write_kv(cache_kv[0], k, pos)
+        v_cache = _write_kv(cache_kv[1], v, pos)
+        out = _decode_attend(q, k_cache, v_cache, pos)
+        saved = (k_cache, v_cache)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, D).astype(cfg.dtype)
+    x = x + out @ c(p["wo"]) + c(p["bo"])
+    h = layernorm(x, c(p["ln2_w"]), c(p["ln2_b"]))
+    h = jax.nn.gelu(h @ c(p["w1"]) + c(p["b1"]), approximate=True)
+    x = x + h @ c(p["w2"]) + c(p["b2"])
+    return x, saved
+
+
+def _llama_block(x, p, cfg: LlamaConfig, positions, *, cache_kv=None, pos=None):
+    """One Llama block (RMSNorm/RoPE/GQA/SwiGLU); same two modes as
+    :func:`_gpt2_block`.  The cache stores post-RoPE keys in the KV-head
+    layout (``n_kv_heads`` rows — the GQA memory saving)."""
+    B, T, D = x.shape
+    H, KV, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    dt = cfg.dtype
+
+    h = rmsnorm(x, p["attn_norm"].astype(dt), eps=cfg.rms_eps)
+    q = (h @ p["wq"].astype(dt)).reshape(B, T, H, dh).transpose(0, 2, 1, 3)
+    k = (h @ p["wk"].astype(dt)).reshape(B, T, KV, dh).transpose(0, 2, 1, 3)
+    v = (h @ p["wv"].astype(dt)).reshape(B, T, KV, dh).transpose(0, 2, 1, 3)
+    q = rope(q, positions, base=cfg.rope_base)
+    k = rope(k, positions, base=cfg.rope_base)
+    if cache_kv is None:
+        kr = jnp.repeat(k, cfg.q_per_kv, axis=1)
+        vr = jnp.repeat(v, cfg.q_per_kv, axis=1)
+        from ray_tpu.ops.attention import attention
+
+        out = attention(q, kr, vr, causal=True)
+        saved = (k, v)
+    else:
+        k_cache = _write_kv(cache_kv[0], k, pos)
+        v_cache = _write_kv(cache_kv[1], v, pos)
+        out = _decode_attend(q, k_cache, v_cache, pos)
+        saved = (k_cache, v_cache)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, H * dh).astype(dt)
+    x = x + out @ p["wo"].astype(dt)
+    h = rmsnorm(x, p["ffn_norm"].astype(dt), eps=cfg.rms_eps)
+    gated = jax.nn.silu(h @ p["w_gate"].astype(dt)) * (h @ p["w_up"].astype(dt))
+    return x + gated @ p["w_down"].astype(dt), saved
+
+
+# ---------------------------------------------------------------------------
+# prefill / decode over the stacked layers
+# ---------------------------------------------------------------------------
+
+def _embed(params, tokens, cfg, positions):
+    if family_of(cfg) == "gpt2":
+        x = params["wte"][tokens] + jnp.take(params["wpe"], positions, axis=0)
+    else:
+        x = params["tok_emb"][tokens]
+    return x.astype(cfg.dtype)
+
+
+def _unembed(params, x, cfg):
+    if family_of(cfg) == "gpt2":
+        x = layernorm(x, params["lnf_w"].astype(cfg.dtype),
+                      params["lnf_b"].astype(cfg.dtype))
+        w = params["wte"]
+    else:
+        x = rmsnorm(x, params["final_norm"].astype(cfg.dtype), eps=cfg.rms_eps)
+        w = params["tok_emb"]
+    return (x @ w.T.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def prefill(params, cfg, tokens: jax.Array, lengths: jax.Array,
+            cache: Dict[str, jax.Array], slot: jax.Array) -> Tuple[jax.Array, Dict]:
+    """Run the prompt ``tokens [B, Tp]`` (right-padded; true lengths
+    ``lengths [B]``) and write K/V into cache slots ``slot + [0..B)``.
+    Returns ``(last_logits [B, V], cache)``.  Positions are 0..Tp-1, so a
+    slot must be prefilled from scratch (pos resets to ``lengths``)."""
+    fam = family_of(cfg)
+    B, Tp = tokens.shape
+    positions = jnp.arange(Tp)
+    x = _embed(params, tokens, cfg, positions)
+
+    if fam == "gpt2":
+        def body(h, p):
+            h, kv = _gpt2_block(h, p, cfg)
+            return h, kv
+    else:
+        def body(h, p):
+            h, kv = _llama_block(h, p, cfg, positions)
+            return h, kv
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])  # ks [L, B, KV, Tp, dh]
+    cache_k = lax.dynamic_update_slice(
+        cache["k"], ks.astype(cache["k"].dtype), (0, slot, 0, 0, 0))
+    cache_v = lax.dynamic_update_slice(
+        cache["v"], vs.astype(cache["v"].dtype), (0, slot, 0, 0, 0))
+    pos = lax.dynamic_update_slice(
+        cache["pos"], lengths.astype(jnp.int32), (slot,))
+    last = _unembed(params, jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1), cfg)
+    return last[:, 0, :], {"k": cache_k, "v": cache_v, "pos": pos}
+
+
+def decode_step(params, cfg, cache: Dict[str, jax.Array], tokens: jax.Array,
+                active: jax.Array) -> Tuple[jax.Array, Dict]:
+    """One token for every slot.  ``tokens [B]`` are each slot's last
+    emitted token, written at ``pos`` then attended; ``active [B]`` bool
+    gates the position advance.  Returns ``(logits [B, V], cache)``."""
+    fam = family_of(cfg)
+    pos = cache["pos"]
+    x = _embed(params, tokens[:, None], cfg, pos[:, None])  # [B, 1, D]
+
+    if fam == "gpt2":
+        def body(h, xs):
+            p, k_l, v_l = xs
+            h, (k_l, v_l) = _gpt2_block(h, p, cfg, cache_kv=(k_l, v_l), pos=pos)
+            return h, (k_l, v_l)
+    else:
+        positions = pos[:, None]  # [B, 1] per-slot rope offsets
+        def body(h, xs):
+            p, k_l, v_l = xs
+            h, (k_l, v_l) = _llama_block(
+                h, p, cfg, positions, cache_kv=(k_l, v_l), pos=pos)
+            return h, (k_l, v_l)
+
+    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    logits = _unembed(params, x, cfg)[:, 0, :]
+    return logits, {
+        "k": ks, "v": vs,
+        "pos": pos + active.astype(jnp.int32),
+    }
+
+
+def sample_logits(logits: jax.Array, key: jax.Array, *, temperature: float = 0.0,
+                  top_k: int = 0) -> jax.Array:
+    """Greedy (temperature 0) or temperature/top-k categorical sampling."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def decode_chunk(params, cfg, cache, tokens, active, key, *, steps: int,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_id: Optional[int] = None):
+    """Run ``steps`` decode+sample iterations in one device computation.
+    Returns ``(emitted [B, steps], cache, active, key)``.  A slot that
+    emits ``eos_id`` flips inactive mid-chunk (its pos freezes)."""
+
+    def step(carry, _):
+        cache, toks, act, k = carry
+        k, sub = jax.random.split(k)
+        logits, cache = decode_step(params, cfg, cache, toks, act)
+        nxt = sample_logits(logits, sub, temperature=temperature, top_k=top_k)
+        nxt = jnp.where(act, nxt, toks)
+        if eos_id is not None:
+            act = act & (nxt != eos_id)
+        return (cache, nxt, act, k), nxt
+
+    (cache, _, active, key), emitted = lax.scan(
+        step, (cache, tokens, active, key), None, length=steps)
+    return emitted.T, cache, active, key  # [B, steps]
+
+
+def generate(params, cfg, prompts: jax.Array, lengths: jax.Array, *,
+             max_new_tokens: int, key: Optional[jax.Array] = None,
+             temperature: float = 0.0, top_k: int = 0,
+             eos_id: Optional[int] = None) -> jax.Array:
+    """One-shot batched generation (prefill + fused decode loop).  Returns
+    ``[B, max_new_tokens]`` generated tokens (post-EOS positions repeat the
+    EOS token).  For the serving path use :mod:`ray_tpu.serve.llm`, which
+    runs the same kernels under iteration-level continuous batching."""
+    B, Tp = prompts.shape
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    cache = init_cache(cfg, B, Tp + max_new_tokens)
+    last_logits, cache = prefill(
+        params, cfg, prompts, lengths, cache, jnp.int32(0))
+    key, sub = jax.random.split(key)
+    first = sample_logits(last_logits, sub, temperature=temperature, top_k=top_k)
+    active = jnp.ones((B,), bool)
+    if eos_id is not None:
+        active = active & (first != eos_id)
+    rest, _, _, _ = decode_chunk(
+        params, cfg, cache, first, active, key,
+        steps=max_new_tokens - 1, temperature=temperature, top_k=top_k,
+        eos_id=eos_id)
+    return jnp.concatenate([first[:, None], rest], axis=1)

@@ -1,0 +1,329 @@
+"""LLM serving: iteration-level continuous batching over the KV-cache
+decode kernels, behind a Serve deployment.
+
+The reference's serving data plane stops at routing a request to a replica
+(``python/ray/serve/_private/router.py:221`` -> ``replica.py:250``); token
+generation is user code.  On TPU the generation loop IS the workload, so it
+is part of the framework here:
+
+- :class:`GenerationEngine` — Orca-style continuous batching: a fixed set
+  of cache slots, prompt prefills admitted into free slots, one fused
+  ``decode_chunk`` advancing every active slot per iteration.  New requests
+  join between chunks; finished slots free mid-stream.  All device
+  computations have static shapes (prompt buckets, fixed chunk length), so
+  everything compiles exactly once per bucket.
+- :func:`llm_deployment` — wraps the engine in a Serve deployment on a
+  ``num_tpus`` replica; requests block on a future the engine thread
+  resolves, so Serve's threaded replica concurrency (not the engine)
+  bounds in-flight requests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from functools import partial
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class _Request:
+    __slots__ = ("tokens", "max_new", "future", "emitted", "submitted_at")
+
+    def __init__(self, tokens: List[int], max_new: int):
+        self.tokens = list(tokens)
+        self.max_new = int(max_new)
+        self.future: Future = Future()
+        self.emitted: List[int] = []
+        self.submitted_at = time.perf_counter()
+
+
+class GenerationEngine:
+    """Continuous-batching decode engine over :mod:`ray_tpu.models.generate`.
+
+    One background thread owns the device state (cache, last tokens); the
+    public :meth:`submit` is thread-safe and returns a Future of the
+    generated token list.
+    """
+
+    def __init__(
+        self,
+        cfg,
+        params=None,
+        *,
+        n_slots: int = 4,
+        max_new_tokens: int = 128,
+        decode_chunk_steps: int = 16,
+        prefill_buckets: tuple = (32, 64, 128, 256),
+        temperature: float = 0.0,
+        top_k: int = 0,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ):
+        import jax
+        from ray_tpu.models import generate as gen
+
+        self._gen = gen
+        self.cfg = cfg
+        if params is None:
+            params = _default_init(cfg, seed)
+        self.params = params
+        self.n_slots = n_slots
+        self.max_new_tokens = max_new_tokens
+        self.chunk = decode_chunk_steps
+        self.buckets = tuple(sorted(prefill_buckets))
+        self.temperature = temperature
+        self.top_k = top_k
+        self.eos_id = eos_id
+
+        max_len = self.buckets[-1] + max_new_tokens + decode_chunk_steps
+        self.cache = gen.init_cache(cfg, n_slots, max_len)
+        self._key = jax.random.PRNGKey(seed)
+
+        # jitted kernels: one prefill per bucket (compiled lazily), one
+        # chunked decode.  cfg is closed over (hashable frozen dataclass).
+        self._prefill_jit = jax.jit(
+            lambda params, toks, lens, cache, slot: gen.prefill(
+                params, cfg, toks, lens, cache, slot),
+        )
+        self._decode_jit = jax.jit(
+            partial(
+                _decode_chunk_wrapper, gen, cfg,
+                steps=decode_chunk_steps, temperature=temperature,
+                top_k=top_k, eos_id=eos_id,
+            ),
+            donate_argnums=(1,),  # cache buffers reused in place
+        )
+        self._sample_jit = jax.jit(
+            lambda logits, key: gen.sample_logits(
+                logits, key, temperature=temperature, top_k=top_k))
+
+        self._slots: List[Optional[_Request]] = [None] * n_slots
+        self._last_tok = np.zeros((n_slots,), np.int32)
+        self._queue: List[_Request] = []
+        self._lock = threading.Lock()
+        self._work = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # serving metrics (Serve data-plane observability)
+        self.total_generated = 0
+        self.total_requests = 0
+
+    # -- public API ----------------------------------------------------
+    def submit(self, tokens: List[int], max_new: Optional[int] = None) -> Future:
+        if not tokens:
+            raise ValueError("empty prompt")
+        if len(tokens) > self.buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(tokens)} exceeds the largest prefill "
+                f"bucket {self.buckets[-1]}")
+        req = _Request(tokens, min(max_new or self.max_new_tokens,
+                                   self.max_new_tokens))
+        with self._lock:
+            self._queue.append(req)
+            self.total_requests += 1
+        self._work.set()
+        return req.future
+
+    def generate(self, tokens: List[int], max_new: Optional[int] = None,
+                 timeout: float = 300.0) -> List[int]:
+        return self.submit(tokens, max_new).result(timeout)
+
+    def start(self) -> "GenerationEngine":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="generation-engine")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "active_slots": sum(s is not None for s in self._slots),
+                "queued": len(self._queue),
+                "total_requests": self.total_requests,
+                "total_generated_tokens": self.total_generated,
+            }
+
+    # -- engine loop ---------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                worked = self.step()
+            except Exception as e:  # noqa: BLE001 — a kernel error (OOM,
+                # bad request shape) must fail the affected requests, not
+                # silently kill the engine thread and wedge the replica
+                with self._lock:
+                    victims = [s for s in self._slots if s is not None]
+                    victims += self._queue
+                    self._slots = [None] * self.n_slots
+                    self._queue.clear()
+                for req in victims:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                worked = False
+            if not worked:
+                self._work.wait(timeout=0.05)
+                self._work.clear()
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _admit(self) -> None:
+        """Prefill queued prompts into free slots (one at a time, B=1)."""
+        import jax.numpy as jnp
+
+        while True:
+            with self._lock:
+                free = next(
+                    (i for i, s in enumerate(self._slots) if s is None), None)
+                if free is None or not self._queue:
+                    return
+                req = self._queue.pop(0)
+                self._slots[free] = req
+            b = self._bucket(len(req.tokens))
+            toks = np.zeros((1, b), np.int32)
+            toks[0, :len(req.tokens)] = req.tokens
+            last_logits, self.cache = self._prefill_jit(
+                self.params, jnp.asarray(toks),
+                jnp.asarray([len(req.tokens)], np.int32),
+                self.cache, jnp.int32(free))
+            import jax
+
+            self._key, sub = jax.random.split(self._key)
+            first = int(self._sample_jit(last_logits, sub)[0])
+            req.emitted.append(first)
+            self._last_tok[free] = first
+            self._finish_if_done(free)
+
+    def _finish_if_done(self, i: int) -> None:
+        req = self._slots[i]
+        if req is None:
+            return
+        done = len(req.emitted) >= req.max_new or (
+            self.eos_id is not None and req.emitted
+            and req.emitted[-1] == self.eos_id)
+        if done:
+            self._slots[i] = None
+            self.total_generated += len(req.emitted)
+            req.future.set_result(req.emitted)
+
+    def step(self) -> bool:
+        """One engine iteration: admit + one decode chunk.  Returns True if
+        any work happened."""
+        import jax.numpy as jnp
+
+        self._admit()
+        with self._lock:
+            active_idx = [i for i, s in enumerate(self._slots) if s is not None]
+        if not active_idx:
+            return False
+        active = np.zeros((self.n_slots,), bool)
+        active[active_idx] = True
+        chunk, self.cache, _, self._key = self._decode_jit(
+            self.params, self.cache, jnp.asarray(self._last_tok),
+            jnp.asarray(active), self._key)
+        chunk = np.asarray(chunk)  # [B, steps] — the once-per-chunk sync
+        for i in active_idx:
+            req = self._slots[i]
+            for t in chunk[i]:
+                t = int(t)
+                req.emitted.append(t)
+                if len(req.emitted) >= req.max_new or t == self.eos_id:
+                    break
+            self._last_tok[i] = req.emitted[-1]
+            self._finish_if_done(i)
+        return True
+
+
+def _decode_chunk_wrapper(gen, cfg, params, cache, tokens, active, key, *,
+                          steps, temperature, top_k, eos_id):
+    return gen.decode_chunk(
+        params, cfg, cache, tokens, active, key, steps=steps,
+        temperature=temperature, top_k=top_k, eos_id=eos_id)
+
+
+def _default_init(cfg, seed: int):
+    import jax
+
+    from ray_tpu.models import generate as gen
+
+    fam = gen.family_of(cfg)
+    if fam == "gpt2":
+        from ray_tpu.models import gpt2 as m
+    else:
+        from ray_tpu.models import llama as m
+    return m.init(cfg, jax.random.PRNGKey(seed))
+
+
+def make_config(family: str = "gpt2", size: str = "tiny", **kw):
+    if family == "gpt2":
+        from ray_tpu.models.gpt2 import GPT2Config as C
+
+        return C.gpt2_small(**kw) if size in ("small", "125m") else C.tiny(**kw)
+    if family == "llama":
+        from ray_tpu.models.llama import LlamaConfig as C
+
+        return C.llama_125m(**kw) if size in ("small", "125m") else C.tiny(**kw)
+    raise ValueError(f"unknown model family {family!r}")
+
+
+def llm_deployment(
+    family: str = "gpt2",
+    size: str = "tiny",
+    *,
+    name: str = "llm",
+    num_replicas: int = 1,
+    num_tpus: float = 0,
+    engine_kwargs: Optional[Dict[str, Any]] = None,
+    config_kwargs: Optional[Dict[str, Any]] = None,
+    max_concurrent_queries: int = 64,
+):
+    """Build a Serve deployment serving token generation with continuous
+    batching (the ``num_tpus=1`` replica shape of BASELINE config 5, with
+    the engine replacing the plain forward)."""
+    from ray_tpu import serve
+
+    ekw = dict(engine_kwargs or {})
+    ckw = dict(config_kwargs or {})
+    actor_opts: Dict[str, Any] = {"max_concurrency": max_concurrent_queries}
+    if num_tpus:
+        actor_opts["num_tpus"] = num_tpus
+
+    @serve.deployment(
+        name=name,
+        num_replicas=num_replicas,
+        max_concurrent_queries=max_concurrent_queries,
+        ray_actor_options=actor_opts,
+    )
+    class LLMServer:
+        def __init__(self):
+            cfg = make_config(family, size, **ckw)
+            self.engine = GenerationEngine(cfg, **ekw).start()
+
+        def __call__(self, request):
+            """request: {"tokens": [int, ...], "max_new_tokens": int} ->
+            {"tokens": generated ids}.  Blocks this replica thread; the
+            engine interleaves all in-flight requests between chunks."""
+            if isinstance(request, (list, tuple)):
+                request = {"tokens": list(request)}
+            toks = self.engine.generate(
+                request["tokens"], request.get("max_new_tokens"))
+            return {"tokens": toks}
+
+        def stats(self):
+            return self.engine.stats()
+
+    return LLMServer

@@ -1,0 +1,95 @@
+"""LLM serving: continuous-batching engine + Serve deployment.
+
+Pins that iteration-level batching (requests admitted/freed mid-stream)
+reproduces one-shot generation exactly under greedy decoding, and that the
+engine works behind a Serve replica (the decode analog of the reference's
+``serve/_private/replica.py:250`` request path).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.models import generate as gen
+from ray_tpu.models.gpt2 import GPT2Config
+from ray_tpu.models import gpt2
+from ray_tpu.serve.llm import GenerationEngine, llm_deployment
+
+
+@pytest.fixture
+def serve_instance():
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    client = serve.start(serve.HTTPOptions(host="127.0.0.1", port=0))
+    yield client
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def _one_shot(params, cfg, prompt, n):
+    out = gen.generate(params, cfg, jnp.asarray([prompt]),
+                       jnp.asarray([len(prompt)]), max_new_tokens=n)
+    return [int(t) for t in out[0]]
+
+
+def test_engine_matches_one_shot_under_continuous_batching():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init(cfg, jax.random.PRNGKey(0))
+    eng = GenerationEngine(
+        cfg, params, n_slots=2, max_new_tokens=8, decode_chunk_steps=3,
+        prefill_buckets=(8, 16)).start()
+    try:
+        prompts = [[3, 17, 5], [9, 2], [11, 4, 7, 1], [6], [8, 8, 3, 2, 1]]
+        futs = [eng.submit(p, 8) for p in prompts]  # 5 requests, 2 slots
+        got = [f.result(timeout=120) for f in futs]
+    finally:
+        eng.stop()
+    for p, g in zip(prompts, got):
+        assert g == _one_shot(params, cfg, p, 8), f"prompt {p}"
+    assert eng.stats()["total_requests"] == 5
+
+
+def test_engine_eos_and_max_new():
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    params = gpt2.init(cfg, jax.random.PRNGKey(1))
+    ref = _one_shot(params, cfg, [5, 9, 2, 4], 12)
+    # EOS semantics: the stream stops at the FIRST occurrence of the eos
+    # value (tiny random models cycle quickly, so derive the expectation
+    # from wherever the chosen value first appears)
+    eos = ref[-1]
+    idx = ref.index(eos)
+    eng = GenerationEngine(
+        cfg, params, n_slots=1, max_new_tokens=12, decode_chunk_steps=5,
+        prefill_buckets=(8,), eos_id=eos).start()
+    try:
+        out = eng.generate([5, 9, 2, 4], timeout=120)
+    finally:
+        eng.stop()
+    assert out == ref[:idx + 1]  # stops AT the eos token
+    # max_new cutoff
+    eng2 = GenerationEngine(
+        cfg, params, n_slots=1, max_new_tokens=3, decode_chunk_steps=5,
+        prefill_buckets=(8,)).start()
+    try:
+        out2 = eng2.generate([5, 9, 2, 4], timeout=120)
+    finally:
+        eng2.stop()
+    assert out2 == ref[:3]
+
+
+def test_llm_deployment_behind_serve(serve_instance):
+    dep = llm_deployment(
+        "gpt2", "tiny",
+        engine_kwargs=dict(n_slots=2, max_new_tokens=6,
+                           decode_chunk_steps=3, prefill_buckets=(8,)),
+        config_kwargs=dict(dtype=jnp.float32),
+    )
+    handle = serve.run(dep.bind(), port=0)
+    refs = [handle.remote({"tokens": [3, 5, 7], "max_new_tokens": 6})
+            for _ in range(4)]
+    outs = ray_tpu.get(refs, timeout=300)
+    assert all(o == outs[0] for o in outs)  # greedy: identical prompts agree
+    assert len(outs[0]["tokens"]) == 6
+    stats = ray_tpu.get(handle.stats.remote(), timeout=60)
+    assert stats["total_requests"] == 4
