@@ -78,13 +78,20 @@ def _decode_attend(q, k_cache, v_cache, pos) -> jax.Array:
     KV = k_cache.shape[1]
     S = k_cache.shape[2]
     q = q.reshape(B, KV, H // KV, dh)
+    # keep the cache reads in bf16 (f32 accumulation via
+    # preferred_element_type) — upcasting the whole cache each step would
+    # double the dominant HBM traffic of decode
     scores = jnp.einsum(
-        "bkgd,bksd->bkgs", q.astype(jnp.float32), k_cache.astype(jnp.float32)
+        "bkgd,bksd->bkgs", q, k_cache.astype(q.dtype),
+        preferred_element_type=jnp.float32,
     ) / (dh ** 0.5)
     mask = jnp.arange(S)[None, None, None, :] <= pos[:, None, None, None]
     scores = jnp.where(mask, scores, -1e30)
     w = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgs,bksd->bkgd", w, v_cache.astype(jnp.float32))
+    out = jnp.einsum(
+        "bkgs,bksd->bkgd", w.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
     return out.reshape(B, H, 1, dh)
 
 
@@ -180,12 +187,14 @@ def _unembed(params, x, cfg):
     return (x @ w.T.astype(cfg.dtype)).astype(jnp.float32)
 
 
-def prefill(params, cfg, tokens: jax.Array, lengths: jax.Array,
-            cache: Dict[str, jax.Array], slot: jax.Array) -> Tuple[jax.Array, Dict]:
-    """Run the prompt ``tokens [B, Tp]`` (right-padded; true lengths
-    ``lengths [B]``) and write K/V into cache slots ``slot + [0..B)``.
-    Returns ``(last_logits [B, V], cache)``.  Positions are 0..Tp-1, so a
-    slot must be prefilled from scratch (pos resets to ``lengths``)."""
+def prefill_at(params, cfg, tokens: jax.Array, lengths: jax.Array,
+               cache: Dict[str, jax.Array], slots: jax.Array) -> Tuple[jax.Array, Dict]:
+    """Run the prompts ``tokens [B, Tp]`` (right-padded; true lengths
+    ``lengths [B]``) and write K/V into cache slots ``slots [B]`` (any
+    subset — one compiled program admits a whole batch of requests, which
+    matters when each device dispatch pays tunnel latency).  Returns
+    ``(last_logits [B, V], cache)``.  Positions are 0..Tp-1, so a slot must
+    be prefilled from scratch (pos resets to ``lengths``)."""
     fam = family_of(cfg)
     B, Tp = tokens.shape
     positions = jnp.arange(Tp)
@@ -201,15 +210,21 @@ def prefill(params, cfg, tokens: jax.Array, lengths: jax.Array,
             return h, kv
 
     x, (ks, vs) = lax.scan(body, x, params["blocks"])  # ks [L, B, KV, Tp, dh]
-    cache_k = lax.dynamic_update_slice(
-        cache["k"], ks.astype(cache["k"].dtype), (0, slot, 0, 0, 0))
-    cache_v = lax.dynamic_update_slice(
-        cache["v"], vs.astype(cache["v"].dtype), (0, slot, 0, 0, 0))
-    pos = lax.dynamic_update_slice(
-        cache["pos"], lengths.astype(jnp.int32), (slot,))
+    # single advanced index keeps its axis position: one scatter per tensor
+    cache_k = cache["k"].at[:, slots, :, :Tp, :].set(ks.astype(cache["k"].dtype))
+    cache_v = cache["v"].at[:, slots, :, :Tp, :].set(vs.astype(cache["v"].dtype))
+    pos = cache["pos"].at[slots].set(lengths.astype(jnp.int32))
     last = _unembed(params, jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1), cfg)
     return last[:, 0, :], {"k": cache_k, "v": cache_v, "pos": pos}
+
+
+def prefill(params, cfg, tokens: jax.Array, lengths: jax.Array,
+            cache: Dict[str, jax.Array], slot: jax.Array) -> Tuple[jax.Array, Dict]:
+    """:func:`prefill_at` with contiguous slots ``slot + [0..B)``."""
+    B = tokens.shape[0]
+    return prefill_at(params, cfg, tokens, lengths, cache,
+                      slot + jnp.arange(B, dtype=jnp.int32))
 
 
 def decode_step(params, cfg, cache: Dict[str, jax.Array], tokens: jax.Array,

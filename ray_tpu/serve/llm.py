@@ -69,7 +69,16 @@ class GenerationEngine:
         self.cfg = cfg
         if params is None:
             params = _default_init(cfg, seed)
-        self.params = params
+        # inference-only params: pre-cast master f32 weights to the compute
+        # dtype ONCE — the per-step .astype inside the blocks otherwise
+        # re-reads the f32 copy every decode step (2x the HBM traffic of
+        # the weights, which is the whole cost of a decode step)
+        import jax.numpy as jnp
+
+        self.params = jax.tree.map(
+            lambda x: x.astype(cfg.dtype)
+            if hasattr(x, "dtype") and x.dtype == jnp.float32 else x,
+            params)
         self.n_slots = n_slots
         self.max_new_tokens = max_new_tokens
         self.chunk = decode_chunk_steps
@@ -79,14 +88,19 @@ class GenerationEngine:
         self.eos_id = eos_id
 
         max_len = self.buckets[-1] + max_new_tokens + decode_chunk_steps
-        self.cache = gen.init_cache(cfg, n_slots, max_len)
+        # one extra SCRATCH slot (index n_slots): batched admission pads
+        # the prefill batch to a bucketed size and parks the padding rows
+        # there, so admitting 1..n_slots requests costs ONE device dispatch
+        # (each dispatch pays full tunnel latency on a remote-attached chip)
+        self.cache = gen.init_cache(cfg, n_slots + 1, max_len)
         self._key = jax.random.PRNGKey(seed)
 
-        # jitted kernels: one prefill per bucket (compiled lazily), one
-        # chunked decode.  cfg is closed over (hashable frozen dataclass).
+        # jitted kernels: one prefill per (bucket, batch-size) pair
+        # (compiled lazily), one chunked decode.  cfg is closed over
+        # (hashable frozen dataclass).
         self._prefill_jit = jax.jit(
-            lambda params, toks, lens, cache, slot: gen.prefill(
-                params, cfg, toks, lens, cache, slot),
+            lambda params, toks, lens, cache, slots: gen.prefill_at(
+                params, cfg, toks, lens, cache, slots),
         )
         self._decode_jit = jax.jit(
             partial(
@@ -101,7 +115,7 @@ class GenerationEngine:
                 logits, key, temperature=temperature, top_k=top_k))
 
         self._slots: List[Optional[_Request]] = [None] * n_slots
-        self._last_tok = np.zeros((n_slots,), np.int32)
+        self._last_tok = np.zeros((n_slots + 1,), np.int32)
         self._queue: List[_Request] = []
         self._lock = threading.Lock()
         self._work = threading.Event()
@@ -182,31 +196,42 @@ class GenerationEngine:
         return self.buckets[-1]
 
     def _admit(self) -> None:
-        """Prefill queued prompts into free slots (one at a time, B=1)."""
+        """Prefill queued prompts into ALL free slots with one device call
+        (batch padded to a fixed n_slots width; padding rows target the
+        scratch slot)."""
+        import jax
         import jax.numpy as jnp
 
-        while True:
-            with self._lock:
-                free = next(
-                    (i for i, s in enumerate(self._slots) if s is None), None)
-                if free is None or not self._queue:
-                    return
-                req = self._queue.pop(0)
-                self._slots[free] = req
-            b = self._bucket(len(req.tokens))
-            toks = np.zeros((1, b), np.int32)
-            toks[0, :len(req.tokens)] = req.tokens
-            last_logits, self.cache = self._prefill_jit(
-                self.params, jnp.asarray(toks),
-                jnp.asarray([len(req.tokens)], np.int32),
-                self.cache, jnp.int32(free))
-            import jax
-
-            self._key, sub = jax.random.split(self._key)
-            first = int(self._sample_jit(last_logits, sub)[0])
-            req.emitted.append(first)
-            self._last_tok[free] = first
-            self._finish_if_done(free)
+        with self._lock:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+            take = min(len(free), len(self._queue))
+            if take == 0:
+                return
+            batch = [(free[j], self._queue.pop(0)) for j in range(take)]
+            for slot, req in batch:
+                self._slots[slot] = req
+        b = self._bucket(max(len(r.tokens) for _, r in batch))
+        # fixed admission width = n_slots: ONE compiled prefill program per
+        # prompt bucket (variable widths recompiled mid-serving, which cost
+        # far more than the padded rows' wasted FLOPs)
+        n = self.n_slots
+        toks = np.zeros((n, b), np.int32)
+        toks[:, 0] = 1  # padding rows: 1-token dummy prompt
+        lens = np.ones((n,), np.int32)
+        slots = np.full((n,), self.n_slots, np.int32)  # scratch slot
+        for j, (slot, req) in enumerate(batch):
+            toks[j, :len(req.tokens)] = req.tokens
+            lens[j] = len(req.tokens)
+            slots[j] = slot
+        last_logits, self.cache = self._prefill_jit(
+            self.params, jnp.asarray(toks), jnp.asarray(lens),
+            self.cache, jnp.asarray(slots))
+        self._key, sub = jax.random.split(self._key)
+        firsts = np.asarray(self._sample_jit(last_logits, sub))
+        for j, (slot, req) in enumerate(batch):
+            req.emitted.append(int(firsts[j]))
+            self._last_tok[slot] = req.emitted[-1]
+            self._finish_if_done(slot)
 
     def _finish_if_done(self, i: int) -> None:
         req = self._slots[i]
@@ -230,7 +255,7 @@ class GenerationEngine:
             active_idx = [i for i, s in enumerate(self._slots) if s is not None]
         if not active_idx:
             return False
-        active = np.zeros((self.n_slots,), bool)
+        active = np.zeros((self.n_slots + 1,), bool)  # scratch stays inactive
         active[active_idx] = True
         chunk, self.cache, _, self._key = self._decode_jit(
             self.params, self.cache, jnp.asarray(self._last_tok),
