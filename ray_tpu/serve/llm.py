@@ -101,6 +101,7 @@ class GenerationEngine:
         self._prefill_jit = jax.jit(
             lambda params, toks, lens, cache, slots: gen.prefill_at(
                 params, cfg, toks, lens, cache, slots),
+            donate_argnums=(3,),  # scatter into the cache in place
         )
         self._decode_jit = jax.jit(
             partial(

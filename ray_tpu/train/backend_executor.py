@@ -97,7 +97,15 @@ class BackendExecutor:
                 for i in pending
             }
             for i, f in futs.items():
-                kind, payload, ckpt = ray_tpu.get(f, timeout=60)
+                try:
+                    kind, payload, ckpt = ray_tpu.get(f, timeout=60)
+                except ray_tpu.exceptions.RayError as e:
+                    # worker PROCESS death (RayActorError/WorkerCrashed) is
+                    # a gang failure exactly like an in-loop exception —
+                    # fit()'s whole-gang restart must see one error type
+                    raise TrainingFailedError(
+                        f"worker {i} died: {type(e).__name__}: {e}"
+                    ) from e
                 if kind == "pending":
                     continue
                 if kind == "error":

@@ -16,6 +16,8 @@ import tempfile
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+import ray_tpu
+
 from ray_tpu.air import (
     Checkpoint,
     CheckpointConfig,
@@ -141,7 +143,11 @@ class DataParallelTrainer(BaseTrainer):
                     path=storage,
                     best_checkpoints=manager.ranked(),
                 )
-            except TrainingFailedError as e:
+            except (TrainingFailedError, ray_tpu.exceptions.RayActorError,
+                    ray_tpu.exceptions.WorkerCrashedError) as e:
+                # worker DEATH during backend setup / rendezvous (before any
+                # result flows) is the same gang failure as an in-loop one;
+                # permanent failures (scheduling timeouts etc.) still raise
                 failures += 1
                 if failures > failure_cfg.max_failures:
                     return Result(metrics=None, checkpoint=latest_ckpt,
