@@ -288,6 +288,10 @@ class CoreClient:
     def remove_refs(self, oids: List[bytes]) -> None:
         self.send({"type": "remove_ref", "oids": oids})
 
+    def broadcast(self, oid: bytes, timeout: float = 120.0) -> dict:
+        return self.request({"type": "broadcast", "oid": oid,
+                             "timeout": timeout}, timeout=timeout + 60)["value"]
+
     def create_pg(self, spec: dict) -> None:
         self.send({"type": "create_pg", "spec": spec})
 

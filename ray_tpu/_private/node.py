@@ -245,6 +245,9 @@ class _PendingGet:
     # waiter's full oid list — the old path was O(waiters x oids) per seal)
     unsealed: Any = None  # set[bytes]
     done: bool = False
+    # consumer's node ("" = head) — location replies pick the copy nearest
+    # to it (location-set pull spreading)
+    node_id: str = ""
 
 
 class Node:
@@ -368,6 +371,8 @@ class Node:
         self._dep_blocked_actors: set = set()
         # workers with queued outbox messages awaiting a flush
         self._outbox_pending: set = set()
+        # broadcast fan-out acks: token -> {"event", "ok", "error"}
+        self._pull_acks: Dict[str, dict] = {}
 
         total, tpus = autodetect_resources(num_cpus, num_tpus, resources)
         self._head_node_id = "node-head"
@@ -652,6 +657,12 @@ class Node:
                             ns = self.nodes.get(agent_node_id)
                             if ns is not None:
                                 ns.last_heartbeat = time.time()
+                elif mtype == "object_pulled":
+                    holder = self._pull_acks.pop(msg.get("token"), None)
+                    if holder is not None:
+                        holder["ok"] = bool(msg.get("ok"))
+                        holder["error"] = msg.get("error")
+                        holder["event"].set()
                 else:
                     self._handle_message(conn, handle, msg)
         finally:
@@ -717,8 +728,14 @@ class Node:
         "num_returns", "return_ids", "trace_ctx",
     )
 
+    def _agent_node_or_head(self, node_id: str) -> str:
+        """Normalize a consumer's node for location selection: emulated /
+        head-local nodes share the head's shm namespace, so they read as
+        the head ("")."""
+        ns = self.nodes.get(node_id)
+        return node_id if ns is not None and ns.agent_conn is not None else ""
+
     def _queue_execute(self, w: WorkerHandle, spec: dict,
-                       dep_locs: Dict[bytes, ObjectLocation],
                        tpu_ids: List[int]) -> None:
         """Queue an execute message for ``w`` (node lock held).  The actual
         pipe write happens in _flush_sends, outside the lock; per-worker
@@ -726,6 +743,7 @@ class Node:
         spec_wire = {k: spec[k] for k in self._EXEC_KEYS
                      if spec.get(k) is not None}
         msg = {"type": "execute", "spec": spec_wire}
+        dep_locs = self._dep_locations(spec, self._agent_node_or_head(w.node_id))
         if dep_locs:
             msg["dep_locs"] = dep_locs
         if tpu_ids:
@@ -925,6 +943,13 @@ class Node:
         elif mtype == "list_state":
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
                                "value": self._list_state(msg["what"], msg.get("limit", 1000))})
+        elif mtype == "replica_added":
+            self._on_replica_added(worker, msg)
+        elif mtype == "broadcast":
+            # fan-out takes seconds for big objects — never on a reader thread
+            threading.Thread(
+                target=self._on_broadcast, args=(conn, msg), daemon=True
+            ).start()
         elif mtype == "metrics_report":
             self.worker_metrics_registry.merge(msg["origin"], msg["metrics"])
         elif mtype == "log":
@@ -1189,6 +1214,82 @@ class Node:
             if self.pending_tasks or self.pending_pgs:
                 self._wake_scheduler()
 
+    def _on_replica_added(self, worker: Optional[WorkerHandle], msg: dict) -> None:
+        """A consumer finished pulling a copy onto its node — extend the
+        object's location set (only real agent nodes count; emulated nodes
+        share the head's shm namespace)."""
+        if worker is None:
+            return
+        with self.lock:
+            ns = self.nodes.get(worker.node_id)
+            if ns is None or ns.agent_conn is None or ns.fetch_addr is None:
+                return
+            addr = tuple(ns.fetch_addr)
+        self.registry.add_replica(msg["oid"], worker.node_id, addr)
+
+    def _on_broadcast(self, conn: Connection, msg: dict) -> None:
+        n_ok, err = self._broadcast_object(
+            msg["oid"], timeout=msg.get("timeout", 120.0))
+        self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                           "value": {"replicas": n_ok, "error": err}})
+
+    def _broadcast_object(self, oid: bytes, timeout: float = 120.0):
+        """Proactively replicate ``oid``'s payload to every alive agent node
+        (PushManager analog, ``src/ray/object_manager/push_manager.h:29``)
+        with doubling fan-out: each completed copy becomes a source for the
+        next wave, so N nodes take O(log N) waves of the origin's bandwidth
+        instead of N pulls from one server."""
+        loc = self.registry.wait_sealed_existing(oid, min(30.0, timeout))
+        if loc in (None, "missing"):
+            return 0, f"object not available ({'unknown' if loc == 'missing' else 'timeout'})"
+        if loc.inline is not None or not loc.shm_name or not loc.fetch_addr:
+            return 0, None  # inline/spilled payloads ride messages instead
+        existing = set(self.registry.replica_nodes(oid))
+        with self.lock:
+            targets = [
+                ns for ns in self.nodes.values()
+                if ns.alive and ns.agent_conn is not None and ns.fetch_addr
+                and ns.node_id != loc.node_id and ns.node_id not in existing
+            ]
+        origin_arena = (loc.arena_path, loc.arena_off) if loc.arena_path else None
+        sources = [(tuple(loc.fetch_addr), origin_arena)]
+        n_ok, err = 0, None
+        pending = list(targets)
+        deadline = time.monotonic() + timeout  # ONE budget across all waves
+        while pending:
+            wave, pending = pending[:len(sources)], pending[len(sources):]
+            acks = []
+            for i, ns in enumerate(wave):
+                addr, arena = sources[i % len(sources)]
+                token = os.urandom(8).hex()
+                holder = {"event": threading.Event(), "ok": False, "error": None}
+                self._pull_acks[token] = holder
+                try:
+                    ns.agent_send({
+                        "type": "pull_object", "name": loc.shm_name,
+                        "size": loc.size, "addr": addr, "arena": arena,
+                        "token": token,
+                    })
+                except (OSError, ValueError):
+                    self._pull_acks.pop(token, None)
+                    err = f"send to {ns.node_id} failed"
+                    continue
+                acks.append((ns, token, holder))
+            for ns, token, holder in acks:
+                remaining = deadline - time.monotonic()
+                if remaining > 0 and holder["event"].wait(remaining) and holder["ok"]:
+                    self.registry.add_replica(oid, ns.node_id, ns.fetch_addr)
+                    sources.append((tuple(ns.fetch_addr), None))
+                    n_ok += 1
+                else:
+                    self._pull_acks.pop(token, None)
+                    err = holder["error"] or "broadcast timed out"
+            if time.monotonic() >= deadline:
+                if pending:
+                    err = err or "broadcast timed out"
+                break
+        return n_ok, err
+
     def _release_spec_pins(self, spec: dict) -> None:
         """Release a task spec's argument pins (idempotent — pops the
         lists).  The pins were counted by the submitting client at
@@ -1235,6 +1336,7 @@ class Node:
             conn_send=lambda m: self._reply(conn, m),
             oids=oids,
             deadline=deadline,
+            node_id=self._agent_node_or_head(worker.node_id) if worker else "",
         ))
 
     def _on_wait_request(self, conn: Connection, msg: dict, worker: Optional[WorkerHandle]) -> None:
@@ -1247,6 +1349,7 @@ class Node:
             deadline=deadline,
             kind="wait",
             num_returns=msg["num_returns"],
+            node_id=self._agent_node_or_head(worker.node_id) if worker else "",
         ))
 
     def _try_complete(self, pg: _PendingGet, now: float) -> Optional[dict]:
@@ -1255,7 +1358,8 @@ class Node:
         expired = pg.deadline is not None and now >= pg.deadline
         if pg.kind == "get":
             if not pg.unsealed:
-                locs = {oid: self.registry.get_location(oid) for oid in pg.oids}
+                locs = {oid: self.registry.get_location(oid, prefer_node=pg.node_id)
+                        for oid in pg.oids}
                 if any(v is None for v in locs.values()):
                     # an oid un-sealed again (node loss between seal and
                     # completion): recompute and keep waiting
@@ -1269,7 +1373,7 @@ class Node:
                             return {"type": "reply", "req_id": pg.req_id,
                                     "timeout": True}
                         return None
-                    locs = {oid: self.registry.get_location(oid)
+                    locs = {oid: self.registry.get_location(oid, prefer_node=pg.node_id)
                             for oid in pg.oids}
                 return {"type": "reply", "req_id": pg.req_id, "locations": locs}
             if expired:
@@ -1287,7 +1391,8 @@ class Node:
                 for oid in pg.unsealed:
                     self._get_waiters.setdefault(oid, []).append(pg)
                 return None
-            locs = {oid: self.registry.get_location(oid) for oid in sealed}
+            locs = {oid: self.registry.get_location(oid, prefer_node=pg.node_id)
+                    for oid in sealed}
             return {"type": "reply", "req_id": pg.req_id,
                     "ready": sealed, "locations": locs}
         return None
@@ -1484,8 +1589,9 @@ class Node:
     def _deps_ready(self, spec: dict) -> bool:
         return all(self.registry.is_sealed(d) for d in spec.get("dep_ids", []))
 
-    def _dep_locations(self, spec: dict) -> Dict[bytes, ObjectLocation]:
-        return {d: self.registry.get_location(d) for d in spec.get("dep_ids", [])}
+    def _dep_locations(self, spec: dict, node_id: str = "") -> Dict[bytes, ObjectLocation]:
+        return {d: self.registry.get_location(d, prefer_node=node_id)
+                for d in spec.get("dep_ids", [])}
 
     def _select_node(self, spec: dict) -> Optional[Tuple[NodeState, Optional[BundleRuntime]]]:
         """Hybrid pack/spread node selection (HybridSchedulingPolicy analog)."""
@@ -1898,7 +2004,7 @@ class Node:
         if ti:
             ti.state = "RUNNING"
             ti.node_id = ns.node_id
-        self._queue_execute(w, spec, self._dep_locations(spec), tpu_ids)
+        self._queue_execute(w, spec, tpu_ids)
 
     def _release_task_resources(self, rt: dict) -> None:
         with self.lock:
@@ -2063,7 +2169,7 @@ class Node:
             if ti:
                 ti.state = "RUNNING"
                 ti.node_id = ns.node_id
-            self._queue_execute(w, spec, self._dep_locations(spec), [])
+            self._queue_execute(w, spec, [])
 
     # ------------------------------------------------------------------
     # actors (GcsActorManager FSM analog)
@@ -2168,9 +2274,7 @@ class Node:
                         w.state = "busy"
                         spec = art.info.creation_spec
                         w.current_task = spec
-                        self._queue_execute(
-                            w, spec, self._dep_locations(spec), art.tpu_ids
-                        )
+                        self._queue_execute(w, spec, art.tpu_ids)
                         art.info.state = "STARTING"
                 elif art.info.state == "ALIVE":
                     self._dispatch_actor_next_locked(art)
@@ -2199,7 +2303,7 @@ class Node:
                 break
             art.queue.popleft()
             art.inflight[spec["task_id"]] = spec
-            self._queue_execute(w, spec, self._dep_locations(spec), art.tpu_ids)
+            self._queue_execute(w, spec, art.tpu_ids)
 
     def _on_actor_started(self, spec: dict, w: WorkerHandle, failed: bool, error: Optional[str]) -> None:
         with self.lock:

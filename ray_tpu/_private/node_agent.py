@@ -128,12 +128,35 @@ class NodeAgent:
             from ray_tpu._private.shm import ShmSegment
 
             ShmSegment.unlink(msg["name"])
+        elif mtype == "pull_object":
+            # broadcast fan-out: fetch a copy into this node's namespace
+            # (transfers take seconds — never on the agent's control loop)
+            threading.Thread(
+                target=self._pull_object, args=(msg,), daemon=True
+            ).start()
         elif mtype == "shutdown":
             self._shutdown = True
         elif mtype == "ping":
             self._send({"type": "pong", "ts": msg.get("ts")})
         else:
             logger.warning("agent: unknown message %s", mtype)
+
+    def _pull_object(self, msg: dict) -> None:
+        from ray_tpu._private.object_transfer import pull_object
+
+        try:
+            pull_object(
+                msg["name"], tuple(msg["addr"]), msg.get("size", -1),
+                arena=tuple(msg["arena"]) if msg.get("arena") else None,
+            )
+            ok, error = True, None
+        except Exception as e:  # noqa: BLE001 — the head needs the nack
+            ok, error = False, f"{type(e).__name__}: {e}"
+        try:
+            self._send({"type": "object_pulled", "token": msg.get("token"),
+                        "ok": ok, "error": error})
+        except (OSError, ValueError):
+            pass
 
     # -- worker management ------------------------------------------------
     def _spawn_worker(self, msg: dict) -> None:

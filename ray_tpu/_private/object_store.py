@@ -86,6 +86,12 @@ class _Entry:
     ref_count: int = 1
     contained: List[bytes] = field(default_factory=list)
     last_access: float = field(default_factory=time.monotonic)
+    # location SET (ownership_based_object_directory.h:37 analog): nodes
+    # holding a pulled copy of the payload, node_id -> object-server addr.
+    # Sources for future pulls; survivors when the origin node dies.
+    replicas: Dict[str, tuple] = field(default_factory=dict)
+    # round-robin cursor over {origin} + replicas for pull load-spreading
+    rr: int = 0
 
 
 # Objects touched within this window are not spill candidates — closes the
@@ -196,7 +202,19 @@ class ObjectRegistry:
             for oid, e in list(self._objects.items()):
                 if oid not in self._objects:
                     continue  # deleted by an earlier iteration's ref drop
+                e.replicas.pop(node_id, None)
                 if e.loc is not None and e.loc.node_id == node_id:
+                    if e.replicas:
+                        # a surviving copy exists: promote it to primary —
+                        # no un-seal, no lineage reconstruction (the payoff
+                        # of the location set)
+                        nid, addr = next(iter(e.replicas.items()))
+                        del e.replicas[nid]
+                        e.loc = ObjectLocation(
+                            shm_name=e.loc.shm_name, size=e.loc.size,
+                            is_error=e.loc.is_error, node_id=nid,
+                            fetch_addr=tuple(addr))
+                        continue
                     # drop contained-ref increments this payload made; a
                     # successful re-seal will re-add them
                     for c in e.contained:
@@ -242,13 +260,61 @@ class ObjectRegistry:
         e.last_access = time.monotonic()
         return e.loc
 
-    def get_location(self, oid: bytes) -> Optional[ObjectLocation]:
+    def get_location(self, oid: bytes,
+                     prefer_node: Optional[str] = None) -> Optional[ObjectLocation]:
+        """Location for a consumer.  ``prefer_node`` is the consumer's node
+        ("" = head / emulated): a copy on the consumer's own node wins
+        (zero-copy attach); otherwise the pull source round-robins across
+        origin + replicas (the location-set payoff: reads spread over every
+        node holding a copy)."""
         with self._lock:
             e = self._objects.get(oid)
             if e is None or not e.sealed.is_set():
                 return None
             e.last_access = time.monotonic()
-            return e.loc
+            loc = e.loc
+            if not (e.replicas and loc is not None and loc.shm_name
+                    and loc.fetch_addr):
+                return loc
+            origin_node = loc.node_id or ""
+            if prefer_node is not None:
+                if prefer_node == origin_node:
+                    return loc  # own-node origin (incl. head arena payloads)
+                if prefer_node in e.replicas:
+                    return self._replica_loc(loc, prefer_node,
+                                             e.replicas[prefer_node])
+            sources = [(origin_node, loc.fetch_addr)] + list(e.replicas.items())
+            nid, addr = sources[e.rr % len(sources)]
+            e.rr += 1
+            if nid == origin_node:
+                return loc
+            return self._replica_loc(loc, nid, addr)
+
+    @staticmethod
+    def _replica_loc(loc: ObjectLocation, node_id: str, addr) -> ObjectLocation:
+        # replicas are plain files — no arena fields
+        return ObjectLocation(
+            shm_name=loc.shm_name, size=loc.size, is_error=loc.is_error,
+            node_id=node_id, fetch_addr=tuple(addr))
+
+    def add_replica(self, oid: bytes, node_id: str, fetch_addr) -> None:
+        """Record that ``node_id`` now holds a pulled copy (location-set
+        update; reported by consumers after a successful pull or by the
+        broadcast fan-out)."""
+        if not node_id or not fetch_addr:
+            return
+        with self._lock:
+            e = self._objects.get(oid)
+            if (
+                e is not None and e.loc is not None and e.loc.shm_name
+                and node_id != e.loc.node_id
+            ):
+                e.replicas[node_id] = tuple(fetch_addr)
+
+    def replica_nodes(self, oid: bytes) -> List[str]:
+        with self._lock:
+            e = self._objects.get(oid)
+            return list(e.replicas) if e is not None else []
 
     # -- reference counting --------------------------------------------
     def add_ref(self, oid: bytes, n: int = 1) -> None:
@@ -362,9 +428,16 @@ class ObjectRegistry:
                     continue  # deleted concurrently
                 e2.loc.shm_name = None
                 e2.loc.spilled_path = path
+                had_replicas = bool(e2.replicas)
+                e2.replicas.clear()
                 self._bytes_used -= size
                 self._num_spilled += 1
             ShmSegment.unlink(shm_name)
+            if had_replicas and self.broadcast_unlink is not None:
+                # replica copies share the segment name on other nodes;
+                # after the spill nothing would ever reap them (delete only
+                # sees the spilled file) — unlink them with the original
+                self.broadcast_unlink(shm_name)
 
     # -- admin ---------------------------------------------------------
     def list_objects(self, limit: int = 1000) -> List[dict]:
@@ -616,6 +689,21 @@ def payload_bytes(loc: ObjectLocation) -> bytes:
     return bytes(seg.buf)
 
 
+def _report_replica(oid: Optional[bytes]) -> None:
+    """Tell the head this node now holds a copy (location-set update; the
+    head records it only for real agent nodes)."""
+    if oid is None:
+        return
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        client = global_worker.client
+        if client is not None and not client.closed:
+            client.send({"type": "replica_added", "oid": oid})
+    except Exception:
+        pass  # best-effort: the directory just misses one source
+
+
 def read_value(loc: ObjectLocation, oid: Optional[bytes] = None) -> Any:
     """Deserialize an object from its location (zero-copy for shm payloads;
     spilled objects are read back from disk; remote segments are pulled
@@ -657,6 +745,7 @@ def read_value(loc: ObjectLocation, oid: Optional[bytes] = None) -> Any:
                 loc.shm_name, loc.fetch_addr, loc.size,
                 arena=(loc.arena_path, loc.arena_off),
             )
+            _report_replica(oid)
             seg = ShmSegment.attach(loc.shm_name, loc.size)
             with _ATTACHED_LOCK:
                 seg = _ATTACHED.setdefault(loc.shm_name, seg)
@@ -673,6 +762,7 @@ def read_value(loc: ObjectLocation, oid: Optional[bytes] = None) -> Any:
                 from ray_tpu._private import object_transfer
 
                 object_transfer.pull_object(loc.shm_name, loc.fetch_addr, loc.size)
+                _report_replica(oid)
                 seg = ShmSegment.attach(loc.shm_name, loc.size)
             with _ATTACHED_LOCK:
                 seg = _ATTACHED.setdefault(loc.shm_name, seg)

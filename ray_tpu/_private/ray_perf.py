@@ -192,6 +192,34 @@ def main(quick: bool = False) -> List[Dict]:
         timeit("wait_8_ready", do_wait, min_time_s=min_t, results=results)
     finally:
         ray_tpu.shutdown()
+
+    # ---------------------------------------------------- broadcast (1->N)
+    # real-process 2-agent cluster: disjoint shm namespaces force the
+    # copies through the object plane (PushManager fan-out analog)
+    from ray_tpu import experimental
+    from ray_tpu.cluster_utils import Cluster
+
+    mb = 16 if quick else 64
+    cluster = Cluster(
+        initialize_head=True,
+        head_node_args={"num_cpus": 2, "num_tpus": 0},
+        real_processes=True,
+    )
+    try:
+        for _ in range(2):
+            cluster.add_node(num_cpus=1)
+        arr = np.random.default_rng(2).integers(0, 255, mb << 20, dtype=np.uint8)
+        ref = ray_tpu.put(arr)
+        t0 = time.perf_counter()
+        out = experimental.broadcast_object(ref, timeout=300)
+        dt = time.perf_counter() - t0
+        assert out["replicas"] == 2, out
+        rec = {"metric": f"broadcast_{mb}mb_to_2_nodes_gbps",
+               "value": round(mb * 2 / 1024 / dt, 3), "unit": "GiB/s"}
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+    finally:
+        cluster.shutdown()
     return results
 
 

@@ -99,10 +99,12 @@ class BackendExecutor:
             for i, f in futs.items():
                 try:
                     kind, payload, ckpt = ray_tpu.get(f, timeout=60)
-                except ray_tpu.exceptions.RayError as e:
-                    # worker PROCESS death (RayActorError/WorkerCrashed) is
-                    # a gang failure exactly like an in-loop exception —
-                    # fit()'s whole-gang restart must see one error type
+                except (ray_tpu.exceptions.RayActorError,
+                        ray_tpu.exceptions.WorkerCrashedError) as e:
+                    # worker PROCESS death is a gang failure exactly like an
+                    # in-loop exception — fit()'s whole-gang restart must
+                    # see one error type.  Other RayErrors (get timeouts,
+                    # cancellations) are NOT deaths and propagate as-is.
                     raise TrainingFailedError(
                         f"worker {i} died: {type(e).__name__}: {e}"
                     ) from e
