@@ -18,7 +18,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from ray_tpu import exceptions
-from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu._private.worker import global_worker
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor
 from ray_tpu.remote_function import RemoteFunction

@@ -373,6 +373,12 @@ class Node:
         self._outbox_pending: set = set()
         # broadcast fan-out acks: token -> {"event", "ok", "error"}
         self._pull_acks: Dict[str, dict] = {}
+        # dynamic-return yield directory: task_id -> {"attempt": n, "oids":
+        # [..]} in yield order (streamed to ObjectRefGenerator consumers;
+        # the attempt counter lets a consumer detect a mid-stream retry)
+        self._dynamic_yields: Dict[bytes, dict] = {}
+        # parked dynamic_yields long-polls: task_id -> [waiter, ...]
+        self._dynamic_waiters: Dict[bytes, List[dict]] = {}
 
         total, tpus = autodetect_resources(num_cpus, num_tpus, resources)
         self._head_node_id = "node-head"
@@ -725,7 +731,7 @@ class Node:
     _EXEC_KEYS = (
         "task_id", "name", "fn_id", "args_blob", "args_oid",
         "is_actor_creation", "actor_id", "method_name",
-        "num_returns", "return_ids", "trace_ctx",
+        "num_returns", "return_ids", "trace_ctx", "dynamic_returns",
     )
 
     def _agent_node_or_head(self, node_id: str) -> str:
@@ -945,6 +951,16 @@ class Node:
                                "value": self._list_state(msg["what"], msg.get("limit", 1000))})
         elif mtype == "replica_added":
             self._on_replica_added(worker, msg)
+        elif mtype == "dynamic_yield":
+            # a dynamic task produced one more return (already sealed — the
+            # seal precedes this message on the same connection)
+            with self.lock:
+                d = self._dynamic_yields.setdefault(
+                    msg["task_id"], {"attempt": 0, "oids": []})
+                d["oids"].append(msg["oid"])
+            self._wake_dynamic_waiters(msg["task_id"])
+        elif mtype == "dynamic_yields":
+            self._on_dynamic_yields_request(conn, msg)
         elif mtype == "broadcast":
             # fan-out takes seconds for big objects — never on a reader thread
             threading.Thread(
@@ -1214,6 +1230,67 @@ class Node:
             if self.pending_tasks or self.pending_pgs:
                 self._wake_scheduler()
 
+    def _dynamic_state(self, tid: bytes):
+        """(attempt, oids, done) snapshot for a dynamic task."""
+        with self.lock:
+            d = self._dynamic_yields.get(tid)
+            attempt = d["attempt"] if d else 0
+            oids = list(d["oids"]) if d else []
+        with self.gcs.lock:
+            ti = self.gcs.tasks.get(tid)
+            done = ti is None or ti.state in ("FINISHED", "FAILED")
+        return attempt, oids, done
+
+    def _on_dynamic_yields_request(self, conn: Connection, msg: dict) -> None:
+        """Long-poll for new dynamic yields: reply immediately when there
+        is news (new oids past ``after``, a retry bumped the attempt, or
+        the task ended); otherwise park until a yield/done wakes us (or the
+        timeout sweep replies empty)."""
+        tid = msg["task_id"]
+        after = int(msg.get("after", 0))
+        attempt, oids, done = self._dynamic_state(tid)
+        if oids[after:] or done or attempt != int(msg.get("attempt", 0)):
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": {"oids": oids[after:], "done": done,
+                                         "attempt": attempt}})
+            return
+        with self.lock:
+            self._dynamic_waiters.setdefault(tid, []).append({
+                "conn": conn, "req_id": msg["req_id"], "after": after,
+                "attempt": int(msg.get("attempt", 0)),
+                "deadline": time.monotonic() + 20.0,
+            })
+
+    def _wake_dynamic_waiters(self, tid: bytes, expire: bool = False) -> None:
+        attempt, oids, done = self._dynamic_state(tid)
+        with self.lock:
+            waiters = self._dynamic_waiters.pop(tid, None)
+            if not waiters:
+                return
+            keep = []
+            fire = []
+            now = time.monotonic()
+            for wtr in waiters:
+                if (oids[wtr["after"]:] or done or attempt != wtr["attempt"]
+                        or (expire and now >= wtr["deadline"])):
+                    fire.append(wtr)
+                else:
+                    keep.append(wtr)
+            if keep:
+                self._dynamic_waiters[tid] = keep
+        for wtr in fire:
+            self._reply(wtr["conn"], {
+                "type": "reply", "req_id": wtr["req_id"],
+                "value": {"oids": oids[wtr["after"]:], "done": done,
+                          "attempt": attempt}})
+
+    def _sweep_dynamic_waiters(self) -> None:
+        """Expire parked long-polls (called from the timeout loop)."""
+        with self.lock:
+            tids = list(self._dynamic_waiters)
+        for tid in tids:
+            self._wake_dynamic_waiters(tid, expire=True)
+
     def _on_replica_added(self, worker: Optional[WorkerHandle], msg: dict) -> None:
         """A consumer finished pulling a copy onto its node — extend the
         object's location set (only real agent nodes count; emulated nodes
@@ -1440,6 +1517,7 @@ class Node:
         while not self._shutdown:
             time.sleep(0.05)
             self._service_pending_gets()
+            self._sweep_dynamic_waiters()
 
     def _gcs_flush_loop(self) -> None:
         """Periodic persistence on its own thread (never in the path of
@@ -1468,14 +1546,26 @@ class Node:
             ]
             excess = len(self.gcs.tasks) - self._MAX_TASK_HISTORY
             terminal.sort()
-            for _, tid in terminal[:excess]:
+            pruned = [tid for _, tid in terminal[:excess]]
+            for tid in pruned:
                 del self.gcs.tasks[tid]
+        with self.lock:
+            for tid in pruned:
+                self._dynamic_yields.pop(tid, None)
 
     # ------------------------------------------------------------------
     # tasks
     # ------------------------------------------------------------------
     def submit_task(self, spec: dict, _resubmit: bool = False) -> None:
         with self.lock:
+            if _resubmit and spec.get("dynamic_returns"):
+                # a retried generator re-yields from the start: new attempt,
+                # fresh yield list (consumers detect the bump and error out
+                # mid-stream rather than receive duplicates)
+                d = self._dynamic_yields.setdefault(
+                    spec["task_id"], {"attempt": 0, "oids": []})
+                d["attempt"] += 1
+                d["oids"] = []
             if not _resubmit:
                 self.gcs.tasks[spec["task_id"]] = TaskInfo(
                     task_id=spec["task_id"], name=spec.get("name", "task"),
@@ -1585,6 +1675,10 @@ class Node:
             if ti:
                 ti.state = "FAILED"
                 ti.end_time = time.time()
+            wake_dynamic = (spec["task_id"] in self._dynamic_yields
+                            or spec["task_id"] in self._dynamic_waiters)
+        if wake_dynamic:
+            self._wake_dynamic_waiters(spec["task_id"])
 
     def _deps_ready(self, spec: dict) -> bool:
         return all(self.registry.is_sealed(d) for d in spec.get("dep_ids", []))
@@ -2053,6 +2147,9 @@ class Node:
                 ti.exec_end = msg.get("exec_end")
                 ti.worker_pid = msg.get("worker_pid")
                 ti.end_time = time.time()
+            wake_dynamic = tid in self._dynamic_yields or tid in self._dynamic_waiters
+        if wake_dynamic:
+            self._wake_dynamic_waiters(tid)
         # return objects were sealed by the worker via "seal" messages already
         is_creation = spec.get("is_actor_creation")
         if is_creation:

@@ -72,6 +72,76 @@ class ObjectRef:
         return (ObjectRef, (self._id,))
 
 
+class ObjectRefGenerator:
+    """Stream of ObjectRefs from a ``num_returns="dynamic"`` task
+    (reference ``python/ray/_private/worker.py:2924`` ObjectRefGenerator).
+
+    Returned directly by ``.remote()`` on a dynamic task: iterating yields
+    each value's ObjectRef AS THE TASK PRODUCES IT (streamed through the
+    head's yield directory), so a consumer can start on the first block
+    while later ones are still being generated.  ``ray_tpu.get`` of the
+    task's terminal return gives the materialized (list-backed) form.
+    """
+
+    def __init__(self, refs=None, task_id: bytes = None, primary=None):
+        self._refs = list(refs) if refs is not None else None
+        self._task_id = task_id
+        self._primary = primary  # terminal return: errors surface via get
+
+    def __iter__(self):
+        if self._refs is not None:
+            return iter(self._refs)
+        return self._stream()
+
+    def __len__(self):
+        if self._refs is None:
+            raise TypeError("length unknown until the task finishes; "
+                            "iterate, or get() the materialized generator")
+        return len(self._refs)
+
+    def _stream(self):
+        import ray_tpu
+        from ray_tpu._private.worker import global_worker
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        seen = 0
+        attempt = 0
+        while True:
+            # long-poll: the head parks this request until a new yield
+            # lands, the task ends, or ~20s pass (no busy polling)
+            reply = global_worker.client.request({
+                "type": "dynamic_yields", "task_id": self._task_id,
+                "after": seen, "attempt": attempt,
+            }, timeout=300)["value"]
+            if reply.get("attempt", 0) != attempt:
+                if seen:
+                    # a retry re-yields from the start; duplicates must not
+                    # flow into a half-consumed stream
+                    raise WorkerCrashedError(
+                        "dynamic-return task was retried mid-stream; "
+                        "restart the iteration")
+                attempt = reply.get("attempt", 0)
+            for oid in reply["oids"]:
+                seen += 1
+                yield global_worker.track_ref(ObjectRef(oid), owned=False)
+            if reply["done"] and not reply["oids"]:
+                if self._primary is not None:
+                    # raises the task's error, if it failed mid-stream;
+                    # also recovers yields the head may have pruned
+                    gen = ray_tpu.get(self._primary)
+                    self._refs = gen._refs
+                    for r in (gen._refs or [])[seen:]:
+                        yield r
+                return
+
+    def completed(self):
+        """ObjectRef of the terminal return (sealed when the task ends)."""
+        return self._primary
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self._refs, self._task_id, self._primary))
+
+
 # IDs are a per-process random prefix + a monotonically increasing counter
 # (the reference also derives object IDs from the task counter, id.h).  One
 # urandom syscall per PROCESS instead of per id — new_id was the single

@@ -67,8 +67,11 @@ def validate_options(opts: Dict[str, Any], for_actor: bool) -> Dict[str, Any]:
         if v is not None and (not isinstance(v, (int, float)) or v < 0):
             raise ValueError(f"{k} must be a non-negative number, got {v!r}")
     nr = opts.get("num_returns")
-    if nr is not None and (not isinstance(nr, int) or nr < 1):
-        raise ValueError(f"num_returns must be an int >= 1, got {nr!r}")
+    if nr is not None and nr != "dynamic" and (not isinstance(nr, int) or nr < 1):
+        raise ValueError(
+            f"num_returns must be an int >= 1 or \"dynamic\", got {nr!r}")
+    if nr == "dynamic" and for_actor:
+        raise ValueError("num_returns=\"dynamic\" is not supported for actors")
     mc = opts.get("max_concurrency")
     if mc is not None and (not isinstance(mc, int) or mc < 1):
         raise ValueError(f"max_concurrency must be an int >= 1, got {mc!r}")

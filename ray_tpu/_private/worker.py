@@ -629,9 +629,14 @@ def _execute_task(msg: dict) -> None:
                     out = asyncio.run_coroutine_threadsafe(
                         _ensure_coro(out, spec.get("trace_ctx")), _get_async_loop()
                     ).result()
+                if spec.get("dynamic_returns"):
+                    out = _stream_dynamic_returns(w, spec, out)
             finally:
                 w.task_depth -= 1
-            results = _split_returns(out, spec["num_returns"])
+            results = (
+                [out] if spec.get("dynamic_returns")
+                else _split_returns(out, spec["num_returns"])
+            )
     except BaseException as e:  # noqa: BLE001
         failed = True
         tb = traceback.format_exc()
@@ -688,6 +693,26 @@ def _seal_and_report(w, spec: dict, results: List[Any], failed: bool,
     w.current_task_id = None
     if threading.current_thread() is threading.main_thread():
         _main_exec["spec"] = None  # reported; nothing left to recover
+
+
+def _stream_dynamic_returns(w: Worker, spec: dict, out) -> "ObjectRefGenerator":
+    """``num_returns="dynamic"`` executor half (reference
+    ``_raylet.pyx`` dynamic-return storing): each yielded value becomes its
+    own object sealed AS PRODUCED — the head's yield directory streams the
+    refs to any ObjectRefGenerator consumer before the task even finishes.
+    The terminal return is the materialized generator, whose contained refs
+    pin the yielded objects."""
+    from ray_tpu._private.object_ref import ObjectRefGenerator
+
+    refs = []
+    for item in out:
+        r = ObjectRef.random()
+        loc, contained = store_value(r, item)
+        w.client.seal(r.binary(), loc, [c.binary() for c in contained])
+        w.client.send({"type": "dynamic_yield",
+                       "task_id": spec["task_id"], "oid": r.binary()})
+        refs.append(w.track_ref(r, owned=True))
+    return ObjectRefGenerator(refs)
 
 
 def _split_returns(out: Any, num_returns: int) -> List[Any]:

@@ -31,6 +31,11 @@ class ActorMethod:
         )
 
     def options(self, num_returns: int = 1, **_):
+        if num_returns == "dynamic":
+            raise ValueError('num_returns="dynamic" is not supported for '
+                             "actor methods")
+        if not isinstance(num_returns, int) or num_returns < 1:
+            raise ValueError(f"num_returns must be an int >= 1, got {num_returns!r}")
         return ActorMethod(self._handle, self._method_name, num_returns)
 
     def __call__(self, *args, **kwargs):

@@ -11,6 +11,7 @@ from ray_tpu.data.dataset_pipeline import DatasetPipeline
 from ray_tpu.data.datasource import Datasource, FileBasedDatasource, ReadTask
 from ray_tpu.data.read_api import (
     from_items,
+    from_block_generator,
     from_numpy,
     from_pandas,
     range,
@@ -36,6 +37,7 @@ __all__ = [
     "Block",
     "BlockAccessor",
     "from_items",
+    "from_block_generator",
     "from_numpy",
     "from_pandas",
     "range",

@@ -96,8 +96,15 @@ class ActorPoolStrategy:
 
 class Dataset:
     def __init__(self, blocks_or_plan, num_rows: Optional[List[int]] = None):
+        from ray_tpu._private.object_ref import ObjectRefGenerator
+
         if isinstance(blocks_or_plan, ExecutionPlan):
             self._plan = blocks_or_plan
+        elif isinstance(blocks_or_plan, ObjectRefGenerator):
+            # blocks stream from a num_returns="dynamic" producer task;
+            # iter_batches consumes them as yielded (listing would block
+            # until the producer finishes)
+            self._plan = ExecutionPlan(blocks_or_plan, None)
         else:
             self._plan = ExecutionPlan(list(blocks_or_plan), num_rows)
 
@@ -113,6 +120,20 @@ class Dataset:
 
     def _with_stage(self, stage) -> "Dataset":
         return Dataset(self._plan.with_stage(stage))
+
+    def _iter_block_refs(self):
+        """Block refs in order, streaming when possible: a stage-free plan
+        over an ObjectRefGenerator yields refs AS THE PRODUCER TASK YIELDS
+        THEM (never materializing the full block list); anything else
+        executes the plan first."""
+        from ray_tpu._private.object_ref import ObjectRefGenerator
+
+        plan = self._plan
+        if (isinstance(plan.input_refs, ObjectRefGenerator)
+                and not plan.stages and plan._out is None):
+            yield from plan.input_refs
+            return
+        yield from self._blocks
 
     def stats(self) -> List[Dict[str, Any]]:
         """Per-stage execution stats (the _internal/stats.py analog)."""
@@ -350,9 +371,7 @@ class Dataset:
         """Stream batches (dataset.py:2624).  A background thread keeps up
         to ``prefetch_blocks`` blocks materialized ahead of consumption, so
         object fetch (incl. cross-node pulls) overlaps compute."""
-        refs = self._blocks
-        if not refs:
-            return
+        refs = self._iter_block_refs()
         q: "queue_mod.Queue" = queue_mod.Queue(maxsize=max(1, prefetch_blocks))
         SENTINEL = object()
         stop = threading.Event()

@@ -86,6 +86,11 @@ class ExecutionPlan:
             start = len(parent.stages)
         else:
             refs, counts = self.input_refs, self.input_counts
+            if not isinstance(refs, list):
+                # streaming (ObjectRefGenerator) input forced by a stage or
+                # a full materialization: drain the producer
+                refs = list(refs)
+                self.input_refs = refs
             start = 0
         i = start
         while i < len(self.stages):

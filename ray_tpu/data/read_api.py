@@ -74,6 +74,20 @@ def from_numpy(arr: Union[np.ndarray, List[np.ndarray]], *,
     return Dataset(refs, [len(c) for c in chunks if len(c)])
 
 
+def from_block_generator(gen) -> Dataset:
+    """Dataset over blocks streamed by a ``num_returns="dynamic"`` task:
+    ``iter_batches`` consumes each block AS THE PRODUCER YIELDS IT —
+    the full block list is never materialized (reference counterpart:
+    streaming Data blocks over ObjectRefGenerator, ``worker.py:2924``)."""
+    from ray_tpu._private.object_ref import ObjectRefGenerator
+
+    if not isinstance(gen, ObjectRefGenerator):
+        raise TypeError(
+            f"from_block_generator expects an ObjectRefGenerator "
+            f"(a num_returns=\"dynamic\" task's handle), got {type(gen)}")
+    return Dataset(gen)
+
+
 def from_pandas(df) -> Dataset:
     block = {c: df[c].to_numpy() for c in df.columns}
     return Dataset([ray_tpu.put(block)], [len(df)])

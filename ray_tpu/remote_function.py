@@ -55,6 +55,7 @@ class RemoteFunction:
             self._fn_blob = cloudpickle.dumps(self._function)
         self._fn_id = w.register_function(self._fn_blob)
         num_returns = options.get("num_returns", 1)
+        dynamic = num_returns == "dynamic"
         resources = ray_option_utils.resources_from_options(options, default_num_cpus=1)
         strategy = _strategy_to_dict(options.get("scheduling_strategy"))
         spec, return_refs = w.build_task_spec(
@@ -62,13 +63,20 @@ class RemoteFunction:
             fn_id=self._fn_id,
             args=args,
             kwargs=kwargs,
-            num_returns=num_returns,
+            num_returns=1 if dynamic else num_returns,
             resources=resources,
             scheduling_strategy=strategy,
             max_retries=options.get("max_retries", 3),
             runtime_env=options.get("runtime_env"),
         )
+        if dynamic:
+            spec["dynamic_returns"] = True
         w.client.submit_task(spec)
+        if dynamic:
+            from ray_tpu._private.object_ref import ObjectRefGenerator
+
+            return ObjectRefGenerator(
+                task_id=spec["task_id"], primary=return_refs[0])
         if num_returns == 1:
             return return_refs[0]
         return return_refs

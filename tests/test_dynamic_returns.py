@@ -1,0 +1,97 @@
+"""``num_returns="dynamic"`` generator tasks + ObjectRefGenerator streaming
+(reference ``python/ray/_private/worker.py:2924``)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import ObjectRefGenerator
+
+
+def test_dynamic_returns_basic(ray_start_regular):
+    @ray_tpu.remote(num_returns="dynamic")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    g = gen.remote(5)
+    assert isinstance(g, ObjectRefGenerator)
+    vals = [ray_tpu.get(r, timeout=60) for r in g]
+    assert vals == [0, 1, 4, 9, 16]
+    # the terminal return materializes the same refs
+    materialized = ray_tpu.get(g.completed(), timeout=60)
+    assert isinstance(materialized, ObjectRefGenerator)
+    assert len(materialized) == 5
+    assert [ray_tpu.get(r, timeout=60) for r in materialized] == vals
+
+
+def test_dynamic_returns_stream_before_completion(ray_start_regular):
+    """Refs arrive WHILE the producer is still running — the consumer gets
+    the first block long before the last one exists."""
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def slow_gen():
+        for i in range(4):
+            yield np.full((1000,), i)
+            time.sleep(1.0)
+
+    g = slow_gen.remote()
+    t0 = time.time()
+    it = iter(g)
+    first = ray_tpu.get(next(it), timeout=120)
+    first_latency = time.time() - t0
+    assert first[0] == 0
+    # producer sleeps 1s per item (4s total); the first item must arrive
+    # well before the stream ends
+    assert first_latency < 3.0, f"first item took {first_latency:.1f}s"
+    rest = [int(ray_tpu.get(r, timeout=120)[0]) for r in it]
+    assert rest == [1, 2, 3]
+
+
+def test_dynamic_returns_error_mid_stream(ray_start_regular):
+    @ray_tpu.remote(num_returns="dynamic", max_retries=0)
+    def bad_gen():
+        yield "ok"
+        raise RuntimeError("boom")
+
+    g = bad_gen.remote()
+    it = iter(g)
+    assert ray_tpu.get(next(it), timeout=60) == "ok"
+    with pytest.raises(Exception, match="boom"):
+        for r in it:  # stream ends by surfacing the task's error
+            ray_tpu.get(r, timeout=60)
+
+
+def test_dynamic_returns_validation(ray_start_regular):
+    with pytest.raises(ValueError):
+        @ray_tpu.remote(num_returns="dynamic")
+        class NotAllowed:  # actors can't be dynamic
+            pass
+
+    with pytest.raises(ValueError):
+        ray_tpu.remote(num_returns="nope")(lambda: None)
+
+
+def test_streamed_iter_batches_never_materializes(ray_start_regular):
+    """Data wiring: iter_batches over a dynamic producer starts yielding
+    batches while later blocks don't exist yet."""
+    from ray_tpu import data as rd
+
+    @ray_tpu.remote(num_returns="dynamic")
+    def produce_blocks():
+        for i in range(4):
+            yield {"value": np.full((500,), i, dtype=np.int64)}
+            time.sleep(1.0)
+
+    ds = rd.from_block_generator(produce_blocks.remote())
+    t0 = time.time()
+    batches = []
+    first_latency = None
+    for batch in ds.iter_batches(batch_size=500, batch_format="numpy"):
+        if first_latency is None:
+            first_latency = time.time() - t0
+        batches.append(int(np.asarray(batch)[0]))
+    assert batches == [0, 1, 2, 3]
+    assert first_latency < 3.0, f"first batch took {first_latency:.1f}s"
