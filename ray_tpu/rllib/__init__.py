@@ -17,6 +17,13 @@ from ray_tpu.rllib.algorithm import (
 )
 from ray_tpu.rllib.dqn import DQN, DQNConfig
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, compute_vtrace
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentBatch,
+    MultiAgentEnv,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+    MultiAgentRolloutWorker,
+)
 from ray_tpu.rllib.offline import JsonReader, JsonWriter
 from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy
 from ray_tpu.rllib.policy import JaxPolicy
@@ -49,6 +56,11 @@ __all__ = [
     "RolloutWorker",
     "WorkerSet",
     "SampleBatch",
+    "MultiAgentBatch",
+    "MultiAgentEnv",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "MultiAgentRolloutWorker",
     "compute_gae",
     "synchronous_parallel_sample",
     "train_one_step",

@@ -221,9 +221,9 @@ class Algorithm(Trainable):
             return int(action[0])
         import jax
 
-        from ray_tpu.rllib.models import apply_actor_critic
+        from ray_tpu.rllib.models import apply_model
 
-        logits, _ = apply_actor_critic(policy.params, obs)
+        logits, _ = apply_model(policy.params, obs)
         return int(np.argmax(np.asarray(logits)[0]))
 
     def get_policy(self):

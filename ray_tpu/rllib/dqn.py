@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, synchronous_parallel_sample
-from ray_tpu.rllib.models import apply_actor_critic
+from ray_tpu.rllib.models import apply_model
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
 from ray_tpu.rllib.sample_batch import SampleBatch
@@ -27,7 +27,7 @@ def make_dqn_loss():
     max lives outside the loss, computed with the frozen params)."""
 
     def loss(params, batch):
-        q_all, _ = apply_actor_critic(params, batch[SampleBatch.OBS])
+        q_all, _ = apply_model(params, batch[SampleBatch.OBS])
         actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
         q = jnp.take_along_axis(q_all, actions[:, None], axis=-1)[:, 0]
         td = q - batch[SampleBatch.VALUE_TARGETS]
@@ -65,14 +65,14 @@ class DQNPolicy(JaxPolicy):
 
         @jax.jit
         def _td_targets(target_params, next_obs, rewards, dones, gamma):
-            q_next, _ = apply_actor_critic(target_params, next_obs)
+            q_next, _ = apply_model(target_params, next_obs)
             return rewards + gamma * (1.0 - dones) * q_next.max(axis=-1)
 
         self._td_targets_jit = _td_targets
 
         @jax.jit
         def _q(params, obs):
-            q_all, _ = apply_actor_critic(params, obs)
+            q_all, _ = apply_model(params, obs)
             return q_all
 
         self._q_jit = _q

@@ -1,9 +1,12 @@
-"""Actor-critic model in pure jax.
+"""Model catalog in pure jax: MLP and CNN actor-critics.
 
 The reference's ``ModelCatalog`` (``rllib/models/catalog.py:195``) builds
-torch/tf nets; here the default model is a jax MLP with separate policy and
-value trunks, expressed as a params pytree + pure apply so the whole PPO
-update jits into one XLA program.
+torch/tf nets by observation space; here the catalog picks a jax MLP for
+flat observations and a Nature-DQN-style CNN (NHWC convs — the TPU-native
+layout) for image observations, both expressed as a params pytree + pure
+apply so the whole PPO update jits into one XLA program.  Dispatch is
+structural (``apply_model``): the params pytree carries its architecture,
+so one loss function serves both model families.
 """
 
 from __future__ import annotations
@@ -12,6 +15,7 @@ from typing import Dict, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _dense_params(key, n_in, n_out, scale=1.0):
@@ -51,6 +55,96 @@ def apply_actor_critic(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Arr
     logits = _mlp(params["pi"], obs)
     value = _mlp(params["vf"], obs)[..., 0]
     return logits, value
+
+
+# ---------------------------------------------------------------------------
+# CNN actor-critic (Atari-shaped inputs — catalog.py:195's conv path)
+# ---------------------------------------------------------------------------
+
+# Nature-DQN conv stack: (out_channels, kernel, stride)
+NATURE_CONV_FILTERS = ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+
+import dataclasses as _dataclasses
+
+
+@jax.tree_util.register_static
+@_dataclasses.dataclass(frozen=True)
+class ConvSpec:
+    """Static conv architecture metadata carried INSIDE the params pytree
+    (treedef, not leaf): optimizers skip it, jit specializes on it."""
+
+    filters: Tuple[Tuple[int, int, int], ...]
+
+
+def _conv_params(key, k, c_in, c_out):
+    # HWIO kernels (the TPU-native conv layout alongside NHWC activations)
+    fan_in = k * k * c_in
+    w = jax.random.normal(key, (k, k, c_in, c_out)) * np.sqrt(2.0 / fan_in)
+    return {"w": w, "b": jnp.zeros((c_out,))}
+
+
+def _conv_forward(convs, x, filters):
+    for layer, (_, k, stride) in zip(convs, filters):
+        x = jax.lax.conv_general_dilated(
+            x, layer["w"], window_strides=(stride, stride), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ) + layer["b"]
+        x = jax.nn.relu(x)
+    return x.reshape(x.shape[0], -1)
+
+
+def init_conv_actor_critic(
+    rng: jax.Array, obs_shape: Tuple[int, int, int], num_actions: int,
+    conv_filters: Sequence[Tuple[int, int, int]] = NATURE_CONV_FILTERS,
+    hiddens: Sequence[int] = (256,),
+) -> Dict:
+    """Shared conv trunk + separate pi/vf dense heads for [H, W, C] obs.
+    The params dict carries its architecture (``conv_spec`` static node)
+    so ``apply_model`` can dispatch without side-channel config."""
+    H, W, C = obs_shape
+    keys = jax.random.split(rng, len(conv_filters) + 2 * len(hiddens) + 2)
+    convs = []
+    c_in = C
+    for i, (c_out, k, stride) in enumerate(conv_filters):
+        convs.append(_conv_params(keys[i], k, c_in, c_out))
+        c_in = c_out
+    # flattened trunk width via shape-only tracing (no FLOPs)
+    flat = jax.eval_shape(
+        lambda cs, x: _conv_forward(cs, x, conv_filters),
+        convs, jax.ShapeDtypeStruct((1, H, W, C), jnp.float32),
+    ).shape[-1]
+    base = len(conv_filters)
+    pi, vf = [], []
+    n_in = flat
+    for i, h in enumerate(hiddens):
+        pi.append(_dense_params(keys[base + 2 * i], n_in, h))
+        vf.append(_dense_params(keys[base + 2 * i + 1], n_in, h))
+        n_in = h
+    pi.append(_dense_params(keys[-2], n_in, num_actions, 0.01))
+    vf.append(_dense_params(keys[-1], n_in, 1))
+    return {
+        "conv": convs, "pi": pi, "vf": vf,
+        # STATIC pytree node: part of the treedef, not a leaf — the
+        # optimizer never sees it, jit specializes on it, and apply_model
+        # reads the true strides instead of assuming the Nature defaults
+        "conv_spec": ConvSpec(tuple(tuple(f) for f in conv_filters)),
+    }
+
+
+def apply_conv_actor_critic(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """obs [B, H, W, C] (float; scale pixels yourself) -> (logits, value)."""
+    filters = params["conv_spec"].filters
+    x = _conv_forward(params["conv"], obs, filters)  # relu'd + flat
+    logits = _mlp(params["pi"], x)
+    value = _mlp(params["vf"], x)[..., 0]
+    return logits, value
+
+
+def apply_model(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Catalog dispatch: the params pytree names its architecture."""
+    if "conv" in params:
+        return apply_conv_actor_critic(params, obs)
+    return apply_actor_critic(params, obs)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,359 @@
+"""Multi-agent RL: MultiAgentEnv + per-policy mapping + multi-agent PPO.
+
+Reference counterparts: ``rllib/env/multi_agent_env.py:30`` (the dict-keyed
+env API with ``"__all__"`` termination), per-policy training via the
+``multiagent`` config (``policies`` + ``policy_mapping_fn``), and
+``MultiAgentBatch``.  Each policy is an independent :class:`JaxPolicy`
+(shared-policy setups map several agents onto one id); sampling groups
+observations per policy so each tick is one batched forward per policy.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.rllib.algorithm import Algorithm, train_one_step
+from ray_tpu.rllib.policy import JaxPolicy
+from ray_tpu.rllib.postprocessing import compute_gae
+from ray_tpu.rllib.ppo import PPOConfig
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+class MultiAgentEnv:
+    """Base class for dict-keyed multi-agent environments
+    (``multi_agent_env.py:30``).
+
+    - ``reset() -> (obs_dict, info_dict)``
+    - ``step(action_dict) -> (obs, rewards, terminateds, truncateds,
+      infos)`` — all dicts keyed by agent id; ``terminateds``/``truncateds``
+      carry the special ``"__all__"`` key ending the episode for everyone.
+    - ``observation_space(agent_id)`` / ``action_space(agent_id)`` describe
+      per-agent spaces.
+    """
+
+    agents: List[Any] = []
+
+    def reset(self, *, seed: Optional[int] = None, options=None):
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict):
+        raise NotImplementedError
+
+    def observation_space(self, agent_id):
+        raise NotImplementedError
+
+    def action_space(self, agent_id):
+        raise NotImplementedError
+
+
+class MultiAgentBatch:
+    """Per-policy SampleBatches (``policy/sample_batch.py`` MultiAgentBatch
+    analog).  ``count`` is total env steps across policies."""
+
+    def __init__(self, policy_batches: Dict[str, SampleBatch]):
+        self.policy_batches = policy_batches
+
+    @property
+    def count(self) -> int:
+        return sum(b.count for b in self.policy_batches.values())
+
+    @staticmethod
+    def concat_samples(batches: List["MultiAgentBatch"]) -> "MultiAgentBatch":
+        merged: Dict[str, List[SampleBatch]] = {}
+        for mb in batches:
+            for pid, b in mb.policy_batches.items():
+                merged.setdefault(pid, []).append(b)
+        return MultiAgentBatch({
+            pid: SampleBatch.concat_samples(parts)
+            for pid, parts in merged.items()
+        })
+
+
+class _AgentTrail:
+    """Per-agent column buffers within the running episode."""
+
+    __slots__ = ("cols", "last_obs")
+
+    def __init__(self, keys):
+        self.cols: Dict[str, List] = {k: [] for k in keys}
+        self.last_obs = None
+
+
+class MultiAgentRolloutWorker:
+    """Steps ONE MultiAgentEnv; groups per-policy forwards; GAE per agent
+    trail (the multi-agent half of ``rollout_worker.py:153``)."""
+
+    _KEYS = (
+        SampleBatch.OBS, SampleBatch.ACTIONS, SampleBatch.REWARDS,
+        SampleBatch.TERMINATEDS, SampleBatch.TRUNCATEDS, SampleBatch.EPS_ID,
+        SampleBatch.ACTION_LOGP, SampleBatch.VF_PREDS,
+    )
+
+    def __init__(self, config: Dict[str, Any], worker_index: int = 0):
+        self.config = config
+        self.worker_index = worker_index
+        ma = config["multiagent"]
+        env_creator: Callable = config["env_creator"]
+        self.env: MultiAgentEnv = env_creator(config.get("env_config", {}))
+        self.mapping_fn: Callable = ma["policy_mapping_fn"]
+        seed = int(config.get("seed") or 0) + worker_index
+
+        loss_factory = config.get("_loss_factory")
+        self.policies: Dict[str, JaxPolicy] = {}
+        for i, pid in enumerate(ma["policies"]):
+            # probe spaces through any agent mapped to this policy
+            agent = next(a for a in self.env.agents
+                         if self.mapping_fn(a) == pid)
+            obs_space = self.env.observation_space(agent)
+            act_space = self.env.action_space(agent)
+            obs_shape = tuple(obs_space.shape)
+            self.policies[pid] = JaxPolicy(
+                int(np.prod(obs_shape)),
+                int(act_space.n),
+                lr=config.get("lr", 5e-4),
+                hiddens=tuple(config.get("fcnet_hiddens", (64, 64))),
+                seed=seed * 131 + i,
+                loss_fn=loss_factory(config) if loss_factory else None,
+                grad_clip=config.get("grad_clip", 0.5),
+                obs_shape=obs_shape if len(obs_shape) == 3 else None,
+            )
+        self._conv = {
+            pid: "conv" in p.params for pid, p in self.policies.items()
+        }
+        self.gamma = config.get("gamma", 0.99)
+        self.lambda_ = config.get("lambda_", 0.95)
+        self.fragment_length = config.get("rollout_fragment_length", 200)
+
+        self._obs, _ = self.env.reset(seed=seed)
+        self._trails: Dict[Any, _AgentTrail] = {}
+        self._eps_id = worker_index * 1_000_000
+        self._episode_reward = 0.0
+        self._episode_len = 0
+        self._episode_rewards: deque = deque(maxlen=100)
+        self._episode_lengths: deque = deque(maxlen=100)
+        self._episodes_total = 0
+        self._total_steps = 0
+
+    # -- helpers --------------------------------------------------------
+    def _prep(self, agent, obs) -> np.ndarray:
+        o = np.asarray(obs, np.float32)
+        return o if self._conv[self.mapping_fn(agent)] else o.reshape(-1)
+
+    def _trail(self, agent) -> _AgentTrail:
+        t = self._trails.get(agent)
+        if t is None:
+            t = self._trails[agent] = _AgentTrail(self._KEYS)
+        return t
+
+    # -- sampling -------------------------------------------------------
+    def sample(self) -> MultiAgentBatch:
+        segments: Dict[str, List[SampleBatch]] = {pid: [] for pid in self.policies}
+
+        def close_trail(agent, trail, bootstrap: float):
+            if not trail.cols[SampleBatch.OBS]:
+                return
+            pid = self.mapping_fn(agent)
+            seg = SampleBatch({k: np.asarray(v) for k, v in trail.cols.items()})
+            seg = compute_gae(seg, bootstrap, self.gamma, self.lambda_)
+            segments[pid].append(seg)
+            for v in trail.cols.values():
+                v.clear()
+
+        for _ in range(self.fragment_length):
+            # group live agents by policy -> one batched forward per policy
+            by_pid: Dict[str, List[Any]] = {}
+            for agent, obs in self._obs.items():
+                by_pid.setdefault(self.mapping_fn(agent), []).append(agent)
+            actions: Dict[Any, Any] = {}
+            logps: Dict[Any, float] = {}
+            vfs: Dict[Any, float] = {}
+            for pid, agents in by_pid.items():
+                batch = np.stack([self._prep(a, self._obs[a]) for a in agents])
+                acts, lps, vs = self.policies[pid].compute_actions(batch)
+                for j, a in enumerate(agents):
+                    actions[a] = acts[j]
+                    logps[a] = lps[j]
+                    vfs[a] = vs[j]
+            prev_obs = self._obs
+            obs, rewards, terms, truncs, _ = self.env.step(
+                {a: int(actions[a]) for a in actions})
+            all_term = bool(terms.get("__all__"))
+            all_done = all_term or bool(truncs.get("__all__"))
+            for agent in prev_obs:
+                t = self._trail(agent)
+                t.cols[SampleBatch.OBS].append(self._prep(agent, prev_obs[agent]))
+                t.cols[SampleBatch.ACTIONS].append(actions[agent])
+                t.cols[SampleBatch.REWARDS].append(
+                    np.float32(rewards.get(agent, 0.0)))
+                # termination (no bootstrap) vs truncation (bootstrap
+                # v(s_T)) — same split as the single-agent worker
+                term = bool(terms.get(agent, False)) or all_term
+                trunc = bool(truncs.get(agent, False)) or (all_done and not all_term)
+                t.cols[SampleBatch.TERMINATEDS].append(term)
+                t.cols[SampleBatch.TRUNCATEDS].append(trunc)
+                t.cols[SampleBatch.EPS_ID].append(self._eps_id)
+                t.cols[SampleBatch.ACTION_LOGP].append(np.float32(logps[agent]))
+                t.cols[SampleBatch.VF_PREDS].append(np.float32(vfs[agent]))
+                t.last_obs = obs.get(agent, prev_obs[agent])
+                self._episode_reward += float(rewards.get(agent, 0.0))
+                self._total_steps += 1
+                if term or trunc:
+                    bootstrap = 0.0 if term else self._bootstrap(agent, t.last_obs)
+                    close_trail(agent, t, bootstrap)
+            self._episode_len += 1
+            if all_done:
+                for agent, t in self._trails.items():
+                    close_trail(agent, t, 0.0 if all_term
+                                else self._bootstrap(agent, t.last_obs))
+                self._episode_rewards.append(self._episode_reward)
+                self._episode_lengths.append(self._episode_len)
+                self._episodes_total += 1
+                self._episode_reward = 0.0
+                self._episode_len = 0
+                self._eps_id += 1
+                self._obs, _ = self.env.reset()
+            else:
+                self._obs = obs
+        # fragment boundary: bootstrap open trails with v(current obs)
+        for agent, t in self._trails.items():
+            if t.cols[SampleBatch.OBS]:
+                close_trail(agent, t, self._bootstrap(agent, self._obs.get(
+                    agent, t.last_obs)))
+        return MultiAgentBatch({
+            pid: SampleBatch.concat_samples(parts)
+            for pid, parts in segments.items() if parts
+        })
+
+    def _bootstrap(self, agent, obs) -> float:
+        pid = self.mapping_fn(agent)
+        return float(self.policies[pid].value(self._prep(agent, obs)[None])[0])
+
+    # -- WorkerSet surface ---------------------------------------------
+    def get_metrics(self) -> Dict[str, Any]:
+        rewards = list(self._episode_rewards)
+        return {
+            "episode_reward_mean": float(np.mean(rewards)) if rewards else np.nan,
+            "episode_len_mean": (
+                float(np.mean(self._episode_lengths))
+                if self._episode_lengths else np.nan),
+            "episodes_total": self._episodes_total,
+            "worker_steps": self._total_steps,
+        }
+
+    def get_weights(self) -> Dict[str, Any]:
+        return {pid: p.get_weights() for pid, p in self.policies.items()}
+
+    def set_weights(self, weights: Dict[str, Any]) -> bool:
+        for pid, w in weights.items():
+            self.policies[pid].set_weights(w)
+        return True
+
+    def set_global_vars(self, timesteps_total: int) -> bool:
+        return True
+
+    def evaluate_episodes(self, num_episodes: int,
+                          max_steps_per_episode: int = 10_000) -> Dict[str, Any]:
+        rewards = []
+        for ep in range(num_episodes):
+            obs, _ = self.env.reset(seed=977 + ep)
+            total, steps = 0.0, 0
+            while steps < max_steps_per_episode:
+                acts = {}
+                for agent, o in obs.items():
+                    pid = self.mapping_fn(agent)
+                    acts[agent] = int(self.policies[pid].greedy_action(
+                        self._prep(agent, o)[None])[0])
+                obs, rs, terms, truncs, _ = self.env.step(acts)
+                total += float(sum(rs.values()))
+                steps += 1
+                if terms.get("__all__") or truncs.get("__all__"):
+                    break
+            rewards.append(total)
+        # the shared env was disturbed: fresh training episode state
+        self._obs, _ = self.env.reset()
+        self._trails.clear()
+        self._episode_reward = 0.0
+        self._episode_len = 0
+        return {"episode_reward_mean": float(np.mean(rewards)),
+                "episodes_this_eval": num_episodes}
+
+    def apply(self, fn_blob: bytes):
+        import cloudpickle
+
+        return cloudpickle.loads(fn_blob)(self)
+
+
+class MultiAgentPPOConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MultiAgentPPO
+        self._config.update(
+            multiagent={"policies": {}, "policy_mapping_fn": None},
+            _worker_class=MultiAgentRolloutWorker,
+        )
+
+    def multi_agent(self, *, policies, policy_mapping_fn) -> "MultiAgentPPOConfig":
+        self._config["multiagent"] = {
+            "policies": dict.fromkeys(policies),
+            "policy_mapping_fn": policy_mapping_fn,
+        }
+        return self
+
+
+class MultiAgentPPO(Algorithm):
+    """PPO over per-policy batches: each policy runs clipped-surrogate SGD
+    on its own agents' trajectories (the reference's multi-agent
+    ``training_step`` over ``MultiAgentBatch``)."""
+
+    def setup(self, config: Dict[str, Any]) -> None:
+        super().setup(config)
+        self._sgd_rng = np.random.default_rng(self.config.get("seed", 0))
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        self.workers.sync_weights()
+        batches: List[MultiAgentBatch] = []
+        total = 0
+        while total < cfg["train_batch_size"]:
+            b = self.workers.synchronous_parallel_sample()
+            batches.append(b)
+            total += b.count
+        batch = MultiAgentBatch.concat_samples(batches)
+        self._timesteps_total += batch.count
+        learner: Dict[str, Dict[str, float]] = {}
+        for pid, pb in batch.policy_batches.items():
+            learner[pid] = train_one_step(
+                self.workers.local_worker.policies[pid],
+                pb,
+                num_sgd_iter=cfg["num_sgd_iter"],
+                sgd_minibatch_size=cfg["sgd_minibatch_size"],
+                rng=self._sgd_rng,
+                required_keys=(
+                    SampleBatch.OBS, SampleBatch.ACTIONS,
+                    SampleBatch.ACTION_LOGP, SampleBatch.ADVANTAGES,
+                    SampleBatch.VALUE_TARGETS,
+                ),
+            )
+        return {"info": {"learner": learner}}
+
+    def save_checkpoint(self) -> Dict:
+        return {
+            "policy_state": {
+                pid: p.get_state()
+                for pid, p in self.workers.local_worker.policies.items()
+            },
+            "timesteps_total": self._timesteps_total,
+        }
+
+    def load_checkpoint(self, state: Dict) -> None:
+        for pid, s in state["policy_state"].items():
+            self.workers.local_worker.policies[pid].set_state(s)
+        self._timesteps_total = state.get("timesteps_total", 0)
+        self.workers.sync_weights()
+
+
+# set after the class exists (MultiAgentPPOConfig references MultiAgentPPO)
+MultiAgentPPO._default_config = MultiAgentPPOConfig().to_dict()

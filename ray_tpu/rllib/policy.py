@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rllib.models import apply_actor_critic, init_actor_critic
+from ray_tpu.rllib.models import apply_model, init_actor_critic, init_conv_actor_critic
 
 
 class JaxPolicy:
@@ -30,13 +30,22 @@ class JaxPolicy:
         seed: int = 0,
         loss_fn: Optional[Callable] = None,
         grad_clip: Optional[float] = 0.5,
+        obs_shape: Optional[tuple] = None,
     ):
         self.obs_dim = obs_dim
         self.num_actions = num_actions
         self._rng = jax.random.PRNGKey(seed)
-        self.params = init_actor_critic(
-            jax.random.PRNGKey(seed + 1), obs_dim, num_actions, hiddens
-        )
+        if obs_shape is not None and len(obs_shape) == 3:
+            # image observations -> CNN (the ModelCatalog conv path); the
+            # caller's hiddens become the post-conv dense trunk
+            self.params = init_conv_actor_critic(
+                jax.random.PRNGKey(seed + 1), tuple(obs_shape), num_actions,
+                hiddens=tuple(hiddens),
+            )
+        else:
+            self.params = init_actor_critic(
+                jax.random.PRNGKey(seed + 1), obs_dim, num_actions, hiddens
+            )
         tx = [optax.clip_by_global_norm(grad_clip)] if grad_clip else []
         self.optimizer = optax.chain(*tx, optax.adam(lr))
         self.opt_state = self.optimizer.init(self.params)
@@ -44,7 +53,7 @@ class JaxPolicy:
 
         @jax.jit
         def _sample(params, rng, obs):
-            logits, value = apply_actor_critic(params, obs)
+            logits, value = apply_model(params, obs)
             action = jax.random.categorical(rng, logits, axis=-1)
             logp = jax.nn.log_softmax(logits)
             action_logp = jnp.take_along_axis(logp, action[:, None], axis=-1)[:, 0]
@@ -52,17 +61,17 @@ class JaxPolicy:
 
         @jax.jit
         def _value(params, obs):
-            _, value = apply_actor_critic(params, obs)
+            _, value = apply_model(params, obs)
             return value
 
         @jax.jit
         def _greedy(params, obs):
-            logits, _ = apply_actor_critic(params, obs)
+            logits, _ = apply_model(params, obs)
             return jnp.argmax(logits, axis=-1)
 
         @jax.jit
         def _action_logp(params, obs, actions):
-            logits, _ = apply_actor_critic(params, obs)
+            logits, _ = apply_model(params, obs)
             logp = jax.nn.log_softmax(logits)
             return jnp.take_along_axis(
                 logp, actions.astype(jnp.int32)[:, None], axis=-1

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, train_one_step
-from ray_tpu.rllib.models import apply_actor_critic
+from ray_tpu.rllib.models import apply_model
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
@@ -25,7 +25,7 @@ def make_ppo_loss(clip_param: float, vf_clip_param: float,
     """Loss factory; the returned closure is jitted inside JaxPolicy."""
 
     def loss(params, batch):
-        logits, values = apply_actor_critic(params, batch[SampleBatch.OBS])
+        logits, values = apply_model(params, batch[SampleBatch.OBS])
         logp_all = jax.nn.log_softmax(logits)
         actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
         logp = jnp.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]

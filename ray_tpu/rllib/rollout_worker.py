@@ -52,6 +52,7 @@ class RolloutWorker:
         )
         self.num_envs = max(1, int(config.get("num_envs_per_worker", 1)))
         probe_env = self._make_env()
+        self._obs_shape = tuple(probe_env.observation_space.shape)
         obs_dim = int(np.prod(probe_env.observation_space.shape))
         space = probe_env.action_space
         self._discrete = hasattr(space, "n")
@@ -74,6 +75,10 @@ class RolloutWorker:
         pk_factory = config.get("_policy_kwargs_factory")
         extra = (dict(pk_factory(config)) if pk_factory
                  else dict(config.get("_policy_kwargs") or {}))
+        if len(self._obs_shape) == 3 and policy_cls is JaxPolicy:
+            # image observations -> the catalog's CNN (catalog.py:195
+            # dispatch); subclass policies keep their own model choices
+            extra.setdefault("obs_shape", self._obs_shape)
         self.policy = policy_cls(
             obs_dim,
             num_actions,
@@ -84,6 +89,11 @@ class RolloutWorker:
             grad_clip=config.get("grad_clip", 0.5),
             **extra,
         )
+        # obs stay [H, W, C] only when the BUILT policy actually carries a
+        # conv net — a flat-MLP policy (DQN/SAC on image envs) gets
+        # flattened observations instead of a shape crash
+        p = getattr(self.policy, "params", None)
+        self._conv = isinstance(p, dict) and "conv" in p
         self._store_next_obs = bool(config.get("_store_next_obs"))
         # on-policy learners want GAE + behavior logp/vf columns; replay
         # learners want raw transitions; IMPALA wants transitions AND the
@@ -129,6 +139,11 @@ class RolloutWorker:
         self._eps_counter += 1
         return self._eps_counter
 
+    def _prep_obs(self, o) -> np.ndarray:
+        """Image obs keep [H, W, C] for the CNN; flat obs flatten."""
+        o = np.asarray(o, np.float32)
+        return o if self._conv else o.reshape(-1)
+
     def _env_action(self, action: np.ndarray):
         """Policy output -> what env.step accepts.  Continuous policies act
         in the canonical [-1, 1] box (tanh squash); rescale to the env's
@@ -159,9 +174,7 @@ class RolloutWorker:
                 v.clear()
 
         for _ in range(self.fragment_length):
-            obs_batch = np.stack([
-                np.asarray(es.obs, np.float32).reshape(-1) for es in self._envs
-            ])
+            obs_batch = np.stack([self._prep_obs(es.obs) for es in self._envs])
             actions, logps, vfs = self.policy.compute_actions(obs_batch)
             for i, es in enumerate(self._envs):
                 a = actions[i]
@@ -175,9 +188,7 @@ class RolloutWorker:
                 es.cols[SampleBatch.TRUNCATEDS].append(truncated)
                 es.cols[SampleBatch.EPS_ID].append(es.eps_id)
                 if self._store_next_obs:
-                    es.cols[SampleBatch.NEXT_OBS].append(
-                        np.asarray(next_obs, np.float32).reshape(-1)
-                    )
+                    es.cols[SampleBatch.NEXT_OBS].append(self._prep_obs(next_obs))
                 if self._keep_behavior_logp:
                     es.cols[SampleBatch.ACTION_LOGP].append(np.float32(logps[i]))
                     es.cols[SampleBatch.VF_PREDS].append(np.float32(vfs[i]))
@@ -189,9 +200,7 @@ class RolloutWorker:
                     # terminal: no bootstrap; truncation: bootstrap v(s_T)
                     _next = next_obs
                     close_segment(es, lambda: 0.0 if terminated else float(
-                        self.policy.value(
-                            np.asarray(_next, np.float32).reshape(1, -1)
-                        )[0]
+                        self.policy.value(self._prep_obs(_next)[None])[0]
                     ))
                     self._episode_rewards.append(es.episode_reward)
                     self._episode_lengths.append(es.episode_len)
@@ -203,9 +212,7 @@ class RolloutWorker:
         # fragment ended mid-episode: bootstrap with v(current obs)
         for es in self._envs:
             close_segment(es, lambda es=es: float(
-                self.policy.value(
-                    np.asarray(es.obs, np.float32).reshape(1, -1)
-                )[0]
+                self.policy.value(self._prep_obs(es.obs)[None])[0]
             ))
         batch = SampleBatch.concat_samples(segments)
         if self._writer is not None:
@@ -226,9 +233,7 @@ class RolloutWorker:
             obs, _ = env.reset(seed=977 + ep)
             total, steps = 0.0, 0
             while steps < max_steps_per_episode:
-                a = self.policy.greedy_action(
-                    np.asarray(obs, np.float32).reshape(1, -1)
-                )[0]
+                a = self.policy.greedy_action(self._prep_obs(obs)[None])[0]
                 obs, r, term, trunc, _ = env.step(self._env_action(a))
                 total += float(r)
                 steps += 1

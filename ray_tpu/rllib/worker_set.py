@@ -19,9 +19,10 @@ class WorkerSet:
     def __init__(self, config: Dict[str, Any]):
         self.config = config
         n = config.get("num_rollout_workers", 0)
+        worker_cls = config.get("_worker_class") or RolloutWorker
         # Local worker: holds the learner policy; also samples when n == 0.
-        self.local_worker = RolloutWorker(config, worker_index=0)
-        RemoteWorker = ray_tpu.remote(RolloutWorker)
+        self.local_worker = worker_cls(config, worker_index=0)
+        RemoteWorker = ray_tpu.remote(worker_cls)
         opts = {"num_cpus": config.get("num_cpus_per_worker", 1)}
         self.remote_workers = [
             RemoteWorker.options(**opts).remote(config, worker_index=i + 1)
@@ -59,7 +60,8 @@ class WorkerSet:
         batches = ray_tpu.get(
             [w.sample.remote() for w in self.remote_workers], timeout=600
         )
-        return SampleBatch.concat_samples(batches)
+        # MultiAgentBatch and SampleBatch both expose concat_samples
+        return type(batches[0]).concat_samples(batches)
 
     def collect_metrics(self) -> List[Dict[str, Any]]:
         if not self.remote_workers:

@@ -1,0 +1,212 @@
+"""RLlib multi-agent + CNN catalog (reference
+``rllib/env/multi_agent_env.py:30``, ``rllib/models/catalog.py:195``)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import (
+    MultiAgentEnv,
+    MultiAgentPPOConfig,
+    PPOConfig,
+)
+from ray_tpu.rllib.models import (
+    apply_conv_actor_critic,
+    apply_model,
+    init_conv_actor_critic,
+)
+
+
+class _Box:
+    def __init__(self, shape):
+        self.shape = shape
+
+
+class _Discrete:
+    def __init__(self, n):
+        self.n = n
+
+
+class DualCartPole(MultiAgentEnv):
+    """Two independent CartPole instances inside one MultiAgentEnv — each
+    agent balances its own pole; the episode ends when BOTH are done (the
+    '2-agent CartPole variant' of the verdict)."""
+
+    agents = ["cart_0", "cart_1"]
+
+    def __init__(self, _config=None):
+        import gymnasium as gym
+
+        self._envs = {a: gym.make("CartPole-v1") for a in self.agents}
+        self._done = {a: False for a in self.agents}
+
+    def observation_space(self, agent_id):
+        return _Box(self._envs[agent_id].observation_space.shape)
+
+    def action_space(self, agent_id):
+        return _Discrete(int(self._envs[agent_id].action_space.n))
+
+    def reset(self, *, seed=None, options=None):
+        obs = {}
+        for i, (a, env) in enumerate(self._envs.items()):
+            o, _ = env.reset(seed=None if seed is None else seed + i)
+            obs[a] = o
+            self._done[a] = False
+        return obs, {}
+
+    def step(self, action_dict):
+        obs, rewards, terms, truncs = {}, {}, {}, {}
+        for a, act in action_dict.items():
+            if self._done[a]:
+                continue
+            o, r, term, trunc, _ = self._envs[a].step(int(act))
+            rewards[a] = r
+            terms[a] = term
+            truncs[a] = trunc
+            if term or trunc:
+                self._done[a] = True
+            else:
+                obs[a] = o
+        done_all = all(self._done.values())
+        terms["__all__"] = done_all
+        truncs["__all__"] = False
+        return obs, rewards, terms, truncs, {}
+
+
+def test_multiagent_ppo_learns_dual_cartpole(ray_start_regular):
+    config = (
+        MultiAgentPPOConfig()
+        .environment(env_creator=lambda cfg: DualCartPole(cfg))
+        .rollouts(num_rollout_workers=0, rollout_fragment_length=200)
+        .training(train_batch_size=800, sgd_minibatch_size=128,
+                  num_sgd_iter=6, lr=3e-4, entropy_coeff=0.01)
+        .multi_agent(
+            policies=["p0", "p1"],
+            policy_mapping_fn=lambda agent_id: f"p{agent_id[-1]}",
+        )
+        .debugging(seed=7)
+    )
+    algo = config.build()
+    first = None
+    best = -np.inf
+    for _ in range(18):
+        res = algo.step()
+        r = res["episode_reward_mean"]
+        if not np.isnan(r):
+            if first is None:
+                first = r
+            best = max(best, r)
+        assert set(res["info"]["learner"]) <= {"p0", "p1"}
+    algo.cleanup()
+    # combined reward of two fresh CartPoles starts ~40-60; learning must
+    # push the (100-episode-window) mean well past the initial level
+    assert first is not None
+    assert best > first * 1.5 and best > 100, (first, best)
+
+
+def test_multiagent_checkpoint_roundtrip(ray_start_regular):
+    config = (
+        MultiAgentPPOConfig()
+        .environment(env_creator=lambda cfg: DualCartPole(cfg))
+        .training(train_batch_size=300, sgd_minibatch_size=64, num_sgd_iter=2)
+        .multi_agent(policies=["p0", "p1"],
+                     policy_mapping_fn=lambda aid: f"p{aid[-1]}")
+    )
+    algo = config.build()
+    algo.step()
+    state = algo.save_checkpoint()
+    assert set(state["policy_state"]) == {"p0", "p1"}
+    algo2 = config.build()
+    algo2.load_checkpoint(state)
+    w1 = algo.workers.local_worker.policies["p0"].get_weights()
+    w2 = algo2.workers.local_worker.policies["p0"].get_weights()
+    np.testing.assert_allclose(w1["pi"][0]["w"], w2["pi"][0]["w"])
+    algo.cleanup()
+    algo2.cleanup()
+
+
+def test_conv_model_fwd_bwd_on_synthetic_frames():
+    """Nature-CNN fwd/bwd on 84x84 frames (Atari-shaped; BASELINE config 4
+    readiness) — gradients flow to every conv layer."""
+    import jax
+    import jax.numpy as jnp
+
+    params = init_conv_actor_critic(jax.random.PRNGKey(0), (84, 84, 4), 6)
+    frames = jnp.asarray(
+        np.random.default_rng(0).random((8, 84, 84, 4), np.float32))
+    logits, value = jax.jit(apply_conv_actor_critic)(params, frames)
+    assert logits.shape == (8, 6) and value.shape == (8,)
+    # dispatch: the same params route through apply_model
+    l2, v2 = apply_model(params, frames)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(l2), rtol=1e-5)
+
+    def loss(p):
+        lg, v = apply_conv_actor_critic(p, frames)
+        return jnp.mean(lg ** 2) + jnp.mean(v ** 2)
+
+    grads = jax.jit(jax.grad(loss))(params)
+    for i, layer in enumerate(grads["conv"]):
+        assert float(jnp.abs(layer["w"]).max()) > 0, f"dead conv layer {i}"
+
+
+class PixelSeeker:
+    """Tiny learnable pixel env: the bright column marks the target; move
+    toward it.  Exercises the conv path through PPO end-to-end."""
+
+    class _Space:
+        def __init__(self, shape=None, n=None):
+            if shape is not None:
+                self.shape = shape
+            if n is not None:
+                self.n = n
+                self.shape = ()
+
+    N = 11
+
+    def __init__(self, _cfg=None):
+        self.observation_space = self._Space(shape=(self.N, self.N, 1))
+        self.action_space = self._Space(n=2)
+        self._rng = np.random.default_rng(0)
+
+    def _obs(self):
+        img = np.zeros((self.N, self.N, 1), np.float32)
+        img[:, self.target, 0] = 1.0
+        img[self.N // 2, self.pos, 0] = 0.5
+        return img
+
+    def reset(self, seed=None):
+        self.pos = self.N // 2
+        self.target = int(self._rng.integers(0, self.N))
+        self.t = 0
+        return self._obs(), {}
+
+    def step(self, action):
+        self.pos = int(np.clip(
+            self.pos + (1 if action == 1 else -1), 0, self.N - 1))
+        self.t += 1
+        done = self.pos == self.target
+        # dense shaping: closeness each step + a bonus on arrival, so the
+        # conv policy gets gradient signal from the first iteration
+        reward = 1.0 if done else -abs(self.pos - self.target) / self.N * 0.2
+        return self._obs(), reward, done, self.t >= 24, {}
+
+
+def test_ppo_conv_policy_learns_pixels(ray_start_regular):
+    config = (
+        PPOConfig()
+        .environment(env_creator=lambda cfg: PixelSeeker(cfg))
+        .rollouts(rollout_fragment_length=200)
+        .training(train_batch_size=600, sgd_minibatch_size=128,
+                  num_sgd_iter=4, lr=1e-3, entropy_coeff=0.01)
+        .debugging(seed=3)
+    )
+    algo = config.build()
+    assert "conv" in algo.get_policy().params  # catalog picked the CNN
+    first, best = None, -np.inf
+    for _ in range(14):
+        r = algo.step()["episode_reward_mean"]
+        if not np.isnan(r):
+            first = r if first is None else first
+            best = max(best, r)
+    algo.cleanup()
+    assert best > first + 0.15, (first, best)
