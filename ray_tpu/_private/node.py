@@ -96,6 +96,21 @@ def _apply_runtime_env(env: Dict[str, str], runtime_env: Optional[dict]) -> Opti
     return runtime_env.get("working_dir")
 
 
+def _worker_argv(runtime_env: Optional[dict]) -> List[str]:
+    """Worker process argv.  A pip runtime_env boots through the
+    runtime_env_setup shim, which builds/reuses the hash-keyed venv in the
+    WORKER process (the head's threads never wait on an install) and execs
+    the venv's python into the normal entrypoint."""
+    if runtime_env and runtime_env.get("pip"):
+        import json
+
+        return [
+            sys.executable, "-m", "ray_tpu._private.runtime_env_setup",
+            "--pip-spec", json.dumps(runtime_env["pip"]),
+        ]
+    return [sys.executable, "-m", "ray_tpu._private.worker"]
+
+
 def _fits(req: Dict[str, float], avail: Dict[str, float]) -> bool:
     return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in req.items())
 
@@ -1004,7 +1019,7 @@ class Node:
             env.update(extra_env)
         env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
         return subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker"], env=env, cwd=cwd
+            _worker_argv(runtime_env), env=env, cwd=cwd
         )
 
     def _spawn_on_node(
@@ -1020,7 +1035,8 @@ class Node:
         if ns.agent_conn is not None:
             env, cwd = self._remote_env_overrides(worker_id, runtime_env, extra_env)
             ns.agent_send({"type": "spawn_worker", "worker_id": worker_id.hex(),
-                           "env_overrides": env, "cwd": cwd})
+                           "env_overrides": env, "cwd": cwd,
+                           "pip": (runtime_env or {}).get("pip")})
             return None
         return self._spawn_worker_process(ns, worker_id, runtime_env, extra_env)
 

@@ -172,10 +172,15 @@ class NodeAgent:
         env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
         cwd = msg.get("cwd")
         wid = msg["worker_id"]
+        if msg.get("pip"):
+            import json
+
+            argv = [sys.executable, "-m", "ray_tpu._private.runtime_env_setup",
+                    "--pip-spec", json.dumps(msg["pip"])]
+        else:
+            argv = [sys.executable, "-m", "ray_tpu._private.worker"]
         try:
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "ray_tpu._private.worker"], env=env, cwd=cwd
-            )
+            proc = subprocess.Popen(argv, env=env, cwd=cwd)
         except OSError as e:
             self._send({"type": "worker_exited", "worker_id": wid,
                         "returncode": -1, "error": str(e)})

@@ -20,11 +20,13 @@ ACTOR_OPTIONS = {
     "max_concurrency",
 }
 
-# The runtime_env MVP honors process-level environments; anything the
-# reference installs through its per-node agent (pip/conda/container/
-# py_modules, ``python/ray/_private/runtime_env/plugin.py``) is rejected
-# loudly instead of silently dropped.
-SUPPORTED_RUNTIME_ENV_KEYS = {"env_vars", "working_dir"}
+# env_vars/working_dir apply at spawn; pip builds a hash-keyed cached venv
+# in the worker's bootstrap (``runtime_env_setup.py``; reference
+# ``python/ray/_private/runtime_env/pip.py``).  Anything else the
+# reference installs through its agent (conda/container/py_modules,
+# ``runtime_env/plugin.py``) is rejected loudly instead of silently
+# dropped.
+SUPPORTED_RUNTIME_ENV_KEYS = {"env_vars", "working_dir", "pip"}
 
 
 def validate_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -51,6 +53,27 @@ def validate_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict
                 f"runtime_env['working_dir'] must be an existing local directory, "
                 f"got {working_dir!r}"
             )
+    pip = runtime_env.get("pip")
+    if pip is not None:
+        # list of requirements, or {"packages": [...], "pip_install_options":
+        # [...]} (reference python/ray/_private/runtime_env/pip.py surface)
+        if isinstance(pip, dict):
+            unknown = set(pip) - {"packages", "pip_install_options"}
+            if unknown:
+                raise ValueError(
+                    f"unsupported runtime_env['pip'] keys {sorted(unknown)}; "
+                    f"supported: ['packages', 'pip_install_options']")
+            pkgs = pip.get("packages")
+            opts_ = pip.get("pip_install_options", [])
+            if not isinstance(pkgs, list) or not all(isinstance(p, str) for p in pkgs):
+                raise TypeError("runtime_env['pip']['packages'] must be List[str]")
+            if not isinstance(opts_, list) or not all(isinstance(o, str) for o in opts_):
+                raise TypeError(
+                    "runtime_env['pip']['pip_install_options'] must be List[str]")
+        elif not (isinstance(pip, list) and all(isinstance(p, str) for p in pip)):
+            raise TypeError(
+                "runtime_env['pip'] must be a List[str] of requirements or a "
+                "dict with 'packages'")
     return runtime_env
 
 

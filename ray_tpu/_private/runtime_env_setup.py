@@ -1,0 +1,105 @@
+"""runtime_env pip plugin: hash-keyed cached virtualenvs at worker spawn.
+
+Reference counterpart: ``python/ray/_private/runtime_env/pip.py`` (venv
+per requirements hash, installed by the per-node agent before the worker
+starts).  Here the slow work runs in the WORKER's own bootstrap process —
+``python -m ray_tpu._private.runtime_env_setup --pip-spec ... `` creates or
+reuses the venv, then ``exec``s the venv's interpreter into the normal
+worker entrypoint — so the head's scheduler thread never blocks on an
+install.  A boot-looping pip spec trips the existing 3-strikes
+runtime_env circuit breaker (``node.py`` spawn_failures) and fails the
+task with an actionable error.
+
+Venvs are created with ``--system-site-packages`` so the base image's
+jax/numpy remain importable, keyed by the sha1 of the canonicalized spec,
+and marked ready atomically; concurrent creators serialize on an
+``fcntl`` lock.
+"""
+
+from __future__ import annotations
+
+import fcntl
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import venv
+from typing import Any, Dict, List, Tuple, Union
+
+DEFAULT_BASE_DIR = "/tmp/ray_tpu/runtime_envs"
+
+PipSpec = Union[List[str], Dict[str, Any]]
+
+
+def parse_pip_spec(pip: PipSpec) -> Tuple[List[str], List[str]]:
+    if isinstance(pip, dict):
+        return list(pip.get("packages") or []), list(
+            pip.get("pip_install_options") or [])
+    return list(pip), []
+
+
+def pip_env_key(pip: PipSpec) -> str:
+    packages, options = parse_pip_spec(pip)
+    blob = json.dumps({"packages": sorted(packages), "options": options},
+                      sort_keys=True).encode()
+    return hashlib.sha1(blob).hexdigest()[:16]
+
+
+def ensure_pip_env(pip: PipSpec, base_dir: str = DEFAULT_BASE_DIR) -> Tuple[str, bool]:
+    """Create (or reuse) the venv for ``pip``; returns ``(python_exe,
+    created)``.  Raises on install failure."""
+    packages, options = parse_pip_spec(pip)
+    key = pip_env_key(pip)
+    env_dir = os.path.join(base_dir, f"pip-{key}")
+    python = os.path.join(env_dir, "bin", "python")
+    ready = os.path.join(env_dir, ".ready")
+    if os.path.exists(ready):
+        return python, False
+    os.makedirs(base_dir, exist_ok=True)
+    lock_path = os.path.join(base_dir, f"pip-{key}.lock")
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(ready):  # another process won the race
+                return python, False
+            venv.EnvBuilder(
+                system_site_packages=True, with_pip=True, clear=True
+            ).create(env_dir)
+            if packages:
+                proc = subprocess.run(
+                    [python, "-m", "pip", "install", "--no-input",
+                     *options, *packages],
+                    capture_output=True, text=True, timeout=600,
+                )
+                if proc.returncode != 0:
+                    raise RuntimeError(
+                        f"pip install {packages} failed:\n"
+                        f"{proc.stderr[-2000:]}")
+            with open(ready, "w") as f:
+                f.write(json.dumps({"packages": packages, "options": options}))
+            return python, True
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def main() -> None:
+    """Worker bootstrap: materialize the env, then exec the venv's python
+    into the worker entrypoint (argv after ``--``)."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--pip-spec", required=True, help="JSON pip spec")
+    p.add_argument("--base-dir", default=DEFAULT_BASE_DIR)
+    args = p.parse_args()
+    try:
+        python, _created = ensure_pip_env(
+            json.loads(args.pip_spec), base_dir=args.base_dir)
+    except Exception as e:  # noqa: BLE001 — the exit code IS the signal
+        print(f"runtime_env pip setup failed: {e}", file=sys.stderr)
+        raise SystemExit(77)
+    os.execv(python, [python, "-m", "ray_tpu._private.worker"])
+
+
+if __name__ == "__main__":
+    main()

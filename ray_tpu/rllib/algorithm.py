@@ -214,13 +214,14 @@ class Algorithm(Trainable):
     # -- inference ------------------------------------------------------
     def compute_single_action(self, obs, explore: bool = False) -> int:
         """Greedy (or sampled) action for one observation."""
-        policy = self.workers.local_worker.policy
-        obs = np.asarray(obs, np.float32)[None]
+        worker = self.workers.local_worker
+        policy = worker.policy
+        # the same prep as sampling: images stay [H, W, C] only for
+        # conv-bearing policies, everything else flattens
+        obs = worker._prep_obs(obs)[None]
         if explore:
             action, _, _ = policy.compute_actions(obs)
             return int(action[0])
-        import jax
-
         from ray_tpu.rllib.models import apply_model
 
         logits, _ = apply_model(policy.params, obs)

@@ -51,7 +51,9 @@ class MultiAgentEnv:
 
 class MultiAgentBatch:
     """Per-policy SampleBatches (``policy/sample_batch.py`` MultiAgentBatch
-    analog).  ``count`` is total env steps across policies."""
+    analog).  ``count`` is SUMMED AGENT steps (a 2-agent tick counts 2) —
+    size train_batch_size in agent steps, unlike the reference's
+    env-step count."""
 
     def __init__(self, policy_batches: Dict[str, SampleBatch]):
         self.policy_batches = policy_batches
@@ -103,10 +105,18 @@ class MultiAgentRolloutWorker:
 
         loss_factory = config.get("_loss_factory")
         self.policies: Dict[str, JaxPolicy] = {}
+        if not self.env.agents:
+            raise ValueError(
+                "MultiAgentEnv must list its agent ids in `.agents` at "
+                "construction time (used to probe per-policy spaces)")
         for i, pid in enumerate(ma["policies"]):
             # probe spaces through any agent mapped to this policy
-            agent = next(a for a in self.env.agents
-                         if self.mapping_fn(a) == pid)
+            agent = next((a for a in self.env.agents
+                          if self.mapping_fn(a) == pid), None)
+            if agent is None:
+                raise ValueError(
+                    f"policy {pid!r} has no agent mapped to it "
+                    f"(agents: {self.env.agents}; check policy_mapping_fn)")
             obs_space = self.env.observation_space(agent)
             act_space = self.env.action_space(agent)
             obs_shape = tuple(obs_space.shape)
@@ -165,13 +175,15 @@ class MultiAgentRolloutWorker:
         for _ in range(self.fragment_length):
             # group live agents by policy -> one batched forward per policy
             by_pid: Dict[str, List[Any]] = {}
+            prepped: Dict[Any, np.ndarray] = {}
             for agent, obs in self._obs.items():
                 by_pid.setdefault(self.mapping_fn(agent), []).append(agent)
+                prepped[agent] = self._prep(agent, obs)
             actions: Dict[Any, Any] = {}
             logps: Dict[Any, float] = {}
             vfs: Dict[Any, float] = {}
             for pid, agents in by_pid.items():
-                batch = np.stack([self._prep(a, self._obs[a]) for a in agents])
+                batch = np.stack([prepped[a] for a in agents])
                 acts, lps, vs = self.policies[pid].compute_actions(batch)
                 for j, a in enumerate(agents):
                     actions[a] = acts[j]
@@ -184,7 +196,7 @@ class MultiAgentRolloutWorker:
             all_done = all_term or bool(truncs.get("__all__"))
             for agent in prev_obs:
                 t = self._trail(agent)
-                t.cols[SampleBatch.OBS].append(self._prep(agent, prev_obs[agent]))
+                t.cols[SampleBatch.OBS].append(prepped[agent])
                 t.cols[SampleBatch.ACTIONS].append(actions[agent])
                 t.cols[SampleBatch.REWARDS].append(
                     np.float32(rewards.get(agent, 0.0)))
@@ -353,6 +365,26 @@ class MultiAgentPPO(Algorithm):
             self.workers.local_worker.policies[pid].set_state(s)
         self._timesteps_total = state.get("timesteps_total", 0)
         self.workers.sync_weights()
+
+    def get_policy(self, policy_id: Optional[str] = None):
+        policies = self.workers.local_worker.policies
+        if policy_id is None:
+            if len(policies) != 1:
+                raise ValueError(
+                    f"multiple policies {sorted(policies)}; pass policy_id")
+            return next(iter(policies.values()))
+        return policies[policy_id]
+
+    def compute_single_action(self, obs, policy_id: Optional[str] = None,
+                              explore: bool = False) -> int:
+        policy = self.get_policy(policy_id)
+        o = np.asarray(obs, np.float32)
+        if "conv" not in policy.params:
+            o = o.reshape(-1)
+        if explore:
+            action, _, _ = policy.compute_actions(o[None])
+            return int(action[0])
+        return int(policy.greedy_action(o[None])[0])
 
 
 # set after the class exists (MultiAgentPPOConfig references MultiAgentPPO)
