@@ -207,6 +207,120 @@ def run_decode_bench() -> dict:
     }
 
 
+def run_serve_bench() -> dict:
+    """Serve data plane on the chip: BERT classifier behind the HTTP proxy
+    with @serve.batch (BASELINE config 5 shape), driven by keep-alive
+    connections.  Reports requests/s and end-to-end latency p50/p99."""
+    import http.client
+    import threading
+    import time
+
+    import numpy as np
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    has_tpu = bool(int(os.environ.get("RAY_TPU_BENCH_TPUS", "1")))
+    ray_tpu.init(num_cpus=4, num_tpus=1 if has_tpu else 0)
+    serve.start(serve.HTTPOptions(host="127.0.0.1", port=0))
+    try:
+        actor_opts = {"num_tpus": 1, "max_concurrency": 64} if has_tpu else {
+            "max_concurrency": 64}
+
+        @serve.deployment(ray_actor_options=actor_opts,
+                          max_concurrent_queries=64)
+        class Bert:
+            def __init__(self):
+                import jax
+
+                from ray_tpu.models import bert
+
+                on_tpu = jax.default_backend() == "tpu"
+                self.cfg = (bert.BertConfig.base() if on_tpu
+                            else bert.BertConfig.tiny())
+                self.params = bert.init(self.cfg, jax.random.PRNGKey(0))
+                self._apply = jax.jit(
+                    lambda p, t: bert.apply(p, t, self.cfg))
+
+            @serve.batch(max_batch_size=16, batch_wait_timeout_s=0.005)
+            def __call__(self, requests):
+                import jax.numpy as jnp
+                import numpy as np
+
+                toks = np.stack([r.json()["tokens"] for r in requests])
+                n = len(toks)
+                if n < 16:  # pad to ONE static batch shape: a single
+                    # compiled program serves every arrival pattern
+                    toks = np.concatenate(
+                        [toks, np.zeros((16 - n, toks.shape[1]), toks.dtype)])
+                logits = self._apply(self.params, jnp.asarray(toks))
+                labels = np.asarray(logits.argmax(-1))[:n]
+                return [{"label": int(l)} for l in labels]
+
+        serve.run(Bert.bind(), port=0, timeout_s=600)
+        host, port = serve.get_http_address()
+        seq = 128 if has_tpu else 16
+        body = json.dumps({"tokens": list(range(1, seq + 1))})
+
+        def one_request(conn):
+            t0 = time.perf_counter()
+            conn.request("POST", "/Bert", body=body,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            data = resp.read()
+            assert resp.status == 200, data
+            return time.perf_counter() - t0
+
+        # warm CONCURRENTLY: the batched forward compiles per batch shape,
+        # so serial warmup would leave the full-batch program to compile
+        # inside the measured window (it shows up as a bogus p99)
+        def warm_loop():
+            conn = http.client.HTTPConnection(host, port, timeout=600)
+            for _ in range(3):
+                one_request(conn)
+            conn.close()
+
+        warmers = [threading.Thread(target=warm_loop) for _ in range(16)]
+        for t in warmers:
+            t.start()
+        for t in warmers:
+            t.join()
+
+        n_threads, per_thread = (8, 15) if has_tpu else (4, 5)
+        lats: list = []
+        lats_lock = threading.Lock()
+
+        def client_loop():
+            conn = http.client.HTTPConnection(host, port, timeout=600)
+            mine = [one_request(conn) for _ in range(per_thread)]
+            conn.close()
+            with lats_lock:
+                lats.extend(mine)
+
+        threads = [threading.Thread(target=client_loop)
+                   for _ in range(n_threads)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+        lats.sort()
+        n = len(lats)
+        return {
+            "serve_bert_rps": round(n / wall, 1),
+            "serve_req_p50_ms": round(lats[n // 2] * 1e3, 1),
+            "serve_req_p99_ms": round(lats[min(n - 1, int(n * 0.99))] * 1e3, 1),
+            "serve_concurrent_clients": n_threads,
+        }
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
 def main() -> None:
     trainer_out = run_through_trainer()
     raw_out = run_raw()
@@ -215,6 +329,10 @@ def main() -> None:
     except Exception as e:  # decode metrics are additive — a decode failure
         # must never sink the headline training number the driver records
         decode_out = {"decode_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        decode_out.update(run_serve_bench())
+    except Exception as e:
+        decode_out["serve_error"] = f"{type(e).__name__}: {e}"[:200]
 
     tps = trainer_out["tokens_per_sec"]
     raw_tps = raw_out["tokens_per_sec"]

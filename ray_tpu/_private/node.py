@@ -97,18 +97,9 @@ def _apply_runtime_env(env: Dict[str, str], runtime_env: Optional[dict]) -> Opti
 
 
 def _worker_argv(runtime_env: Optional[dict]) -> List[str]:
-    """Worker process argv.  A pip runtime_env boots through the
-    runtime_env_setup shim, which builds/reuses the hash-keyed venv in the
-    WORKER process (the head's threads never wait on an install) and execs
-    the venv's python into the normal entrypoint."""
-    if runtime_env and runtime_env.get("pip"):
-        import json
+    from ray_tpu._private.runtime_env_setup import worker_argv
 
-        return [
-            sys.executable, "-m", "ray_tpu._private.runtime_env_setup",
-            "--pip-spec", json.dumps(runtime_env["pip"]),
-        ]
-    return [sys.executable, "-m", "ray_tpu._private.worker"]
+    return worker_argv((runtime_env or {}).get("pip"))
 
 
 def _fits(req: Dict[str, float], avail: Dict[str, float]) -> bool:

@@ -172,15 +172,10 @@ class NodeAgent:
         env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
         cwd = msg.get("cwd")
         wid = msg["worker_id"]
-        if msg.get("pip"):
-            import json
+        from ray_tpu._private.runtime_env_setup import worker_argv
 
-            argv = [sys.executable, "-m", "ray_tpu._private.runtime_env_setup",
-                    "--pip-spec", json.dumps(msg["pip"])]
-        else:
-            argv = [sys.executable, "-m", "ray_tpu._private.worker"]
         try:
-            proc = subprocess.Popen(argv, env=env, cwd=cwd)
+            proc = subprocess.Popen(worker_argv(msg.get("pip")), env=env, cwd=cwd)
         except OSError as e:
             self._send({"type": "worker_exited", "worker_id": wid,
                         "returncode": -1, "error": str(e)})

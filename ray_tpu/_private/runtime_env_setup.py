@@ -83,6 +83,17 @@ def ensure_pip_env(pip: PipSpec, base_dir: str = DEFAULT_BASE_DIR) -> Tuple[str,
             fcntl.flock(lock, fcntl.LOCK_UN)
 
 
+def worker_argv(pip: Union[PipSpec, None]) -> List[str]:
+    """Worker process argv — shared by the head and node agents so local
+    and remote spawns can never drift.  A pip spec boots through this
+    module's shim (venv build in the worker process), which then execs the
+    venv's python into the normal entrypoint."""
+    if pip:
+        return [sys.executable, "-m", "ray_tpu._private.runtime_env_setup",
+                "--pip-spec", json.dumps(pip)]
+    return [sys.executable, "-m", "ray_tpu._private.worker"]
+
+
 def main() -> None:
     """Worker bootstrap: materialize the env, then exec the venv's python
     into the worker entrypoint (argv after ``--``)."""

@@ -148,9 +148,12 @@ class MultiAgentRolloutWorker:
         self._total_steps = 0
 
     # -- helpers --------------------------------------------------------
-    def _prep(self, agent, obs) -> np.ndarray:
+    def _prep_for_policy(self, pid: str, obs) -> np.ndarray:
         o = np.asarray(obs, np.float32)
-        return o if self._conv[self.mapping_fn(agent)] else o.reshape(-1)
+        return o if self._conv[pid] else o.reshape(-1)
+
+    def _prep(self, agent, obs) -> np.ndarray:
+        return self._prep_for_policy(self.mapping_fn(agent), obs)
 
     def _trail(self, agent) -> _AgentTrail:
         t = self._trails.get(agent)
@@ -377,10 +380,13 @@ class MultiAgentPPO(Algorithm):
 
     def compute_single_action(self, obs, policy_id: Optional[str] = None,
                               explore: bool = False) -> int:
+        worker = self.workers.local_worker
+        policies = worker.policies
+        if policy_id is None and len(policies) == 1:
+            policy_id = next(iter(policies))
         policy = self.get_policy(policy_id)
-        o = np.asarray(obs, np.float32)
-        if "conv" not in policy.params:
-            o = o.reshape(-1)
+        # the worker's prep, so inference matches sampling exactly
+        o = worker._prep_for_policy(policy_id, obs)
         if explore:
             action, _, _ = policy.compute_actions(o[None])
             return int(action[0])

@@ -22,9 +22,10 @@ from ray_tpu.serve.api import (
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from ray_tpu.serve.handle import DeploymentHandle
-from ray_tpu.serve._private.http_util import Request
+from ray_tpu.serve._private.http_util import Request, StreamingResponse
 
 __all__ = [
+    "StreamingResponse",
     "deployment",
     "Deployment",
     "DeploymentConfig",

@@ -110,7 +110,10 @@ class HTTPProxyActor:
                 router = self._routers.get(name)
                 if router is None:
                     router = self._routers[name] = Router(self._controller, name)
-            result = self._route_with_retry(router, request)
+            result, replica = self._route_with_retry(router, request)
+            if isinstance(result, dict) and "__serve_stream__" in result:
+                self._stream_response(h, replica, result)
+                return
             payload, ctype = encode_response(result)
             self._respond(h, 200, payload, ctype)
         except GetTimeoutError as e:
@@ -134,13 +137,59 @@ class HTTPProxyActor:
 
         last_exc = None
         for _ in range(2):
-            ref = router.assign_request("__call__", (request,), {}, timeout=30.0)
+            ref, replica = router.assign_request(
+                "__call__", (request,), {}, timeout=30.0, return_replica=True)
             try:
-                return ray_tpu.get(ref, timeout=120.0)
+                return ray_tpu.get(ref, timeout=120.0), replica
             except RayActorError as e:
                 router.on_replica_error(ref)
                 last_exc = e
         raise last_exc
+
+    def _stream_response(self, h: BaseHTTPRequestHandler, replica,
+                         meta: Dict) -> None:
+        """Deliver a StreamingResponse with chunked transfer encoding,
+        draining buffered chunks from the replica as the generator produces
+        them (the streaming data plane the reference gets from starlette).
+
+        NEVER raises: once the 200 + chunked headers are on the wire, a
+        second response would corrupt the stream — any failure just ends
+        the body and closes the (no longer reusable) connection."""
+        import ray_tpu
+
+        sid = meta["__serve_stream__"]
+        try:
+            h.send_response(200)
+            h.send_header("Content-Type", meta.get("content_type", "text/plain"))
+            h.send_header("Transfer-Encoding", "chunked")
+            h.end_headers()
+            while True:
+                # non-blocking drain replica-side; an empty reply means the
+                # producer hasn't caught up — pace the poll, don't spin
+                out = ray_tpu.get(replica.next_chunks.remote(sid, 16),
+                                  timeout=120.0)
+                for c in out["chunks"]:
+                    if c:  # a zero-length chunk would terminate the stream
+                        h.wfile.write(f"{len(c):x}\r\n".encode() + c + b"\r\n")
+                h.wfile.flush()
+                if out["done"]:
+                    if out.get("error"):
+                        # mid-stream producer failure: the body is already
+                        # partial — truncate (no terminating chunk) so the
+                        # client sees an aborted stream, not a clean end
+                        h.close_connection = True
+                        return
+                    h.wfile.write(b"0\r\n\r\n")
+                    return
+                if not out["chunks"]:
+                    time.sleep(0.02)
+        except Exception:  # noqa: BLE001 — includes client disconnects and
+            # replica death; the connection is unusable either way
+            h.close_connection = True
+            try:
+                replica.cancel_stream.remote(sid)
+            except Exception:
+                pass
 
     @staticmethod
     def _respond(h: BaseHTTPRequestHandler, code: int, body: bytes, ctype: str) -> None:

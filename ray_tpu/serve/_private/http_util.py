@@ -56,6 +56,26 @@ class Request:
         return f"Request({self.method} {self.path}, {len(self.body)}B)"
 
 
+class StreamingResponse:
+    """Return this from a deployment to stream the response body
+    incrementally (the starlette StreamingResponse seat).  ``iterable``
+    yields str/bytes chunks (anything else is JSON-encoded per chunk); the
+    proxy delivers them with chunked transfer encoding as produced, pulling
+    batches from the replica's stream registry."""
+
+    def __init__(self, iterable, content_type: str = "text/plain"):
+        self.iterable = iterable
+        self.content_type = content_type
+
+
+def encode_chunk(chunk: Any) -> bytes:
+    if isinstance(chunk, bytes):
+        return chunk
+    if isinstance(chunk, str):
+        return chunk.encode()
+    return (json.dumps(chunk) + "\n").encode()
+
+
 def encode_response(result: Any) -> tuple:
     """(body_bytes, content_type) for an HTTP response, mirroring the
     reference proxy's str/bytes/json handling (``http_util.py`` Response)."""

@@ -44,6 +44,10 @@ class ServeReplica:
         self._stats_lock = threading.Lock()
         self._num_requests = 0
         self._start_time = time.time()
+        # live streaming responses: stream id -> iterator (the proxy pulls
+        # batches of chunks with next_chunks until exhausted)
+        self._streams: Dict[str, Any] = {}
+        self._streams_lock = threading.Lock()
 
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict) -> Any:
         """Run one request (``replica.py:250`` handle_request analog).
@@ -56,15 +60,97 @@ class ServeReplica:
                     f"function deployment {self.deployment_name!r} has no "
                     f"method {method_name!r}"
                 )
-            return self.callable(*args, **kwargs)
-        if method_name == "__call__":
+            result = self.callable(*args, **kwargs)
+        elif method_name == "__call__":
             if not callable(self.callable):
                 raise TypeError(
                     f"deployment {self.deployment_name!r} defines no __call__; "
                     "invoke a named method via handle.<method>.remote()"
                 )
-            return self.callable(*args, **kwargs)
-        return getattr(self.callable, method_name)(*args, **kwargs)
+            result = self.callable(*args, **kwargs)
+        else:
+            result = getattr(self.callable, method_name)(*args, **kwargs)
+        from ray_tpu.serve._private.http_util import (
+            Request as _HttpRequest,
+            StreamingResponse,
+        )
+
+        if isinstance(result, StreamingResponse):
+            if not (args and isinstance(args[0], _HttpRequest)):
+                raise TypeError(
+                    "StreamingResponse is only supported for HTTP requests "
+                    "(the proxy drains it incrementally); a DeploymentHandle "
+                    "caller should return/iterate the data directly")
+            return self._register_stream(result)
+        return result
+
+    def _register_stream(self, result) -> Dict[str, Any]:
+        """Drain the generator on a dedicated thread into a bounded queue
+        so follow-up ``next_chunks`` polls never BLOCK a replica executor
+        thread between chunks (N slow streams would otherwise pin N
+        threads and exhaust max_concurrency)."""
+        import queue as queue_mod
+        import threading
+        import uuid
+
+        from ray_tpu.serve._private.http_util import encode_chunk
+
+        sid = uuid.uuid4().hex
+        state = {"q": queue_mod.Queue(maxsize=64), "done": False,
+                 "error": None, "stop": threading.Event()}
+
+        def drain(it=iter(result.iterable)):
+            try:
+                for chunk in it:
+                    data = encode_chunk(chunk)
+                    while not state["stop"].is_set():
+                        try:
+                            state["q"].put(data, timeout=0.2)
+                            break
+                        except queue_mod.Full:
+                            continue
+                    if state["stop"].is_set():
+                        if hasattr(it, "close"):
+                            it.close()
+                        return
+            except Exception as e:  # noqa: BLE001 — surfaced to the proxy
+                state["error"] = f"{type(e).__name__}: {e}"
+            finally:
+                state["done"] = True
+
+        threading.Thread(target=drain, daemon=True,
+                         name=f"serve-stream-{sid[:8]}").start()
+        with self._streams_lock:
+            self._streams[sid] = state
+        return {"__serve_stream__": sid, "content_type": result.content_type}
+
+    def next_chunks(self, sid: str, max_n: int = 16) -> Dict[str, Any]:
+        """Non-blocking drain of up to ``max_n`` buffered chunks; ``done``
+        unregisters the stream, ``error`` carries a producer failure."""
+        import queue as queue_mod
+
+        with self._streams_lock:
+            state = self._streams.get(sid)
+        if state is None:
+            return {"chunks": [], "done": True}
+        chunks = []
+        for _ in range(max_n):
+            try:
+                chunks.append(state["q"].get_nowait())
+            except queue_mod.Empty:
+                break
+        finished = state["done"] and state["q"].empty()
+        if finished:
+            self.cancel_stream(sid)
+        return {"chunks": chunks, "done": finished,
+                "error": state["error"] if finished else None}
+
+    def cancel_stream(self, sid: str) -> bool:
+        with self._streams_lock:
+            state = self._streams.pop(sid, None)
+        if state is not None:
+            state["stop"].set()
+        return state is not None
 
     def reconfigure(self, user_config: Any) -> bool:
         """Apply a new ``user_config`` in place (deployment_state reconciler

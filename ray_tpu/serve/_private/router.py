@@ -149,10 +149,13 @@ class Router:
         args: Tuple,
         kwargs: Dict,
         timeout: Optional[float] = 60.0,
+        return_replica: bool = False,
     ):
-        """Submit one request to a replica; returns the ObjectRef.  Blocks
-        while no replica is available (deployment still starting, or all at
-        max_concurrent_queries)."""
+        """Submit one request to a replica; returns the ObjectRef (or
+        ``(ref, replica_handle)`` with ``return_replica`` — streaming
+        responses need follow-up next_chunks calls on the SAME replica).
+        Blocks while no replica is available (deployment still starting, or
+        all at max_concurrent_queries)."""
         import ray_tpu
         from ray_tpu.exceptions import GetTimeoutError
 
@@ -176,7 +179,7 @@ class Router:
                         ref = handle.handle_request.remote(method_name, args, kwargs)
                         self._inflight.setdefault(tag, []).append(ref)
                         self._push_metrics()
-                        return ref
+                        return (ref, handle) if return_replica else ref
                     self._push_metrics()
                     waitable = [r for refs in self._inflight.values() for r in refs]
                 if deadline is not None and time.monotonic() >= deadline:

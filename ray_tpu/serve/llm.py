@@ -127,7 +127,8 @@ class GenerationEngine:
         self.total_requests = 0
 
     # -- public API ----------------------------------------------------
-    def submit(self, tokens: List[int], max_new: Optional[int] = None) -> Future:
+    def _submit_req(self, tokens: List[int], max_new: Optional[int]) -> _Request:
+        """Validate + enqueue (shared by submit and stream)."""
         if not tokens:
             raise ValueError("empty prompt")
         if len(tokens) > self.buckets[-1]:
@@ -140,11 +141,36 @@ class GenerationEngine:
             self._queue.append(req)
             self.total_requests += 1
         self._work.set()
-        return req.future
+        return req
+
+    def submit(self, tokens: List[int], max_new: Optional[int] = None) -> Future:
+        return self._submit_req(tokens, max_new).future
 
     def generate(self, tokens: List[int], max_new: Optional[int] = None,
                  timeout: float = 300.0) -> List[int]:
         return self.submit(tokens, max_new).result(timeout)
+
+    def stream(self, tokens: List[int], max_new: Optional[int] = None,
+               timeout: float = 300.0):
+        """Yield token ids AS THE ENGINE EMITS THEM (token streaming for
+        serve's chunked responses).  Raises the request's error, if any."""
+        req = self._submit_req(tokens, max_new)
+        n = 0
+        deadline = time.perf_counter() + timeout
+        while True:
+            emitted = req.emitted  # list append is atomic; len-snapshot safe
+            m = len(emitted)
+            while n < m:
+                yield emitted[n]
+                n += 1
+            if req.future.done():
+                for t in req.emitted[n:]:
+                    yield t
+                req.future.result()  # surface engine errors
+                return
+            if time.perf_counter() > deadline:
+                raise TimeoutError("token stream timed out")
+            time.sleep(0.02)
 
     def start(self) -> "GenerationEngine":
         if self._thread is None:
@@ -340,11 +366,24 @@ def llm_deployment(
             self.engine = GenerationEngine(cfg, **ekw).start()
 
         def __call__(self, request):
-            """request: {"tokens": [int, ...], "max_new_tokens": int} ->
-            {"tokens": generated ids}.  Blocks this replica thread; the
-            engine interleaves all in-flight requests between chunks."""
+            """request: {"tokens": [int, ...], "max_new_tokens": int,
+            "stream": bool} -> {"tokens": generated ids}, or a token-per-
+            line StreamingResponse when ``stream`` is set.  Blocks this
+            replica thread; the engine interleaves all in-flight requests
+            between chunks."""
+            from ray_tpu.serve._private.http_util import Request as _HttpReq
+
+            if isinstance(request, _HttpReq):
+                request = request.json()
             if isinstance(request, (list, tuple)):
                 request = {"tokens": list(request)}
+            if request.get("stream"):
+                from ray_tpu import serve as _serve
+
+                gen = self.engine.stream(
+                    request["tokens"], request.get("max_new_tokens"))
+                return _serve.StreamingResponse(
+                    (f"{t}\n" for t in gen), content_type="text/plain")
             toks = self.engine.generate(
                 request["tokens"], request.get("max_new_tokens"))
             return {"tokens": toks}
