@@ -58,6 +58,9 @@ class CoreClient:
         # cancel can interrupt the main thread mid-task (the exec queue
         # would only deliver it after the task finished)
         self._cancel_handler = None
+        # worker-side profiling hook (dashboard on-demand profiling): runs
+        # on its own thread — sampling blocks for the requested duration
+        self._profile_handler = None
         self._subscriptions: Dict[str, list] = {}  # channel -> callbacks
         self._pubsub_queue = None  # created on first subscribe
         self._pubsub_lock = threading.Lock()
@@ -151,6 +154,11 @@ class CoreClient:
                     self._cancel_handler(msg)
                 except Exception:
                     pass
+            elif msg.get("type") == "profile" and self._profile_handler is not None:
+                threading.Thread(
+                    target=self._profile_handler, args=(msg,), daemon=True,
+                    name="profile-request",
+                ).start()
             elif self._exec_queue is not None:
                 self._exec_queue.put(msg)
 

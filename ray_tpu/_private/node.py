@@ -379,6 +379,8 @@ class Node:
         self._outbox_pending: set = set()
         # broadcast fan-out acks: token -> {"event", "ok", "error"}
         self._pull_acks: Dict[str, dict] = {}
+        # on-demand worker profiling acks: token -> {"event", "report"}
+        self._profile_acks: Dict[str, dict] = {}
         # dynamic-return yield directory: task_id -> {"attempt": n, "oids":
         # [..]} in yield order (streamed to ObjectRefGenerator consumers;
         # the attempt counter lets a consumer detect a mid-stream retry)
@@ -972,6 +974,11 @@ class Node:
             threading.Thread(
                 target=self._on_broadcast, args=(conn, msg), daemon=True
             ).start()
+        elif mtype == "profile_result":
+            holder = self._profile_acks.pop(msg.get("token"), None)
+            if holder is not None:
+                holder["report"] = msg.get("report")
+                holder["event"].set()
         elif mtype == "metrics_report":
             self.worker_metrics_registry.merge(msg["origin"], msg["metrics"])
         elif mtype == "log":

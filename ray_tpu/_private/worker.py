@@ -795,6 +795,17 @@ def main() -> None:
         )
 
     client._cancel_handler = _on_cancel_message
+
+    def _on_profile_message(msg):
+        # dashboard on-demand profiling (profile_manager.py analog): sample
+        # this process for the requested window, report back to the head
+        from ray_tpu._private.sampling_profiler import profile_for
+
+        report = profile_for(float(msg.get("duration", 3.0)))
+        client.send({"type": "profile_result", "token": msg.get("token"),
+                     "report": report})
+
+    client._profile_handler = _on_profile_message
     while True:
         try:
             msg = client._exec_queue.get()

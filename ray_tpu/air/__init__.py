@@ -13,9 +13,11 @@ from ray_tpu.air.config import (
     ScalingConfig,
 )
 from ray_tpu.air.result import Result
+from ray_tpu.air import remote_storage
 from ray_tpu.air import session
 
 __all__ = [
+    "remote_storage",
     "Checkpoint",
     "ScalingConfig",
     "RunConfig",

@@ -85,6 +85,13 @@ class Dashboard:
         if path == "/metrics":
             self._send(req, self._metrics_text(), ctype="text/plain; version=0.0.4")
             return
+        if path == "/api/profile":
+            # on-demand sampling profile (py-spy/profile_manager.py analog):
+            # ?duration=3 for the head; &worker_id=<hex> for a worker
+            duration = min(30.0, float(qs.get("duration", ["3"])[0]))
+            wid = qs.get("worker_id", [None])[0]
+            self._send(req, json.dumps(self._profile(wid, duration)))
+            return
         if path.startswith("/api/"):
             payload = self._api(path[len("/api/"):], limit)
             if payload is None:
@@ -104,6 +111,39 @@ class Dashboard:
         req.send_header("Content-Length", str(len(data)))
         req.end_headers()
         req.wfile.write(data)
+
+    def _profile(self, worker_id_hex, duration: float):
+        """Sample the head process, or ask a worker to sample itself."""
+        import os as _os
+        import threading as _threading
+
+        if worker_id_hex is None:
+            from ray_tpu._private.sampling_profiler import profile_for
+
+            return {"target": "head", "duration_s": duration,
+                    "report": profile_for(duration)}
+        node = self.node
+        try:
+            wid = bytes.fromhex(worker_id_hex)
+        except ValueError:
+            return {"error": f"bad worker_id {worker_id_hex!r}"}
+        with node.lock:
+            w = node.workers.get(wid)
+        if w is None or w.conn is None or w.state == "dead":
+            return {"error": "unknown or dead worker"}
+        token = _os.urandom(8).hex()
+        holder = {"event": _threading.Event(), "report": None}
+        node._profile_acks[token] = holder
+        try:
+            w.send({"type": "profile", "token": token, "duration": duration})
+        except (OSError, ValueError):
+            node._profile_acks.pop(token, None)
+            return {"error": "worker unreachable"}
+        if not holder["event"].wait(duration + 30.0):
+            node._profile_acks.pop(token, None)
+            return {"error": "profile timed out"}
+        return {"target": worker_id_hex, "duration_s": duration,
+                "report": holder["report"]}
 
     # -- payloads ----------------------------------------------------------
     def _api(self, what: str, limit: int):

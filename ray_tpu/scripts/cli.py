@@ -79,6 +79,23 @@ def cmd_start(args) -> None:
         raise SystemExit("start needs --head or --address")
 
 
+def cmd_up(args) -> None:
+    """``ray up`` analog: start head + join workers per the YAML."""
+    from ray_tpu.autoscaler.commands import load_cluster_config, up
+
+    out = up(load_cluster_config(args.config))
+    print(json.dumps(out, indent=2))
+    print(f"cluster up: {out['address']} "
+          f"({len(out['workers'])} worker nodes joining)")
+
+
+def cmd_down(args) -> None:
+    from ray_tpu.autoscaler.commands import down, load_cluster_config
+
+    down(load_cluster_config(args.config))
+    print("cluster down")
+
+
 def cmd_stop(_args) -> None:
     sess = _session()
     pid = sess.get("pid")
@@ -191,6 +208,14 @@ def main(argv=None) -> None:
     s.add_argument("--num-tpus", type=int, default=None)
     s.add_argument("--shm-dir", default=None)
     s.set_defaults(fn=cmd_start)
+
+    s = sub.add_parser("up", help="launch a cluster from a YAML spec")
+    s.add_argument("config", help="cluster YAML (see autoscaler/commands.py)")
+    s.set_defaults(fn=cmd_up)
+
+    s = sub.add_parser("down", help="tear down a YAML-launched cluster")
+    s.add_argument("config")
+    s.set_defaults(fn=cmd_down)
 
     sub.add_parser("stop", help="stop the last started head").set_defaults(fn=cmd_stop)
     sub.add_parser("status", help="cluster summary").set_defaults(fn=cmd_status)

@@ -70,11 +70,20 @@ class Tuner:
         raise TypeError(f"cannot make a trainable from {trainable!r}")
 
     def _exp_dir(self) -> str:
+        from ray_tpu.air import remote_storage
+
         base = self.run_config.storage_path or os.path.join(
             tempfile.gettempdir(), "ray_tpu_results"
         )
         name = self.run_config.name or f"tune_{int(time.time())}"
-        path = os.path.join(base, name)
+        if remote_storage.is_uri(base):
+            # remote experiment storage (tune/syncer.py seam): run against
+            # a local working dir, sync it to the URI on every state save
+            self._sync_uri = base.rstrip("/") + "/" + name
+            path = os.path.join(tempfile.gettempdir(), "ray_tpu_results", name)
+        else:
+            self._sync_uri = None
+            path = os.path.join(base, name)
         os.makedirs(path, exist_ok=True)
         return path
 
@@ -124,8 +133,10 @@ class Tuner:
         for i, t in enumerate(trials):
             ckpt_dir = None
             if t.checkpoint is not None:
-                ckpt_dir = os.path.join(exp_dir, f"trial_{t.trial_id}")
-                t.checkpoint.to_directory(ckpt_dir)
+                # stored RELATIVE to exp_dir: a restore on another machine
+                # (different tempdir) re-roots it under its own download
+                ckpt_dir = f"trial_{t.trial_id}"
+                t.checkpoint.to_directory(os.path.join(exp_dir, ckpt_dir))
             state.append({
                 "trial_id": t.trial_id, "config": t.config, "status": t.status,
                 "last_result": t.last_result, "error": t.error,
@@ -133,14 +144,26 @@ class Tuner:
             })
         with open(os.path.join(exp_dir, _STATE_FILE), "wb") as f:
             pickle.dump({"trials": state, "param_space": self.param_space}, f)
+        if getattr(self, "_sync_uri", None):
+            from ray_tpu.air import remote_storage
+
+            remote_storage.upload_dir(exp_dir, self._sync_uri)
 
     @classmethod
     def restore(cls, path: str, trainable: Any,
                 tune_config: Optional[TuneConfig] = None) -> "Tuner":
         """Resume: finished trials keep their results, unfinished ones
-        restart from their latest checkpoints."""
-        from ray_tpu.air import Checkpoint
+        restart from their latest checkpoints.  ``path`` may be a storage
+        URI — the experiment is downloaded to a local working dir first."""
+        from ray_tpu.air import Checkpoint, remote_storage
 
+        storage_path = os.path.dirname(path.rstrip("/"))
+        exp_name = os.path.basename(path.rstrip("/"))
+        if remote_storage.is_uri(path):
+            local = os.path.join(
+                tempfile.gettempdir(), "ray_tpu_results", exp_name)
+            remote_storage.download_dir(path, local)
+            path = local
         with open(os.path.join(path, _STATE_FILE), "rb") as f:
             state = pickle.load(f)
         trials = []
@@ -148,15 +171,20 @@ class Tuner:
             t = T.Trial(config=s["config"], trial_id=s["trial_id"])
             t.last_result = s["last_result"]
             t.error = s["error"]
-            if s["checkpoint_dir"] and os.path.isdir(s["checkpoint_dir"]):
-                t.checkpoint = Checkpoint.from_directory(s["checkpoint_dir"])
+            ckpt_dir = s["checkpoint_dir"]
+            if ckpt_dir:
+                if not os.path.isabs(ckpt_dir):  # re-root relative entries
+                    ckpt_dir = os.path.join(path, ckpt_dir)
+                if os.path.isdir(ckpt_dir):
+                    t.checkpoint = Checkpoint.from_directory(ckpt_dir)
             t.status = s["status"] if s["status"] in (T.TERMINATED, T.ERROR) else T.PENDING
             trials.append(t)
         tuner = cls(
             trainable, param_space=state["param_space"],
             tune_config=tune_config,
-            run_config=RunConfig(storage_path=os.path.dirname(path),
-                                 name=os.path.basename(path)),
+            # keep the ORIGINAL storage_path (URI included): a resumed
+            # fit() re-derives the sync target and uploads state back
+            run_config=RunConfig(storage_path=storage_path, name=exp_name),
             _trials=trials,
         )
         return tuner
