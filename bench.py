@@ -145,8 +145,8 @@ def run_through_trainer() -> dict:
 
 def run_decode_bench() -> dict:
     """LLM decode serving on the chip: the continuous-batching engine
-    (ray_tpu.serve.llm) inside a ``num_tpus=1`` actor — GPT-2 125M, 8 cache
-    slots, 32 concurrent requests of 128 new tokens each.  Reports
+    (ray_tpu.serve.llm) inside a ``num_tpus=1`` actor — GPT-2 125M, 16
+    cache slots, 32 concurrent requests of 128 new tokens each.  Reports
     aggregate decode tokens/s and engine-side request latency p50/p99."""
     import time
 
@@ -169,7 +169,7 @@ def run_decode_bench() -> dict:
             cfg = make_config("gpt2", "small" if on_tpu else "tiny")
             self.engine = GenerationEngine(
                 cfg,
-                n_slots=8,
+                n_slots=16 if on_tpu else 8,
                 max_new_tokens=self.n_new,
                 decode_chunk_steps=64 if on_tpu else 4,
                 prefill_buckets=(128,),  # prompts are 16-99 tokens either way

@@ -8,8 +8,11 @@ first-class TPU path, designed for XLA:
 - **Static shapes everywhere**: the cache is a fixed ``[L, B, KV, S, dh]``
   buffer; positions are dynamic *values*, never dynamic shapes, so the
   decode step compiles once and runs for every token.
-- **Layer-stacked cache + ``lax.scan``**: the per-layer cache rides the
-  same scan as the stacked block params — one compiled block body.
+- **In-place cache**: the decode layer loop is a ``fori_loop`` carrying
+  the full cache; each layer writes only its new K/V column with one
+  scatter, and XLA's while-loop buffer aliasing keeps the cache in place
+  (a scan that re-emits the cache per step measured ~1.3 ms/step of pure
+  rewrite traffic at GPT-2 125M on v5e).
 - **Per-slot positions**: each batch slot sits at its own offset (``pos``
   vector), which is what iteration-level continuous batching needs
   (Orca-style; see :mod:`ray_tpu.serve.llm`).
@@ -18,11 +21,10 @@ first-class TPU path, designed for XLA:
   not per token — host<->device latency is the decode killer on a
   tunneled chip.
 
-Cache writes land at each slot's current position via a vmapped
-``dynamic_update_slice``; finished/idle slots simply keep writing at their
-frozen position, which is harmless because a slot's attention mask never
-reaches an index its own ``pos`` hasn't covered and prefill overwrites
-``[0, len)`` when a slot is reused.
+Cache columns of finished/idle slots keep being written at their frozen
+position, which is harmless: a slot's attention mask never reaches an
+index its own ``pos`` hasn't covered, and prefill overwrites ``[0, len)``
+when a slot is reused.
 """
 
 from __future__ import annotations
@@ -60,16 +62,6 @@ def init_cache(cfg, n_slots: int, max_len: int) -> Dict[str, jax.Array]:
     }
 
 
-def _write_kv(cache_l: jax.Array, new: jax.Array, pos: jax.Array) -> jax.Array:
-    """Write ``new [B, KV, T, dh]`` into ``cache_l [B, KV, S, dh]`` at each
-    slot's ``pos [B]`` (vmapped dynamic_update_slice -> one scatter)."""
-
-    def upd(c, n, p):
-        return lax.dynamic_update_slice(c, n.astype(c.dtype), (0, p, 0))
-
-    return jax.vmap(upd)(cache_l, new, pos)
-
-
 def _decode_attend(q, k_cache, v_cache, pos) -> jax.Array:
     """q ``[B, H, 1, dh]`` against the full cache ``[B, KV, S, dh]`` with a
     per-slot length mask ``j <= pos``.  GQA folds the query heads onto
@@ -96,72 +88,80 @@ def _decode_attend(q, k_cache, v_cache, pos) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
-# per-family block math (prefill captures K/V; decode reads the cache)
+# per-family block math — ONE implementation serves prefill and decode:
+# _qkv projects (post-rope, [B, heads, T, dh]), _post_attn applies the
+# output projection + FFN residuals; only the attention middle differs
+# (full causal for prefill, cache-masked for decode)
 # ---------------------------------------------------------------------------
 
-def _gpt2_block(x, p, cfg: GPT2Config, *, cache_kv=None, pos=None):
-    """One GPT-2 block.  Prefill mode (cache_kv None): full causal self-
-    attention over ``x [B, T, D]``, returns ``(x, (k, v))``.  Decode mode:
-    ``x [B, 1, D]`` attends over the cache, returns ``(x, (k_cache,
-    v_cache))`` with the new K/V written at ``pos``."""
-    B, T, D = x.shape
+def _gpt2_qkv(x, p, cfg: GPT2Config):
+    """x [B, T, D] -> q, k, v [B, H, T, dh]."""
+    B, T, _ = x.shape
     H, dh = cfg.n_heads, cfg.head_dim
     c = lambda w: w.astype(cfg.dtype)
-
     h = layernorm(x, c(p["ln1_w"]), c(p["ln1_b"]))
     qkv = h @ c(p["wqkv"]) + c(p["bqkv"])
     q, k, v = jnp.split(qkv, 3, axis=-1)
     to_heads = lambda t: t.reshape(B, T, H, dh).transpose(0, 2, 1, 3)
-    q, k, v = to_heads(q), to_heads(k), to_heads(v)
-    if cache_kv is None:
-        from ray_tpu.ops.attention import attention
+    return to_heads(q), to_heads(k), to_heads(v)
 
-        out = attention(q, k, v, causal=True)
-        saved = (k, v)
-    else:
-        k_cache = _write_kv(cache_kv[0], k, pos)
-        v_cache = _write_kv(cache_kv[1], v, pos)
-        out = _decode_attend(q, k_cache, v_cache, pos)
-        saved = (k_cache, v_cache)
-    out = out.transpose(0, 2, 1, 3).reshape(B, T, D).astype(cfg.dtype)
+
+def _gpt2_post_attn(x, out, p, cfg: GPT2Config):
+    """out [B, T, D] (attention result, head-merged) -> next x."""
+    c = lambda w: w.astype(cfg.dtype)
     x = x + out @ c(p["wo"]) + c(p["bo"])
     h = layernorm(x, c(p["ln2_w"]), c(p["ln2_b"]))
     h = jax.nn.gelu(h @ c(p["w1"]) + c(p["b1"]), approximate=True)
-    x = x + h @ c(p["w2"]) + c(p["b2"])
-    return x, saved
+    return x + h @ c(p["w2"]) + c(p["b2"])
 
 
-def _llama_block(x, p, cfg: LlamaConfig, positions, *, cache_kv=None, pos=None):
-    """One Llama block (RMSNorm/RoPE/GQA/SwiGLU); same two modes as
-    :func:`_gpt2_block`.  The cache stores post-RoPE keys in the KV-head
-    layout (``n_kv_heads`` rows — the GQA memory saving)."""
-    B, T, D = x.shape
+def _llama_qkv(x, p, cfg: LlamaConfig, positions):
+    """x [B, T, D] -> post-rope q [B, H, T, dh], k/v [B, KV, T, dh] (the
+    GQA KV-head layout the cache stores)."""
+    B, T, _ = x.shape
     H, KV, dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
     dt = cfg.dtype
-
     h = rmsnorm(x, p["attn_norm"].astype(dt), eps=cfg.rms_eps)
     q = (h @ p["wq"].astype(dt)).reshape(B, T, H, dh).transpose(0, 2, 1, 3)
     k = (h @ p["wk"].astype(dt)).reshape(B, T, KV, dh).transpose(0, 2, 1, 3)
     v = (h @ p["wv"].astype(dt)).reshape(B, T, KV, dh).transpose(0, 2, 1, 3)
-    q = rope(q, positions, base=cfg.rope_base)
-    k = rope(k, positions, base=cfg.rope_base)
-    if cache_kv is None:
-        kr = jnp.repeat(k, cfg.q_per_kv, axis=1)
-        vr = jnp.repeat(v, cfg.q_per_kv, axis=1)
-        from ray_tpu.ops.attention import attention
+    return (rope(q, positions, base=cfg.rope_base),
+            rope(k, positions, base=cfg.rope_base), v)
 
-        out = attention(q, kr, vr, causal=True)
-        saved = (k, v)
-    else:
-        k_cache = _write_kv(cache_kv[0], k, pos)
-        v_cache = _write_kv(cache_kv[1], v, pos)
-        out = _decode_attend(q, k_cache, v_cache, pos)
-        saved = (k_cache, v_cache)
-    out = out.transpose(0, 2, 1, 3).reshape(B, T, H * dh).astype(dt)
+
+def _llama_post_attn(x, out, p, cfg: LlamaConfig):
+    dt = cfg.dtype
     x = x + out @ p["wo"].astype(dt)
     h = rmsnorm(x, p["ffn_norm"].astype(dt), eps=cfg.rms_eps)
     gated = jax.nn.silu(h @ p["w_gate"].astype(dt)) * (h @ p["w_up"].astype(dt))
-    return x + gated @ p["w_down"].astype(dt), saved
+    return x + gated @ p["w_down"].astype(dt)
+
+
+def _gpt2_block(x, p, cfg: GPT2Config):
+    """One GPT-2 prefill block: full causal self-attention over
+    ``x [B, T, D]``; returns ``(x, (k, v))`` for the cache."""
+    B, T, D = x.shape
+    q, k, v = _gpt2_qkv(x, p, cfg)
+    from ray_tpu.ops.attention import attention
+
+    out = attention(q, k, v, causal=True)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, D).astype(cfg.dtype)
+    return _gpt2_post_attn(x, out, p, cfg), (k, v)
+
+
+def _llama_block(x, p, cfg: LlamaConfig, positions):
+    """One Llama prefill block (RMSNorm/RoPE/GQA/SwiGLU); the cache stores
+    post-RoPE keys in the KV-head layout (the GQA memory saving)."""
+    B, T, _ = x.shape
+    H, dh = cfg.n_heads, cfg.head_dim
+    q, k, v = _llama_qkv(x, p, cfg, positions)
+    kr = jnp.repeat(k, cfg.q_per_kv, axis=1)
+    vr = jnp.repeat(v, cfg.q_per_kv, axis=1)
+    from ray_tpu.ops.attention import attention
+
+    out = attention(q, kr, vr, causal=True)
+    out = out.transpose(0, 2, 1, 3).reshape(B, T, H * dh).astype(cfg.dtype)
+    return _llama_post_attn(x, out, p, cfg), (k, v)
 
 
 # ---------------------------------------------------------------------------
@@ -231,28 +231,54 @@ def decode_step(params, cfg, cache: Dict[str, jax.Array], tokens: jax.Array,
                 active: jax.Array) -> Tuple[jax.Array, Dict]:
     """One token for every slot.  ``tokens [B]`` are each slot's last
     emitted token, written at ``pos`` then attended; ``active [B]`` bool
-    gates the position advance.  Returns ``(logits [B, V], cache)``."""
+    gates the position advance.  Returns ``(logits [B, V], cache)``.
+
+    The layer loop is a ``fori_loop`` carrying the FULL cache and writing
+    each layer's new K/V column with one scatter — XLA's while-loop buffer
+    aliasing keeps the cache in place.  (The earlier scan-with-outputs
+    version rebuilt the whole cache every step: measured ~1.3 ms/step of
+    pure rewrite traffic on v5e at GPT-2 125M, on top of the ~1.2 ms
+    weight-streaming floor.)"""
     fam = family_of(cfg)
     pos = cache["pos"]
+    B = tokens.shape[0]
+    H, dh = cfg.n_heads, cfg.head_dim
+    KV = kv_heads(cfg)
     x = _embed(params, tokens[:, None], cfg, pos[:, None])  # [B, 1, D]
+    blocks = params["blocks"]
+    iota_b = jnp.arange(B)[:, None]
+    iota_kv = jnp.arange(KV)[None, :]
+    positions = pos[:, None]  # [B, 1] per-slot offsets (rope)
 
-    if fam == "gpt2":
-        def body(h, xs):
-            p, k_l, v_l = xs
-            h, (k_l, v_l) = _gpt2_block(h, p, cfg, cache_kv=(k_l, v_l), pos=pos)
-            return h, (k_l, v_l)
-    else:
-        positions = pos[:, None]  # [B, 1] per-slot rope offsets
-        def body(h, xs):
-            p, k_l, v_l = xs
-            h, (k_l, v_l) = _llama_block(
-                h, p, cfg, positions, cache_kv=(k_l, v_l), pos=pos)
-            return h, (k_l, v_l)
+    def layer(l, carry):
+        x, k_all, v_all = carry  # x [B, 1, D]
+        p = jax.tree.map(
+            lambda a: lax.dynamic_index_in_dim(a, l, 0, keepdims=False),
+            blocks)
+        if fam == "gpt2":
+            q, k, v = _gpt2_qkv(x, p, cfg)  # [B, heads, 1, dh]
+        else:
+            q, k, v = _llama_qkv(x, p, cfg, positions)
+        # ONE scatter per tensor writes only the new column (l, b, :, pos_b)
+        k_all = k_all.at[l, iota_b, iota_kv, positions, :].set(
+            k[:, :, 0, :].astype(k_all.dtype))
+        v_all = v_all.at[l, iota_b, iota_kv, positions, :].set(
+            v[:, :, 0, :].astype(v_all.dtype))
+        k_c = lax.dynamic_index_in_dim(k_all, l, 0, keepdims=False)
+        v_c = lax.dynamic_index_in_dim(v_all, l, 0, keepdims=False)
+        out = _decode_attend(q, k_c, v_c, pos)  # [B, H, 1, dh]
+        out = out.transpose(0, 2, 1, 3).reshape(B, 1, H * dh).astype(cfg.dtype)
+        if fam == "gpt2":
+            x = _gpt2_post_attn(x, out, p, cfg)
+        else:
+            x = _llama_post_attn(x, out, p, cfg)
+        return x, k_all, v_all
 
-    x, (ks, vs) = lax.scan(body, x, (params["blocks"], cache["k"], cache["v"]))
+    x, k_all, v_all = lax.fori_loop(
+        0, cfg.n_layers, layer, (x, cache["k"], cache["v"]))
     logits = _unembed(params, x, cfg)[:, 0, :]
     return logits, {
-        "k": ks, "v": vs,
+        "k": k_all, "v": v_all,
         "pos": pos + active.astype(jnp.int32),
     }
 
