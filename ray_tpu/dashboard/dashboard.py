@@ -38,13 +38,76 @@ def _jsonable(obj):
     return repr(obj)
 
 
-_INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title></head>
-<body><h2>ray_tpu cluster</h2><pre id="s">loading...</pre>
-<script>fetch('/api/cluster_status').then(r=>r.json()).then(
- d=>document.getElementById('s').textContent=JSON.stringify(d,null,2));</script>
-<p>endpoints: /api/cluster_status /api/nodes /api/actors /api/tasks
-/api/placement_groups /api/workers /api/objects /api/jobs /metrics</p>
-</body></html>"""
+# Single-page web UI over the JSON API (the reference ships a 22k-LoC
+# TypeScript frontend, dashboard/client/src; this is the build-step-free
+# equivalent: live tables for every state table, auto-refreshing).
+_INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:0;background:#f6f7f9;color:#1a1a2e}
+ header{background:#1a1a2e;color:#fff;padding:10px 18px;display:flex;
+        align-items:baseline;gap:16px}
+ header h1{font-size:17px;margin:0} header span{opacity:.7;font-size:12px}
+ nav{display:flex;gap:4px;padding:8px 14px;flex-wrap:wrap}
+ nav button{border:1px solid #ccd;border-radius:6px;background:#fff;
+            padding:5px 12px;cursor:pointer;font-size:13px}
+ nav button.on{background:#1a1a2e;color:#fff;border-color:#1a1a2e}
+ #cards{display:flex;gap:10px;padding:4px 14px;flex-wrap:wrap}
+ .card{background:#fff;border:1px solid #e3e5ea;border-radius:8px;
+       padding:8px 14px;min-width:110px}
+ .card b{display:block;font-size:20px} .card small{color:#667}
+ main{padding:8px 14px} table{border-collapse:collapse;width:100%;
+      background:#fff;border:1px solid #e3e5ea;border-radius:8px;font-size:12px}
+ th,td{padding:5px 9px;text-align:left;border-bottom:1px solid #eef0f4;
+       max-width:340px;overflow:hidden;text-overflow:ellipsis;white-space:nowrap}
+ th{background:#eef0f4;position:sticky;top:0} tr:hover td{background:#f3f6ff}
+ .ALIVE,.RUNNING,.FINISHED,.true{color:#0a7d38}.DEAD,.FAILED,.false{color:#c0222b}
+ #foot{color:#889;font-size:11px;padding:10px 14px}
+</style></head><body>
+<header><h1>ray_tpu</h1><span id="hdr"></span></header>
+<div id="cards"></div>
+<nav id="nav"></nav>
+<main><table id="tbl"><thead></thead><tbody></tbody></table></main>
+<div id="foot">auto-refresh 2s &middot; JSON API: /api/&lt;table&gt;,
+ /api/cluster_status, /api/serve/applications,
+ /api/profile?duration=3[&amp;worker_id=], /metrics</div>
+<script>
+const TABS=["nodes","actors","tasks","workers","objects","placement_groups",
+            "jobs","serve"];
+let tab="nodes";
+const nav=document.getElementById("nav");
+TABS.forEach(t=>{const b=document.createElement("button");b.textContent=t;
+ b.onclick=()=>{tab=t;render()};nav.appendChild(b);});
+function cell(v){if(v===null)return"";if(typeof v==="object")
+ return JSON.stringify(v);return String(v);}
+async function render(){
+ [...nav.children].forEach(b=>b.classList.toggle("on",b.textContent===tab));
+ try{
+  const s=await (await fetch("/api/cluster_status")).json();
+  document.getElementById("hdr").textContent=
+   Object.entries(s.cluster_resources).map(([n,r])=>
+    n+": "+Object.entries(r).map(([k,v])=>k+"="+v).join(" ")).join(" | ");
+  const cards=[["nodes",s.num_nodes],["actors",s.num_actors],
+   ["tasks",s.num_tasks],["workers",s.num_workers],
+   ["objects",s.object_store.num_objects??s.object_store.objects??"-"],
+   ["store MB",Math.round((s.object_store.bytes_used??0)/1048576)]];
+  document.getElementById("cards").innerHTML=cards.map(([k,v])=>
+   `<div class=card><b>${v}</b><small>${k}</small></div>`).join("");
+  const url=tab==="serve"?"/api/serve/applications":"/api/"+tab+"?limit=200";
+  let rows=await (await fetch(url)).json();
+  if(!Array.isArray(rows)){rows=Object.entries(rows||{}).map(([k,v])=>
+   Object.assign({name:k},typeof v==="object"?v:{value:v}));}
+  const thead=document.querySelector("#tbl thead"),
+        tbody=document.querySelector("#tbl tbody");
+  if(!rows.length){thead.innerHTML="";tbody.innerHTML=
+   "<tr><td>(empty)</td></tr>";return;}
+  const cols=Object.keys(rows[0]);
+  thead.innerHTML="<tr>"+cols.map(c=>`<th>${c}</th>`).join("")+"</tr>";
+  tbody.innerHTML=rows.map(r=>"<tr>"+cols.map(c=>
+   `<td class="${cell(r[c])}">${cell(r[c])}</td>`).join("")+"</tr>").join("");
+ }catch(e){document.getElementById("hdr").textContent="error: "+e;}
+}
+render();setInterval(render,2000);
+</script></body></html>"""
 
 
 class Dashboard:

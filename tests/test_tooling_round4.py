@@ -219,3 +219,14 @@ def test_tasks_survive_node_agent_kill(tmp_path):
         assert len([n for n in ray_tpu.nodes() if n["Alive"]]) == 1
     finally:
         cluster.shutdown()
+
+
+def test_dashboard_web_ui_serves(ray_start_regular):
+    """The single-page UI (the TS-frontend seat) renders with live tables."""
+    node = ray_tpu._private.worker.global_worker.node
+    host, port = node.dashboard.address
+    with urllib.request.urlopen(f"http://{host}:{port}/", timeout=60) as r:
+        html = r.read().decode()
+    assert "<table" in html and "auto-refresh" in html
+    for tab in ("nodes", "actors", "tasks", "workers"):
+        assert f'"{tab}"' in html  # tab registry present
