@@ -23,7 +23,11 @@ class WorkerSet:
         # Local worker: holds the learner policy; also samples when n == 0.
         self.local_worker = worker_cls(config, worker_index=0)
         RemoteWorker = ray_tpu.remote(worker_cls)
-        opts = {"num_cpus": config.get("num_cpus_per_worker", 1)}
+        # rollout workers restart on crash and retry the in-flight sample
+        # (the reference recreates failed rollout workers the same way);
+        # sync_weights re-broadcasts the policy each training step anyway
+        opts = {"num_cpus": config.get("num_cpus_per_worker", 1),
+                "max_restarts": 2, "max_task_retries": 2}
         self.remote_workers = [
             RemoteWorker.options(**opts).remote(config, worker_index=i + 1)
             for i in range(n)

@@ -13,7 +13,8 @@ from ray_tpu.util.scheduling_strategies import (
 
 def test_pg_create_ready(ray_start_regular):
     pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
-    assert pg.wait(30)
+    # generous: under full-suite contention a 30s bound has flaked
+    assert pg.wait(120)
 
 
 def test_pg_infeasible_pending(ray_start_regular):
