@@ -319,6 +319,11 @@ class CoreClient:
             pass
         self.closed = True
         self._flush_event.set()  # let the flusher thread exit
+        from ray_tpu._private.netutil import force_close_connection
+
+        # shutdown(2) wakes the recv thread; close alone would leave it
+        # parked forever (the per-session thread leak)
+        force_close_connection(self.conn)
         if self._pubsub_queue is not None:
             self._pubsub_queue.put(None)  # end the dispatcher thread
         try:

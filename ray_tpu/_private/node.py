@@ -381,6 +381,10 @@ class Node:
         self._pull_acks: Dict[str, dict] = {}
         # on-demand worker profiling acks: token -> {"event", "report"}
         self._profile_acks: Dict[str, dict] = {}
+        # accepted connections whose reader threads are alive: shutdown
+        # force-closes them (close alone never wakes a blocked recv — the
+        # leak that accumulated threads across sessions in one process)
+        self._live_conns: set = set()
         # dynamic-return yield directory: task_id -> {"attempt": n, "oids":
         # [..]} in yield order (streamed to ObjectRefGenerator consumers;
         # the attempt counter lets a consumer detect a mid-stream retry)
@@ -650,6 +654,7 @@ class Node:
         is_client = False
         with self.lock:
             self._conn_locks[id(conn)] = threading.Lock()
+            self._live_conns.add(conn)
         try:
             while not self._shutdown:
                 try:
@@ -680,7 +685,15 @@ class Node:
                 else:
                     self._handle_message(conn, handle, msg)
         finally:
+            # release the fd NOW: WorkerHandle/agent references keep the
+            # Connection object alive long after EOF, and unclosed accepted
+            # conns were the per-session fd leak
+            try:
+                conn.close()
+            except Exception:
+                pass
             with self.lock:
+                self._live_conns.discard(conn)
                 # a disconnected peer's pubsub subscriptions die with it
                 for subs in self.subscribers.values():
                     if conn in subs:
@@ -2898,14 +2911,20 @@ class Node:
                 ns.agent_send({"type": "shutdown"})
             except Exception:
                 pass
-        try:
-            self._listener.close()
-        except Exception:
-            pass
-        try:
-            self._tcp_listener.close()
-        except Exception:
-            pass
+        from ray_tpu._private.netutil import (
+            force_close_connection,
+            unblock_listener,
+        )
+
+        # wake the accept loops (close alone leaves accept(2) parked) and
+        # every reader thread (their peers also see EOF promptly)
+        unblock_listener(self._listener)
+        unblock_listener(self._tcp_listener)
+        with self.lock:
+            conns = list(self._live_conns)
+            self._live_conns.clear()
+        for conn in conns:
+            force_close_connection(conn)
         try:
             if self.dashboard is not None:
                 self.dashboard.close()

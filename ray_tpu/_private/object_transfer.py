@@ -100,10 +100,9 @@ class ObjectServer:
 
     def close(self) -> None:
         self._shutdown = True
-        try:
-            self._listener.close()
-        except Exception:
-            pass
+        from ray_tpu._private.netutil import unblock_listener
+
+        unblock_listener(self._listener)  # wakes the parked accept loop
 
 
 # -- pull client -----------------------------------------------------------

@@ -152,8 +152,12 @@ def sweep_orphaned_segments() -> int:
             with open(os.path.join(shm_dir(), marker)) as f:
                 pid = int(f.read().strip() or "0")
             os.kill(pid, 0)  # raises if dead
-            alive = True
-        except (OSError, ValueError):
+            # a ZOMBIE still answers kill(pid, 0) but owns nothing — in
+            # containers whose pid 1 never reaps orphans, a SIGKILL'd
+            # head would otherwise pin its segments forever
+            with open(f"/proc/{pid}/stat") as f:
+                alive = f.read().rsplit(")", 1)[-1].split()[0] != "Z"
+        except (OSError, ValueError, IndexError):
             alive = False
         if alive:
             continue

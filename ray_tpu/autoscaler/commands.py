@@ -187,10 +187,22 @@ def down(config: Dict[str, Any], runner: Optional[CommandRunner] = None) -> None
     kill_agents = (
         f"pkill -f 'ray_tpu[.]_private[.]node_agent.*node-{name}-' || true"
     )
+    # kill by the session-record pid, but ONLY if that pid's cmdline is
+    # really a launched head — a stale record can name an unrelated (or
+    # the calling!) process, and `ray down` must never kill those
     kill_head = (
-        "kill $(python3 -c \"import json;"
-        "print(json.load(open('/tmp/ray_tpu/last_session.json'))['pid'])\""
-        ") 2>/dev/null || pkill -f 'ray_tpu start [-][-]head' || true"
+        "kill $(python3 - <<'PYEOF'\n"
+        "import json\n"
+        "try:\n"
+        "    pid = json.load(open('/tmp/ray_tpu/last_session.json'))['pid']\n"
+        "    cmd = open(f'/proc/{pid}/cmdline', 'rb').read().decode()\n"
+        "    cmd = cmd.replace(chr(0), ' ')\n"
+        "    if 'ray_tpu' in cmd and '--head' in cmd:\n"
+        "        print(pid)\n"
+        "except Exception:\n"
+        "    pass\n"
+        "PYEOF\n"
+        ") 2>/dev/null; pkill -f 'ray_tpu start [-][-]head' || true"
     )
     for node in config.get("worker_nodes") or []:
         try:
