@@ -244,6 +244,15 @@ def nodes() -> List[dict]:
 
 def _ensure_connected() -> None:
     if not global_worker.connected:
+        import threading
+
+        if threading.current_thread() is not threading.main_thread():
+            # a BACKGROUND thread (e.g. a stale poller from a torn-down
+            # session) must never silently boot a fresh default head: that
+            # zombie session would absorb every later init() in the process
+            raise RuntimeError(
+                "ray_tpu is not initialized (auto-init only runs on the "
+                "main thread)")
         init()
 
 

@@ -117,6 +117,12 @@ class ActorClass:
 
         w = global_worker
         if not w.connected:
+            import threading
+
+            if threading.current_thread() is not threading.main_thread():
+                raise RuntimeError(
+                    "ray_tpu is not initialized (auto-init only runs on "
+                    "the main thread)")
             import ray_tpu
 
             ray_tpu.init()

@@ -48,6 +48,16 @@ class RemoteFunction:
     def _remote(self, args, kwargs, options: Dict[str, Any]):
         w = global_worker
         if not w.connected:
+            import threading
+
+            if threading.current_thread() is not threading.main_thread():
+                # a BACKGROUND thread submitting after shutdown (e.g. a
+                # stale poller from a torn-down session) must never boot a
+                # fresh default session — that zombie head silently absorbs
+                # every later init() in the process
+                raise RuntimeError(
+                    "ray_tpu is not initialized (auto-init only runs on "
+                    "the main thread)")
             import ray_tpu
 
             ray_tpu.init()

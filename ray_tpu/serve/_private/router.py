@@ -33,6 +33,11 @@ class Router:
         self._inflight: Dict[str, List[Any]] = {}  # tag -> [ObjectRef]
         self._rr = 0  # round-robin tiebreak among equally-loaded replicas
         self._router_id = uuid.uuid4().hex[:12]
+        # the session (client) this router belongs to: its poll/metrics
+        # threads exit when the session is shut down or replaced
+        from ray_tpu._private.worker import global_worker
+
+        self._born_client = global_worker.client
         self._last_metrics_push = 0.0
         self._listener_started = False
         # callers inside assign_request that have not been assigned a
@@ -223,12 +228,23 @@ class Router:
 # ---------------------------------------------------------------------------
 
 
+def _session_gone(router) -> bool:
+    """The session this router was born in was shut down (or replaced):
+    its threads must unwind instead of poking a dead/new head forever."""
+    from ray_tpu._private.worker import global_worker
+
+    client = getattr(router, "_born_client", None)
+    return client is None or client.closed or global_worker.client is not client
+
+
 def _listen_loop(router_ref) -> None:
     import ray_tpu
 
     while True:
         router = router_ref()
         if router is None:
+            return
+        if _session_gone(router):
             return
         controller, name, version = router._controller, router._name, router._version
         del router  # don't pin the Router across the blocking poll
@@ -256,6 +272,8 @@ def _metrics_loop(router_ref) -> None:
         time.sleep(2.0)
         router = router_ref()
         if router is None:
+            return
+        if _session_gone(router):
             return
         try:
             with router._lock:
