@@ -38,6 +38,11 @@ class HTTPProxyActor:
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+            # Nagle + the peer's delayed ACK turns our two-write response
+            # (headers, then body) into a ~40 ms stall per request — the
+            # whole data plane runs on loopback/ICI where coalescing buys
+            # nothing, so turn it off unconditionally.
+            disable_nagle_algorithm = True
 
             def log_message(self, *args):  # silence per-request stderr spam
                 pass
@@ -47,8 +52,13 @@ class HTTPProxyActor:
 
             do_GET = do_POST = do_PUT = do_DELETE = do_PATCH = _dispatch
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
-        self._server.daemon_threads = True
+        class Server(ThreadingHTTPServer):
+            # stock backlog is 5: a burst of concurrent clients (the bench
+            # opens 16 at once) overflows it and the kernel RSTs the rest
+            request_queue_size = 128
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
         self.host, self.port = self._server.server_address[0], self._server.server_address[1]
         threading.Thread(
             target=self._server.serve_forever, daemon=True, name="serve-http"
@@ -133,17 +143,27 @@ class HTTPProxyActor:
         (stale membership during a scale-down/redeploy is routine, not a
         user-visible error)."""
         import ray_tpu
-        from ray_tpu.exceptions import RayActorError
+        from ray_tpu.exceptions import GetTimeoutError, RayActorError
 
         last_exc = None
         for _ in range(2):
             ref, replica = router.assign_request(
                 "__call__", (request,), {}, timeout=30.0, return_replica=True)
             try:
-                return ray_tpu.get(ref, timeout=120.0), replica
+                result = ray_tpu.get(ref, timeout=120.0)
             except RayActorError as e:
                 router.on_replica_error(ref)
                 last_exc = e
+                continue
+            except GetTimeoutError:
+                # request is STILL executing on the replica — the slot is
+                # genuinely occupied; prune reclaims it when it finishes
+                raise
+            except Exception:
+                router.on_request_done(ref)  # slot back on app errors
+                raise
+            router.on_request_done(ref)
+            return result, replica
         raise last_exc
 
     def _stream_response(self, h: BaseHTTPRequestHandler, replica,
@@ -194,10 +214,17 @@ class HTTPProxyActor:
     @staticmethod
     def _respond(h: BaseHTTPRequestHandler, code: int, body: bytes, ctype: str) -> None:
         try:
+            # one write for headers+body: even with TCP_NODELAY, separate
+            # writes mean separate packets and a chance for the client to
+            # read a torn response on a reused keep-alive connection
             h.send_response(code)
             h.send_header("Content-Type", ctype)
             h.send_header("Content-Length", str(len(body)))
-            h.end_headers()
-            h.wfile.write(body)
+            h._headers_buffer.append(b"\r\n")
+            payload = b"".join(h._headers_buffer) + body
+            h._headers_buffer = []
+            h.wfile.write(payload)
         except (BrokenPipeError, ConnectionResetError):
             pass
+        finally:
+            h._headers_buffer = []

@@ -30,7 +30,9 @@ class Router:
         self._replicas: List[Tuple[str, Any]] = []  # (tag, ActorHandle)
         self._max_concurrent = 100
         self._last_refresh = 0.0
-        self._inflight: Dict[str, List[Any]] = {}  # tag -> [ObjectRef]
+        # tag -> {oid: ObjectRef}: dict-keyed so on_request_done is O(1)
+        self._inflight: Dict[str, Dict[bytes, Any]] = {}
+        self._ref_tags: Dict[bytes, str] = {}  # oid -> tag for done-reports
         self._rr = 0  # round-robin tiebreak among equally-loaded replicas
         self._router_id = uuid.uuid4().hex[:12]
         # the session (client) this router belongs to: its poll/metrics
@@ -82,6 +84,9 @@ class Router:
             self._inflight = {
                 tag: refs for tag, refs in self._inflight.items() if tag in live
             }
+            self._ref_tags = {
+                oid: tag for oid, tag in self._ref_tags.items() if tag in live
+            }
 
     def _push_metrics(self) -> None:
         """Throttled fire-and-forget ongoing-request report feeding the
@@ -118,16 +123,32 @@ class Router:
         self._apply_routing_info(info)
 
     def _prune_inflight(self) -> None:
-        """Drop completed refs from the in-flight ledgers (lock held)."""
+        """Drop completed refs from the in-flight ledgers (lock held).
+        Costs one head round trip — callers that finished via the fast
+        path already reported through on_request_done, so this only runs
+        when saturated or from the periodic metrics loop."""
         import ray_tpu
 
         for tag, refs in self._inflight.items():
             if not refs:
                 continue
             ready, not_ready = ray_tpu.wait(
-                refs, num_returns=len(refs), timeout=0
+                list(refs.values()), num_returns=len(refs), timeout=0
             )
-            self._inflight[tag] = not_ready
+            self._inflight[tag] = {r.binary(): r for r in not_ready}
+            for r in ready:
+                self._ref_tags.pop(r.binary(), None)
+
+    def on_request_done(self, ref) -> None:
+        """Caller finished ``ray_tpu.get(ref)``: release the concurrency
+        slot without a head round trip (the reference router decrements
+        its in-flight counter from the completion callback the same way —
+        ``router.py:221`` ReplicaSet)."""
+        oid = ref.binary()
+        with self._lock:
+            tag = self._ref_tags.pop(oid, None)
+            if tag is not None:
+                self._inflight.get(tag, {}).pop(oid, None)
 
     def _pick(self) -> Optional[Tuple[str, Any]]:
         """Least-loaded replica under the cap, round-robin on ties (lock
@@ -171,22 +192,31 @@ class Router:
             self._pending += 1  # queued demand, visible to the autoscaler
         assigned = False
         try:
+            pruned = False
             while True:
                 self._refresh(force=force)
                 force = False
                 with self._lock:
-                    self._prune_inflight()
                     picked = self._pick()
+                    if picked is None and not pruned:
+                        # saturated by our own ledger: reconcile against
+                        # the head once (callers that crashed before
+                        # on_request_done would otherwise leak slots)
+                        self._prune_inflight()
+                        pruned = True
+                        picked = self._pick()
                     if picked is not None:
                         tag, handle = picked
                         self._pending -= 1
                         assigned = True
                         ref = handle.handle_request.remote(method_name, args, kwargs)
-                        self._inflight.setdefault(tag, []).append(ref)
+                        self._inflight.setdefault(tag, {})[ref.binary()] = ref
+                        self._ref_tags[ref.binary()] = tag
                         self._push_metrics()
                         return (ref, handle) if return_replica else ref
                     self._push_metrics()
-                    waitable = [r for refs in self._inflight.values() for r in refs]
+                    waitable = [r for refs in self._inflight.values()
+                                for r in refs.values()]
                 if deadline is not None and time.monotonic() >= deadline:
                     raise GetTimeoutError(
                         f"no replica of {self._name!r} available within {timeout}s"
@@ -209,13 +239,12 @@ class Router:
         reference router's replica-removal-on-failure path)."""
         oid = ref.binary()
         with self._lock:
-            dead_tag = None
-            for tag, refs in self._inflight.items():
-                if any(r.binary() == oid for r in refs):
-                    dead_tag = tag
-                    break
+            dead_tag = self._ref_tags.pop(oid, None)
             if dead_tag is not None:
                 self._inflight.pop(dead_tag, None)
+                self._ref_tags = {
+                    o: t for o, t in self._ref_tags.items() if t != dead_tag
+                }
                 self._replicas = [
                     (t, h) for t, h in self._replicas if t != dead_tag
                 ]
