@@ -12,9 +12,11 @@ decorator), so requests arrive on concurrent executor threads.  A
 dedicated batcher thread per decorated callable collects them: callers
 enqueue and park; the batcher waits up to ``batch_wait_timeout_s`` from
 the first queued item (returning early at ``max_batch_size``), runs the
-wrapped function once on the list, and distributes results.  All user
-code runs on the single batcher thread, so deployment state needs no
-locking.
+wrapped function once on the list, and distributes results.  With the
+default ``max_concurrent_batches=1`` all user code runs on the single
+batcher thread, so deployment state needs no locking; raising it runs up
+to K batches on concurrent executor threads — the decorated function
+must then be thread-safe (pure jit-apply functions are).
 """
 
 from __future__ import annotations
@@ -41,10 +43,17 @@ class _Slot:
 
 class _Batcher:
     """One collector thread per decorated callable (replica-side only —
-    never pickled; built lazily on first call)."""
+    never pickled; built lazily on first call).
+
+    ``max_concurrent_batches > 1`` lets the collector hand batch N+1 to a
+    worker thread while batch N is still executing.  On a TPU whose host
+    round trip dominates (remote-attached chips: a sync readback costs
+    ~100 ms regardless of size), overlapping batches is the difference
+    between ``batch/rtt`` and ``batch*K/rtt`` throughput — the device
+    serializes the actual compute either way."""
 
     def __init__(self, run_fn: Callable[[List], List], max_batch_size: int,
-                 timeout_s: float):
+                 timeout_s: float, max_concurrent_batches: int = 1):
         self._run_fn = run_fn
         self._max = max_batch_size
         self._timeout = timeout_s
@@ -52,6 +61,12 @@ class _Batcher:
         self._nonempty = threading.Condition(self._lock)
         self._queue: List[_Slot] = []
         self._thread_started = False
+        self._inflight_sem = threading.Semaphore(max(1, max_concurrent_batches))
+        # K>1: daemon executor threads over a queue (not ThreadPoolExecutor,
+        # whose non-daemon threads would leak per deploy and whose atexit
+        # join wedges worker shutdown if a batch ever hangs)
+        self._exec_queue = None
+        self._n_exec_threads = max(1, max_concurrent_batches)
 
     def submit(self, item):
         slot = _Slot(item)
@@ -89,21 +104,44 @@ class _Batcher:
                     self._nonempty.wait(remaining)
                 batch = self._queue[: self._max]
                 del self._queue[: len(batch)]
-            try:
-                results = self._run_fn([s.item for s in batch])
-                if len(results) != len(batch):
-                    raise ValueError(
-                        f"@serve.batch function returned {len(results)} "
-                        f"results for a batch of {len(batch)}"
-                    )
-                for s, r in zip(batch, results):
-                    s.result = r
-            except BaseException as e:  # noqa: BLE001 — every caller must wake
-                for s in batch:
-                    s.error = e
-            finally:
-                for s in batch:
-                    s.event.set()
+            # bounds in-flight batches; with K=1 this serializes execution
+            # on this collector thread exactly as before
+            self._inflight_sem.acquire()
+            if self._n_exec_threads == 1:
+                self._execute(batch)
+            else:
+                if self._exec_queue is None:
+                    import queue as queue_mod
+
+                    self._exec_queue = queue_mod.Queue()
+                    for i in range(self._n_exec_threads):
+                        threading.Thread(
+                            target=self._exec_loop, daemon=True,
+                            name=f"serve-batch-exec-{i}",
+                        ).start()
+                self._exec_queue.put(batch)
+
+    def _exec_loop(self) -> None:
+        while True:
+            self._execute(self._exec_queue.get())
+
+    def _execute(self, batch: List[_Slot]) -> None:
+        try:
+            results = self._run_fn([s.item for s in batch])
+            if len(results) != len(batch):
+                raise ValueError(
+                    f"@serve.batch function returned {len(results)} "
+                    f"results for a batch of {len(batch)}"
+                )
+            for s, r in zip(batch, results):
+                s.result = r
+        except BaseException as e:  # noqa: BLE001 — every caller must wake
+            for s in batch:
+                s.error = e
+        finally:
+            self._inflight_sem.release()
+            for s in batch:
+                s.event.set()
 
 
 def uses_batching(func_or_class) -> bool:
@@ -122,7 +160,7 @@ def uses_batching(func_or_class) -> bool:
 
 
 def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
-          batch_wait_timeout_s: float = 0.01):
+          batch_wait_timeout_s: float = 0.01, max_concurrent_batches: int = 1):
     """Decorate a replica method (or function deployment) taking a LIST of
     requests::
 
@@ -131,6 +169,11 @@ def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
             @serve.batch(max_batch_size=16, batch_wait_timeout_s=0.005)
             def __call__(self, requests):           # list in ...
                 return self.model(np.stack(requests)).tolist()  # list out
+
+    ``max_concurrent_batches=K`` (default 1) overlaps up to K batch
+    executions on concurrent threads — use when per-batch latency is
+    dominated by device round trips rather than compute (remote-attached
+    TPUs), and only if the decorated function is thread-safe.
     """
     if max_batch_size < 1:
         raise ValueError("max_batch_size must be >= 1")
@@ -152,7 +195,8 @@ def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
                     b = self.__dict__.setdefault(
                         attr,
                         _Batcher(lambda items: fn(self, items),
-                                 max_batch_size, batch_wait_timeout_s),
+                                 max_batch_size, batch_wait_timeout_s,
+                                 max_concurrent_batches),
                     )
                 return b.submit(request)
         else:
@@ -163,7 +207,8 @@ def batch(_func: Optional[Callable] = None, *, max_batch_size: int = 8,
                 if b is None:
                     b = wrapper.__dict__.setdefault(
                         attr,
-                        _Batcher(fn, max_batch_size, batch_wait_timeout_s),
+                        _Batcher(fn, max_batch_size, batch_wait_timeout_s,
+                                 max_concurrent_batches),
                     )
                 return b.submit(request)
 

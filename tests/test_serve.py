@@ -419,6 +419,66 @@ def test_serve_batch_sustained_load(serve_instance):
     serve.delete("Slowish")
 
 
+def test_serve_batch_concurrent_batches(serve_instance):
+    """max_concurrent_batches>1: batch N+1 executes while batch N is still
+    in its (slow) run_fn — overlap is the round-trip-dominated TPU serving
+    lever — and results still route back to the right callers."""
+
+    @serve.deployment(max_concurrent_queries=64)
+    class Overlap:
+        def __init__(self):
+            import threading
+
+            self.lock = threading.Lock()
+            self.active = 0
+            self.max_active = 0
+
+        @serve.batch(max_batch_size=4, batch_wait_timeout_s=0.01,
+                     max_concurrent_batches=4)
+        def __call__(self, requests):
+            with self.lock:
+                self.active += 1
+                self.max_active = max(self.max_active, self.active)
+            time.sleep(0.1)  # a "readback RTT" long enough to overlap
+            with self.lock:
+                self.active -= 1
+            return [r * 10 for r in requests]
+
+        def peak(self):
+            return self.max_active
+
+    serve.run(Overlap.bind(), port=0)
+    handle = serve.get_deployment_handle("Overlap")
+    refs = [handle.remote(i) for i in range(32)]
+    out = ray_tpu.get(refs, timeout=180)
+    assert out == [i * 10 for i in range(32)]
+    assert ray_tpu.get(handle.peak.remote(), timeout=60) > 1, \
+        "batches never overlapped despite max_concurrent_batches=4"
+    serve.delete("Overlap")
+
+
+def test_serve_batch_concurrent_batches_error_propagation(serve_instance):
+    """Exceptions raised on pool-executed batches reach every caller of
+    that batch (and only that batch)."""
+
+    @serve.deployment(max_concurrent_queries=32)
+    class Flaky:
+        @serve.batch(max_batch_size=2, batch_wait_timeout_s=0.01,
+                     max_concurrent_batches=2)
+        def __call__(self, requests):
+            if any(r < 0 for r in requests):
+                raise ValueError("negative")
+            return [r + 1 for r in requests]
+
+    serve.run(Flaky.bind(), port=0)
+    handle = serve.get_deployment_handle("Flaky")
+    ok = ray_tpu.get([handle.remote(i) for i in range(4)], timeout=120)
+    assert ok == [1, 2, 3, 4]
+    with pytest.raises(Exception, match="negative"):
+        ray_tpu.get([handle.remote(-1), handle.remote(-2)], timeout=120)
+    serve.delete("Flaky")
+
+
 def test_serve_status_cli(serve_instance):
     """`python -m ray_tpu serve-status` against the running instance."""
     import io
