@@ -143,11 +143,12 @@ def run_through_trainer() -> dict:
     return result.metrics
 
 
-def run_decode_bench() -> dict:
+def run_decode_bench(family: str = "gpt2") -> dict:
     """LLM decode serving on the chip: the continuous-batching engine
-    (ray_tpu.serve.llm) inside a ``num_tpus=1`` actor — GPT-2 125M, 16
+    (ray_tpu.serve.llm) inside a ``num_tpus=1`` actor — 125M model, 16
     cache slots, 32 concurrent requests of 128 new tokens each.  Reports
-    aggregate decode tokens/s and engine-side request latency p50/p99."""
+    aggregate decode tokens/s and engine-side request latency p50/p99.
+    ``family="llama"`` covers the GQA cache path on hardware."""
     import time
 
     import numpy as np
@@ -166,7 +167,7 @@ def run_decode_bench() -> dict:
 
             on_tpu = jax.default_backend() == "tpu"
             self.n_new = 128 if on_tpu else 8
-            cfg = make_config("gpt2", "small" if on_tpu else "tiny")
+            cfg = make_config(family, "small" if on_tpu else "tiny")
             self.engine = GenerationEngine(
                 cfg,
                 n_slots=16 if on_tpu else 8,
@@ -198,12 +199,14 @@ def run_decode_bench() -> dict:
         ray_tpu.shutdown()  # a hung engine must not keep the chip claimed
     lats = sorted(dt for _, dt in outs)
     total_tokens = sum(n for n, _ in outs)
+    prefix = "decode" if family == "gpt2" else f"decode_{family}"
     return {
-        "decode_tokens_per_sec": round(total_tokens / wall, 1),
-        "decode_req_p50_ms": round(lats[len(lats) // 2] * 1e3, 1),
-        "decode_req_p99_ms": round(lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 1),
-        "decode_reqs": n_reqs,
-        "decode_new_tokens_per_req": n_new,
+        f"{prefix}_tokens_per_sec": round(total_tokens / wall, 1),
+        f"{prefix}_req_p50_ms": round(lats[len(lats) // 2] * 1e3, 1),
+        f"{prefix}_req_p99_ms": round(
+            lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e3, 1),
+        f"{prefix}_reqs": n_reqs,
+        f"{prefix}_new_tokens_per_req": n_new,
     }
 
 
@@ -363,6 +366,10 @@ def main() -> None:
     except Exception as e:  # decode metrics are additive — a decode failure
         # must never sink the headline training number the driver records
         decode_out = {"decode_error": f"{type(e).__name__}: {e}"[:200]}
+    try:
+        decode_out.update(run_decode_bench("llama"))
+    except Exception as e:
+        decode_out["decode_llama_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         decode_out.update(run_serve_bench())
     except Exception as e:
