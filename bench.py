@@ -210,6 +210,65 @@ def run_decode_bench(family: str = "gpt2") -> dict:
     }
 
 
+def run_rl_bench() -> dict:
+    """RLlib north star (BASELINE config 4 shape): PPO on Atari-shaped
+    synthetic frames — parallel rollout workers stepping 84x84x4 uint8
+    envs on host CPUs, batched CNN inference AND minibatch SGD on the
+    chip-resident PolicyServer.  Reports env-steps/s over post-warmup
+    training iterations (sampling + learning, the reference's
+    ``timesteps_total / wall`` definition)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.rllib import PPOConfig, serve_policy, synthetic_atari_creator
+
+    has_tpu = bool(int(os.environ.get("RAY_TPU_BENCH_TPUS", "1")))
+    ray_tpu.init(num_cpus=12, num_tpus=1 if has_tpu else 0)
+    n_workers, n_envs, frag = (4, 64, 16) if has_tpu else (2, 4, 8)
+    cfg = (
+        PPOConfig()
+        .environment(env_creator=synthetic_atari_creator,
+                     env_config={"episode_len": 400})
+        .rollouts(num_rollout_workers=n_workers, num_envs_per_worker=n_envs,
+                  rollout_fragment_length=frag)
+        .training(
+            train_batch_size=n_workers * n_envs * frag,
+            sgd_minibatch_size=256 if has_tpu else 32,
+            num_sgd_iter=4, fcnet_hiddens=(256,) if has_tpu else (32,),
+            entropy_coeff=0.01,
+        )
+        .debugging(seed=0)
+    ).to_dict()
+    server, overrides = serve_policy(
+        cfg, obs_dim=84 * 84 * 4, num_actions=6, obs_shape=(84, 84, 4),
+        num_tpus=1 if has_tpu else 0, max_concurrency=4 * n_workers,
+        frame_stack_transport=True)
+    cfg.update(overrides)
+    algo = cfg.pop("_algo_class")(config=cfg)
+    try:
+        algo.step()  # warmup: XLA compiles (sample fwd + SGD fwd/bwd)
+        t0 = time.perf_counter()
+        steps0 = algo._timesteps_total
+        iters = 3 if has_tpu else 1
+        rew = float("nan")
+        for _ in range(iters):
+            rew = algo.step().get("episode_reward_mean", float("nan"))
+        wall = time.perf_counter() - t0
+        steps = algo._timesteps_total - steps0
+    finally:
+        algo.cleanup()
+        ray_tpu.shutdown()
+    out = {
+        "rl_env_steps_per_sec": round(steps / wall, 1),
+        "rl_algo": "PPO-synthetic-atari",
+        "rl_workers": n_workers,
+        "rl_envs_per_worker": n_envs,
+    }
+    if rew == rew:  # episode metrics exist once episodes complete
+        out["rl_episode_reward_mean"] = round(rew, 2)
+    return out
+
+
 def run_serve_bench() -> dict:
     """Serve data plane on the chip: BERT classifier behind the HTTP proxy
     with @serve.batch (BASELINE config 5 shape), driven by keep-alive
@@ -374,6 +433,10 @@ def main() -> None:
         decode_out.update(run_serve_bench())
     except Exception as e:
         decode_out["serve_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        decode_out.update(run_rl_bench())
+    except Exception as e:
+        decode_out["rl_error"] = f"{type(e).__name__}: {e}"[:200]
 
     tps = trainer_out["tokens_per_sec"]
     raw_tps = raw_out["tokens_per_sec"]

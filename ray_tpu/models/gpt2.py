@@ -33,19 +33,19 @@ class GPT2Config(TransformerConfig):
 
     @staticmethod
     def gpt2_small(**kw) -> "GPT2Config":
-        """The 124M-parameter headline model."""
-        return GPT2Config(
-            vocab_size=50304, n_layers=12, n_heads=12, d_model=768,
-            d_ff=3072, max_seq_len=1024, **kw,
-        )
+        """The 124M-parameter headline model (any field overridable)."""
+        base = dict(vocab_size=50304, n_layers=12, n_heads=12, d_model=768,
+                    d_ff=3072, max_seq_len=1024)
+        base.update(kw)
+        return GPT2Config(**base)
 
     @staticmethod
     def tiny(**kw) -> "GPT2Config":
-        """Test/dry-run sized."""
-        return GPT2Config(
-            vocab_size=512, n_layers=2, n_heads=4, d_model=64,
-            d_ff=256, max_seq_len=128, remat=False, **kw,
-        )
+        """Test/dry-run sized (any field overridable)."""
+        base = dict(vocab_size=512, n_layers=2, n_heads=4, d_model=64,
+                    d_ff=256, max_seq_len=128, remat=False)
+        base.update(kw)
+        return GPT2Config(**base)
 
 
 def init(cfg: GPT2Config, key: jax.Array) -> Dict[str, Any]:

@@ -67,11 +67,10 @@ class LlamaConfig:
 
     @staticmethod
     def tiny(**kw) -> "LlamaConfig":
-        kw.setdefault("remat", False)
-        return LlamaConfig(
-            vocab_size=512, n_layers=2, n_heads=4, n_kv_heads=2,
-            d_model=64, d_ff=128, max_seq_len=128, **kw,
-        )
+        base = dict(vocab_size=512, n_layers=2, n_heads=4, n_kv_heads=2,
+                    d_model=64, d_ff=128, max_seq_len=128, remat=False)
+        base.update(kw)
+        return LlamaConfig(**base)
 
 
 def _dense(key, n_in, n_out, scale=1.0):

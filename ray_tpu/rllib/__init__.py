@@ -24,7 +24,9 @@ from ray_tpu.rllib.multi_agent import (
     MultiAgentPPOConfig,
     MultiAgentRolloutWorker,
 )
+from ray_tpu.rllib.envs import SyntheticAtariEnv, synthetic_atari_creator
 from ray_tpu.rllib.offline import JsonReader, JsonWriter
+from ray_tpu.rllib.policy_server import PolicyServer, RemotePolicy, serve_policy
 from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.postprocessing import compute_gae
@@ -64,4 +66,9 @@ __all__ = [
     "compute_gae",
     "synchronous_parallel_sample",
     "train_one_step",
+    "SyntheticAtariEnv",
+    "synthetic_atari_creator",
+    "PolicyServer",
+    "RemotePolicy",
+    "serve_policy",
 ]

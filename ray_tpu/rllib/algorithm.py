@@ -256,6 +256,14 @@ def train_one_step(
 ) -> Dict[str, float]:
     """Minibatch SGD epochs over one train batch
     (``execution/train_ops.py:26``)."""
+    if hasattr(policy, "train_on_batch"):
+        # server-resident learner (policy_server.py): the batch crosses
+        # the wire once and every SGD update runs device-side — per-
+        # minibatch round trips would dominate on a remote-attached chip
+        return policy.train_on_batch(
+            batch, num_sgd_iter=num_sgd_iter,
+            sgd_minibatch_size=sgd_minibatch_size,
+            required_keys=required_keys, seed=int(rng.integers(1 << 31)))
     metrics: Dict[str, float] = {}
     count = 0
     mb_size = min(sgd_minibatch_size, batch.count)

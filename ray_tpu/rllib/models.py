@@ -141,7 +141,16 @@ def apply_conv_actor_critic(params: Dict, obs: jax.Array) -> Tuple[jax.Array, ja
 
 
 def apply_model(params: Dict, obs: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    """Catalog dispatch: the params pytree names its architecture."""
+    """Catalog dispatch: the params pytree names its architecture.
+
+    uint8 pixel observations are cast+scaled HERE (device-side), so the
+    whole pipeline — rollout transport, sample batches, SGD minibatches —
+    carries 1-byte pixels instead of 4-byte floats (4x less host<->device
+    and object-store traffic; the wrapped-Atari preprocessing the
+    reference does in ``atari_wrappers.py:244``)."""
+    obs = jnp.asarray(obs)
+    if jnp.issubdtype(obs.dtype, jnp.integer):
+        obs = obs.astype(jnp.float32) / 255.0
     if "conv" in params:
         return apply_conv_actor_critic(params, obs)
     return apply_actor_critic(params, obs)

@@ -104,6 +104,24 @@ class RolloutWorker:
         self._keep_behavior_logp = self._postprocess_gae or bool(
             config.get("_keep_behavior_logp")
         )
+        # frame-stack transport (policy_server.py): ship each env's newest
+        # frame instead of the full stack; pixels for training stay on the
+        # server's device. Requires a remote policy exposing the stacked
+        # tick API and channel-stacked uint8 observations.
+        self._fst = bool(config.get("_frame_stack_transport")) and hasattr(
+            self.policy, "compute_actions_stacked")
+        if self._fst:
+            # reference rows replace pixels in the OBS column, so every
+            # consumer that reads OBS as pixels is incompatible: offline
+            # writers, replay learners (next_obs), V-trace logp recompute
+            if (config.get("output") or self._store_next_obs
+                    or not self._postprocess_gae):
+                raise ValueError(
+                    "frame_stack_transport supports on-policy GAE learners "
+                    "(PPO/A2C) without offline output: the obs column holds "
+                    "device-snapshot references, not pixels")
+            self.policy.start_rollout(worker_index, self.num_envs)
+            self._reset_mask = np.ones((self.num_envs,), bool)
         self.gamma = config.get("gamma", 0.99)
         self.lambda_ = config.get("lambda_", 0.95)
         self.fragment_length = config.get("rollout_fragment_length", 200)
@@ -140,9 +158,13 @@ class RolloutWorker:
         return self._eps_counter
 
     def _prep_obs(self, o) -> np.ndarray:
-        """Image obs keep [H, W, C] for the CNN; flat obs flatten."""
-        o = np.asarray(o, np.float32)
-        return o if self._conv else o.reshape(-1)
+        """Image obs keep [H, W, C] for the CNN — and keep uint8 pixels
+        uint8 (the policy casts device-side; 4x less transport); flat obs
+        flatten to float32.  Always copies: envs that return their internal
+        frame buffer would otherwise alias every stored row."""
+        if self._conv:
+            return np.array(o)
+        return np.asarray(o, np.float32).reshape(-1)
 
     def _env_action(self, action: np.ndarray):
         """Policy output -> what env.step accepts.  Continuous policies act
@@ -159,23 +181,53 @@ class RolloutWorker:
     # ------------------------------------------------------------------
     def sample(self) -> SampleBatch:
         """One fragment of ``num_envs * rollout_fragment_length`` steps,
-        postprocessed per episode segment at its boundary."""
-        segments: List[SampleBatch] = []
+        postprocessed per episode segment at its boundary.
 
-        def close_segment(es: _EnvState, last_value_fn):
-            n = len(es.cols[SampleBatch.OBS])
-            if n == 0:
-                return
-            seg = SampleBatch({k: np.asarray(v) for k, v in es.cols.items()})
-            if self._postprocess_gae:
-                seg = compute_gae(seg, last_value_fn(), self.gamma, self.lambda_)
-            segments.append(seg)
+        Bootstrap values (truncation and fragment-end) are computed in ONE
+        batched ``policy.value`` call at the end of the fragment: with a
+        remote policy (policy_server.py) per-segment calls would each pay
+        a device round trip."""
+        segments: List[SampleBatch] = []
+        # segments awaiting a bootstrap value: (cols_snapshot, boot_obs)
+        deferred: List = []
+
+        def snapshot(es: _EnvState):
+            seg_cols = {k: np.asarray(v) for k, v in es.cols.items()}
             for v in es.cols.values():
                 v.clear()
+            return seg_cols
+
+        def close_terminal(es: _EnvState):
+            if len(es.cols[SampleBatch.OBS]) == 0:
+                return
+            seg = SampleBatch(snapshot(es))
+            if self._postprocess_gae:
+                seg = compute_gae(seg, 0.0, self.gamma, self.lambda_)
+            segments.append(seg)
+
+        def defer_bootstrap(es: _EnvState, boot_obs):
+            if len(es.cols[SampleBatch.OBS]) == 0:
+                return
+            deferred.append((snapshot(es), self._prep_obs(boot_obs)))
 
         for _ in range(self.fragment_length):
-            obs_batch = np.stack([self._prep_obs(es.obs) for es in self._envs])
-            actions, logps, vfs = self.policy.compute_actions(obs_batch)
+            if self._fst:
+                # newest channel only (uint8 [n, H, W]); the server holds
+                # and advances the full stacks device-side
+                new_frames = np.stack(
+                    [np.asarray(es.obs)[..., -1] for es in self._envs])
+                actions, logps, vfs, tick = self.policy.compute_actions_stacked(
+                    self.worker_index, new_frames, self._reset_mask)
+                self._reset_mask[:] = False
+                # [N, 3] (worker, tick, env) reference rows stand in for
+                # pixel observations in the sample batch
+                obs_batch = np.stack([
+                    np.array([self.worker_index, tick, i], np.int32)
+                    for i in range(self.num_envs)])
+            else:
+                obs_batch = np.stack(
+                    [self._prep_obs(es.obs) for es in self._envs])
+                actions, logps, vfs = self.policy.compute_actions(obs_batch)
             for i, es in enumerate(self._envs):
                 a = actions[i]
                 next_obs, reward, terminated, truncated, _ = es.env.step(
@@ -198,10 +250,10 @@ class RolloutWorker:
                 es.obs = next_obs
                 if terminated or truncated:
                     # terminal: no bootstrap; truncation: bootstrap v(s_T)
-                    _next = next_obs
-                    close_segment(es, lambda: 0.0 if terminated else float(
-                        self.policy.value(self._prep_obs(_next)[None])[0]
-                    ))
+                    if terminated:
+                        close_terminal(es)
+                    else:
+                        defer_bootstrap(es, next_obs)
                     self._episode_rewards.append(es.episode_reward)
                     self._episode_lengths.append(es.episode_len)
                     self._episodes_total += 1
@@ -209,11 +261,21 @@ class RolloutWorker:
                     es.episode_len = 0
                     es.eps_id = self._next_eps_id()
                     es.obs, _ = es.env.reset()
+                    if self._fst:
+                        self._reset_mask[i] = True
         # fragment ended mid-episode: bootstrap with v(current obs)
         for es in self._envs:
-            close_segment(es, lambda es=es: float(
-                self.policy.value(self._prep_obs(es.obs)[None])[0]
-            ))
+            defer_bootstrap(es, es.obs)
+        if deferred:
+            if self._postprocess_gae:
+                boots = self.policy.value(
+                    np.stack([b for _, b in deferred]))
+                for (seg_cols, _), v in zip(deferred, boots):
+                    segments.append(compute_gae(
+                        SampleBatch(seg_cols), float(v),
+                        self.gamma, self.lambda_))
+            else:
+                segments.extend(SampleBatch(c) for c, _ in deferred)
         batch = SampleBatch.concat_samples(segments)
         if self._writer is not None:
             self._writer.write(batch)

@@ -45,6 +45,10 @@ class JaxConfig(BackendConfig):
     use_jax_distributed: bool = False
     coordinator_port: int = 0  # 0 = pick a free port
     group_name: Optional[str] = None  # collective group; default unique per run
+    # extra env applied on every worker BEFORE jax initializes there
+    # (XLA_FLAGS / JAX_PLATFORMS / TPU topology variables); the seat of
+    # the reference torch config's backend env knobs
+    env_vars: Optional[dict] = None
 
     @property
     def backend_cls(self):
@@ -73,6 +77,8 @@ class _JaxBackend(Backend):
             "RAY_TRAIN_WORLD_SIZE": str(n),
             "RAY_TRAIN_COLLECTIVE_GROUP": group,
         }
+        if cfg.env_vars:
+            env.update({k: str(v) for k, v in cfg.env_vars.items()})
         ray_tpu.get(
             [w.setup_env.remote({**env, "RAY_TRAIN_WORLD_RANK": str(i)})
              for i, w in enumerate(worker_group.workers)],
