@@ -28,8 +28,11 @@ the same trick as the reference's in-process multi-raylet test Cluster
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
+import signal
+import socket
 import subprocess
 import sys
 import threading
@@ -100,6 +103,153 @@ def _worker_argv(runtime_env: Optional[dict]) -> List[str]:
     from ray_tpu._private.runtime_env_setup import worker_argv
 
     return worker_argv((runtime_env or {}).get("pip"))
+
+
+def _set_child_subreaper() -> bool:
+    """PR_SET_CHILD_SUBREAPER: forkserver-spawned workers (and any orphan
+    a dying worker leaves behind) reparent to THIS process instead of pid
+    1, so the reaper loop can waitpid them — the fix for zombie
+    accumulation when the head runs as a container's pid 1."""
+    try:
+        import ctypes
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        return libc.prctl(36, 1, 0, 0, 0) == 0  # PR_SET_CHILD_SUBREAPER
+    except Exception:
+        return False
+
+
+class _ForkedProc:
+    """Popen-compatible handle for a forkserver-spawned worker.  The
+    worker is not our direct child (double fork) but reparents to us via
+    the subreaper, so waitpid works; without subreaper support, liveness
+    falls back to signal 0."""
+
+    def __init__(self, pid: int):
+        self.pid = pid
+        self.returncode: Optional[int] = None
+
+    def poll(self) -> Optional[int]:
+        if self.returncode is not None:
+            return self.returncode
+        try:
+            done, status = os.waitpid(self.pid, os.WNOHANG)
+            if done == self.pid:
+                self.returncode = os.waitstatus_to_exitcode(status)
+        except ChildProcessError:
+            try:
+                os.kill(self.pid, 0)
+            except ProcessLookupError:
+                self.returncode = -1
+        return self.returncode
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.poll() is None:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise subprocess.TimeoutExpired("forked-worker", timeout)
+            time.sleep(0.02)
+        return self.returncode
+
+    def _signal(self, sig: int) -> None:
+        if self.returncode is None:
+            try:
+                os.kill(self.pid, sig)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def terminate(self) -> None:
+        self._signal(signal.SIGTERM)
+
+    def kill(self) -> None:
+        self._signal(signal.SIGKILL)
+
+
+class _ForkServerClient:
+    """Manages the template process and requests spawns from it."""
+
+    def __init__(self, session_dir: str):
+        self._sock_path = os.path.join(session_dir, "forkserver.sock")
+        self._proc: Optional[subprocess.Popen] = None
+        self._lock = threading.Lock()
+        self._broken = False
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc is not None else None
+
+    def _ensure(self) -> bool:
+        if self._broken:
+            return False
+        if self._proc is not None and self._proc.poll() is None:
+            return True
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
+        try:
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.forkserver",
+                 self._sock_path],
+                env=env,
+            )
+        except OSError:
+            self._broken = True
+            return False
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if self._proc.poll() is not None:
+                self._broken = True
+                return False
+            s = socket.socket(socket.AF_UNIX)
+            try:
+                s.connect(self._sock_path)
+                s.close()
+                return True
+            except OSError:
+                s.close()
+                time.sleep(0.05)
+        self._broken = True
+        return False
+
+    def prewarm(self) -> None:
+        with self._lock:
+            self._ensure()
+
+    def spawn(self, env: Dict[str, str], cwd: Optional[str]) -> Optional[_ForkedProc]:
+        """Fork a worker from the warm template; None -> caller should
+        fall back to a classic Popen.  Callers may hold head.lock, so the
+        per-request timeout stays short — a wedged template degrades to
+        Popen spawns instead of freezing the control plane."""
+        with self._lock:
+            if not self._ensure():
+                return None
+            try:
+                s = socket.socket(socket.AF_UNIX)
+                s.settimeout(10)
+                s.connect(self._sock_path)
+                s.sendall((json.dumps({"env": env, "cwd": cwd}) + "\n").encode())
+                data = b""
+                while not data.endswith(b"\n"):
+                    chunk = s.recv(1 << 16)
+                    if not chunk:
+                        break
+                    data += chunk
+                s.close()
+                return _ForkedProc(int(json.loads(data)["pid"]))
+            except (OSError, ValueError, KeyError):
+                # template wedged: drop it; next spawn restarts it
+                try:
+                    self._proc.kill()
+                except Exception:
+                    pass
+                self._proc = None
+                return None
+
+    def close(self) -> None:
+        if self._proc is not None:
+            try:
+                self._proc.kill()
+            except Exception:
+                pass
 
 
 def _fits(req: Dict[str, float], avail: Dict[str, float]) -> bool:
@@ -412,7 +562,25 @@ class Node:
         self.object_server = object_transfer.ObjectServer(host, self.authkey)
         self.nodes[self._head_node_id].fetch_addr = tuple(self.object_server.addr)
         self.registry.broadcast_unlink = self._broadcast_unlink
+        # warm-template worker spawns + orphan reaping: forked workers
+        # reparent to this process (subreaper), the reaper loop collects
+        # them AND any zombie a dying worker leaves when we're pid 1
+        self._subreaper = _set_child_subreaper()
+        self._forkserver = (
+            None if os.environ.get("RAY_TPU_DISABLE_FORKSERVER")
+            else _ForkServerClient(self.session_dir))
+        self._zombie_seen: Dict[int, float] = {}
         self._threads = []
+        t = threading.Thread(target=self._reaper_loop, name="reaper", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if self._forkserver is not None:
+            # boot the template OFF the scheduler path: the first worker
+            # spawn must never pay the ~2s template boot under head.lock
+            t = threading.Thread(target=self._forkserver.prewarm,
+                                 name="forkserver-warm", daemon=True)
+            t.start()
+            self._threads.append(t)
         t = threading.Thread(target=self._accept_loop, name="accept", daemon=True)
         t.start()
         self._threads.append(t)
@@ -1029,6 +1197,13 @@ class Node:
         if extra_env:
             env.update(extra_env)
         env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
+        # plain workers fork from the warm template (~20ms vs a ~2s cold
+        # CPython boot); pip runtime_envs need the venv's interpreter, so
+        # they (and any forkserver failure) take the classic Popen path
+        if self._forkserver is not None and not (runtime_env or {}).get("pip"):
+            proc = self._forkserver.spawn(env, cwd)
+            if proc is not None:
+                return proc
         return subprocess.Popen(
             _worker_argv(runtime_env), env=env, cwd=cwd
         )
@@ -1545,6 +1720,69 @@ class Node:
             time.sleep(0.05)
             self._service_pending_gets()
             self._sweep_dynamic_waiters()
+
+    def _reaper_loop(self) -> None:
+        """Collect exited forkserver workers and any zombie reparented to
+        us (subreaper / pid-1 container): a Z-state child that no live
+        Popen object owns gets waitpid'ed here, nowhere else."""
+        while not self._shutdown:
+            time.sleep(2.0)
+            try:
+                with self.lock:
+                    forked = [w.proc for w in self.workers.values()
+                              if isinstance(w.proc, _ForkedProc)]
+                    popen_pids = {w.proc.pid for w in self.workers.values()
+                                  if isinstance(w.proc, subprocess.Popen)}
+                if self._forkserver is not None and self._forkserver.pid:
+                    popen_pids.add(self._forkserver.pid)
+                for p in forked:
+                    p.poll()  # reaps on exit; handle keeps the status
+                    popen_pids.add(p.pid)  # sweep must not steal statuses
+                self._reap_unknown_zombies(popen_pids)
+            except Exception:
+                pass
+
+    def _reap_unknown_zombies(self, popen_pids: set) -> None:
+        """Reap ORPHANED zombies only: a zombie owned by a live Popen
+        (job drivers, node agents, user subprocesses) is collected by its
+        owner within moments of exit — so anything still Z-state across
+        two sweeps ~30s apart has no owner (a worker's abandoned child
+        reparented to us), and waitpid'ing it cannot steal an exit status
+        another subsystem is waiting on."""
+        try:
+            tids = os.listdir("/proc/self/task")
+        except OSError:
+            return
+        children: set = set()
+        for tid in tids:
+            try:
+                with open(f"/proc/self/task/{tid}/children") as f:
+                    children.update(int(p) for p in f.read().split())
+            except (OSError, ValueError):
+                continue
+        now = time.monotonic()
+        seen = self._zombie_seen
+        zombies: set = set()
+        for pid in children - popen_pids:
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    if f.read().split(")")[-1].split()[0] != "Z":
+                        continue  # alive (a _ForkedProc worker, fine)
+            except (OSError, IndexError):
+                continue
+            zombies.add(pid)
+            first = seen.setdefault(pid, now)
+            if now - first < 30.0:
+                continue  # young zombie: its owner may still collect it
+            try:
+                os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                pass
+            seen.pop(pid, None)
+        # forget pids that got collected (or whose pid was recycled)
+        for pid in list(seen):
+            if pid not in zombies:
+                seen.pop(pid, None)
 
     def _gcs_flush_loop(self) -> None:
         """Periodic persistence on its own thread (never in the path of
@@ -2868,6 +3106,8 @@ class Node:
     def _state_snapshot(self) -> dict:
         snap = self.gcs.snapshot()
         snap["object_store"] = self.registry.stats()
+        snap["dashboard"] = (
+            list(self.dashboard.address) if self.dashboard else None)
         with self.lock:
             snap["cluster_resources"] = {
                 nid: dict(ns.total) for nid, ns in self.nodes.items() if ns.alive
@@ -2882,6 +3122,8 @@ class Node:
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
         self._shutdown = True
+        if self._forkserver is not None:
+            self._forkserver.close()
         try:
             self._pub_queue.put(None)  # end the publisher thread
         except Exception:

@@ -25,7 +25,7 @@ from ray_tpu._private.shm import ShmSegment, shm_dir
 
 logger = logging.getLogger(__name__)
 
-CHUNK = 8 << 20  # 8 MiB chunks (object_manager_default_chunk_size analog)
+CHUNK = 32 << 20  # 32 MiB sendfile spans (object_manager chunk analog)
 
 Addr = Tuple[str, int]
 
@@ -80,6 +80,21 @@ class ObjectServer:
                         conn.send({"ok": False,
                                    "error": f"range [{base}, {base + size}) "
                                             f"outside file of {file_size}"})
+                        continue
+                    if msg.get("raw"):
+                        # kernel-side file->socket copy: no userspace pread
+                        # buffer, no mp framing — on a CPU-starved host the
+                        # copy count IS the bandwidth ceiling
+                        conn.send({"ok": True, "size": size, "raw": True})
+                        cfd = conn.fileno()
+                        off = 0
+                        while off < size:
+                            sent = os.sendfile(
+                                cfd, fd, base + off, min(CHUNK, size - off))
+                            if sent == 0:  # peer gone / truncation race
+                                conn.close()
+                                return
+                            off += sent
                         continue
                     conn.send({"ok": True, "size": size})
                     off = 0
@@ -167,6 +182,54 @@ def _evict(addr: Addr, conn: Connection) -> None:
         pass
 
 
+def _arena_local_copy(dst_path: str, arena: tuple, size: int) -> bool:
+    """Same-HOST fast path: the origin's arena file is visible in this
+    host's tmpfs (emulated multi-node, or co-located nodes), so the slice
+    copies kernel-side with copy_file_range — no sockets, one copy.  The
+    reference gets the same effect from its per-node shared plasma store.
+    Returns False (caller takes the socket path) if the arena isn't local
+    or the copy fails.  ``RAY_TPU_FORCE_REMOTE_PULL=1`` disables it
+    (benchmarks that specifically measure the network plane)."""
+    if size < 0 or os.environ.get("RAY_TPU_FORCE_REMOTE_PULL"):
+        return False
+    # the origin's arena path is host-absolute; when it exists HERE the
+    # origin shares this host (namespaced shm dirs notwithstanding —
+    # arena names are session+node scoped, so a hit can't be a stranger)
+    src = arena[0] if os.path.isabs(arena[0]) and os.path.exists(arena[0]) \
+        else os.path.join(shm_dir(), os.path.basename(arena[0]))
+    base = int(arena[1])
+    try:
+        sfd = os.open(src, os.O_RDONLY)
+    except OSError:
+        return False
+    dfd = -1
+    tmp = f"{dst_path}.lcopy.{os.getpid()}.{os.urandom(2).hex()}"
+    try:
+        if base + size > os.fstat(sfd).st_size:
+            return False
+        dfd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
+        off_in, off_out = base, 0
+        while off_out < size:
+            n = os.copy_file_range(sfd, dfd, size - off_out,
+                                   offset_src=off_in, offset_dst=off_out)
+            if n == 0:
+                raise OSError("copy_file_range returned 0")
+            off_in += n
+            off_out += n
+        os.rename(tmp, dst_path)
+        return True
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+    finally:
+        os.close(sfd)
+        if dfd >= 0:
+            os.close(dfd)
+
+
 def pull_object(name: str, addr: Addr, expected_size: int = -1,
                 arena: Optional[tuple] = None) -> None:
     """Fetch segment ``name`` from the object server at ``addr`` into the
@@ -181,6 +244,8 @@ def pull_object(name: str, addr: Addr, expected_size: int = -1,
     path = os.path.join(shm_dir(), name)
     if os.path.exists(path):
         return
+    if arena is not None and _arena_local_copy(path, arena, expected_size):
+        return
     tmp = f"{path}.pull.{os.getpid()}.{threading.get_ident()}.{os.urandom(2).hex()}"
     conn, req_lock = _connection(addr)
     fd = -1
@@ -188,9 +253,9 @@ def pull_object(name: str, addr: Addr, expected_size: int = -1,
         with req_lock:
             if arena is not None:
                 conn.send({"arena": arena[0], "off": arena[1],
-                           "size": expected_size})
+                           "size": expected_size, "raw": True})
             else:
-                conn.send({"name": name})
+                conn.send({"name": name, "raw": True})
             hdr = conn.recv()
             if not hdr.get("ok"):
                 # clean protocol state — no chunks follow an error header
@@ -199,12 +264,39 @@ def pull_object(name: str, addr: Addr, expected_size: int = -1,
             if expected_size >= 0 and size != expected_size:
                 _evict(addr, conn)  # chunks are in flight; wire is dirty
                 raise IOError(f"pull of {name}: size {size} != expected {expected_size}")
-            fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
-            off = 0
-            while off < size:
-                data = conn.recv_bytes()
-                os.write(fd, data)
-                off += len(data)
+            fd = os.open(tmp, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+            if hdr.get("raw"):
+                # raw payload stream straight into the mmapped destination:
+                # one kernel->user copy total (the server side is sendfile)
+                import mmap
+                import socket as socket_mod
+
+                if size > 0:
+                    os.ftruncate(fd, size)
+                    sock = socket_mod.socket(fileno=os.dup(conn.fileno()))
+                    try:
+                        with mmap.mmap(fd, size) as mm:
+                            view = memoryview(mm)
+                            try:
+                                off = 0
+                                while off < size:
+                                    n = sock.recv_into(
+                                        view[off:], min(CHUNK, size - off))
+                                    if n == 0:
+                                        raise EOFError(
+                                            f"pull of {name}: stream ended "
+                                            f"at {off}/{size}")
+                                    off += n
+                            finally:
+                                view.release()  # else mmap.close() raises
+                    finally:
+                        sock.close()  # closes only the dup'd fd
+            else:
+                off = 0
+                while off < size:
+                    data = conn.recv_bytes()
+                    os.write(fd, data)
+                    off += len(data)
     except (OSError, EOFError) as e:
         if not isinstance(e, FileNotFoundError):
             _evict(addr, conn)

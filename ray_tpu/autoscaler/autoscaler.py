@@ -54,6 +54,14 @@ class StandardAutoscaler:
                      if ns.alive}
             for spec in list(head.pending_tasks):
                 demands.append(dict(spec.get("resources", {})))
+            # tasks leased into a busy worker's pipeline are queued work
+            # too (the reference reports lease BACKLOGS to load metrics —
+            # resource_demand_scheduler feeds on them); without this, fast
+            # worker dispatch hides all queued demand inside pipelines and
+            # the autoscaler never sees a reason to scale
+            for w in head.workers.values():
+                for spec in list(w.pipeline):
+                    demands.append(dict(spec.get("resources", {})))
             for art in head.actors.values():
                 if art.info.state == "PENDING_CREATION" and art.worker is None:
                     demands.append(dict(art.info.creation_spec.get("resources", {})))

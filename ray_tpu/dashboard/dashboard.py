@@ -130,6 +130,18 @@ class Dashboard:
                     self.end_headers()
                     self.wfile.write(str(e).encode())
 
+            def do_PUT(self):
+                try:
+                    dash._route_put(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+
+            do_POST = do_PUT
+
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.address = self._server.server_address
         self._thread = threading.Thread(target=self._server.serve_forever,
@@ -227,11 +239,65 @@ class Dashboard:
             })
         if what == "serve/applications":
             return self._serve_status()
+        if what == "serve/config":
+            # the declarative goal config last applied over PUT (empty if
+            # serve is down or nothing was config-deployed)
+            import ray_tpu
+            from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+            try:
+                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                return _jsonable(ray_tpu.get(
+                    controller.get_deploy_config.remote(), timeout=10) or {})
+            except Exception:
+                return {}
         try:
             # the state-API backend takes the right locks and strips blobs
             return _jsonable(node._list_state(what, limit))
         except ValueError:
             return None
+
+    def _route_put(self, req: BaseHTTPRequestHandler) -> None:
+        path = urlparse(req.path).path.rstrip("/")
+        if path != "/api/serve/applications":
+            req.send_response(404)
+            req.end_headers()
+            return
+        length = int(req.headers.get("Content-Length") or 0)
+        body = req.rfile.read(length) if length else b""
+        code, payload = self._serve_deploy(body)
+        data = json.dumps(payload).encode()
+        req.send_response(code)
+        req.send_header("Content-Type", "application/json")
+        req.send_header("Content-Length", str(len(data)))
+        req.end_headers()
+        req.wfile.write(data)
+
+    def _serve_deploy(self, body: bytes):
+        """PUT /api/serve/applications: validate a declarative config and
+        hand it to the controller to reconcile (the reference's
+        ``dashboard/modules/serve/serve_head.py`` deploy path)."""
+        import ray_tpu
+        from ray_tpu.serve.schema import SchemaError, parse_deploy_config
+
+        try:
+            parsed = parse_deploy_config(json.loads(body or b"{}"))
+        except (ValueError, SchemaError) as e:  # includes JSONDecodeError
+            return 400, {"error": str(e)}
+        try:
+            from ray_tpu.serve import api as serve_api
+
+            serve_api.start()  # idempotent: connect-or-boot controller+proxy
+            controller = serve_api._get_client().controller
+        except Exception as e:  # noqa: BLE001
+            return 503, {"error": f"cannot start serve: {type(e).__name__}: {e}"}
+        try:
+            out = ray_tpu.get(
+                controller.apply_deploy_config.remote(parsed.to_dict()),
+                timeout=180)
+        except Exception as e:  # noqa: BLE001
+            return 500, {"error": f"deploy failed: {type(e).__name__}: {e}"}
+        return 200, out
 
     def _serve_status(self):
         """Serve REST module (``dashboard/modules/serve`` analog): live

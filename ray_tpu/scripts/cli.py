@@ -193,7 +193,55 @@ def cmd_serve_status(_args) -> None:
             status[name]["autoscaling_metrics"] = m
     except Exception as e:  # noqa: BLE001
         status["_autoscaling_metrics_error"] = f"{type(e).__name__}: {e}"
+    try:
+        goal = rt.get(controller.get_deploy_config.remote(), timeout=10)
+        if goal:  # goal (declarative config) vs actual (status above)
+            status["_goal_config"] = goal
+    except Exception:
+        pass
     print(json.dumps(status, indent=2, default=repr))
+
+
+def cmd_serve_deploy(args) -> None:
+    """``serve deploy config.yaml`` analog: validate the declarative app
+    config and PUT it to the head's REST endpoint."""
+    import urllib.request
+
+    with open(args.config) as f:
+        text = f.read()
+    try:
+        config = json.loads(text)
+    except json.JSONDecodeError:
+        try:  # yaml if the environment provides it; never a hard dependency
+            import yaml  # type: ignore
+
+            config = yaml.safe_load(text)
+        except ImportError:
+            raise SystemExit(
+                "config must be JSON (no yaml parser in this environment)")
+    from ray_tpu.serve.schema import SchemaError, parse_deploy_config
+
+    try:
+        parse_deploy_config(config)  # client-side validation, better errors
+    except SchemaError as e:
+        raise SystemExit(f"invalid config: {e}")
+    _connect()
+    from ray_tpu._private.worker import global_worker
+
+    snap = global_worker.client.request({"type": "state_snapshot"})["value"]
+    dash = snap.get("dashboard")
+    if not dash:
+        raise SystemExit("head has no dashboard; cannot reach the serve REST API")
+    req = urllib.request.Request(
+        "http://%s:%d/api/serve/applications" % tuple(dash),
+        data=json.dumps(config).encode(),
+        headers={"Content-Type": "application/json"}, method="PUT")
+    try:
+        with urllib.request.urlopen(req, timeout=240) as resp:
+            print(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        # the endpoint's JSON error payload IS the diagnosis; show it
+        raise SystemExit(f"deploy failed ({e.code}): {e.read().decode()}")
 
 
 def main(argv=None) -> None:
@@ -247,6 +295,12 @@ def main(argv=None) -> None:
     sub.add_parser(
         "serve-status", help="serve deployments + autoscaling state"
     ).set_defaults(fn=cmd_serve_status)
+
+    s = sub.add_parser(
+        "serve-deploy",
+        help="deploy serve applications from a declarative JSON config")
+    s.add_argument("config", help="path to the config file")
+    s.set_defaults(fn=cmd_serve_deploy)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -205,6 +205,85 @@ class ServeController:
         with self._lock:
             return list(self._deployments)
 
+    # ------------------------------------------------------------------
+    # declarative config deploy (serve/schema.py + serve_head.py analog)
+    # ------------------------------------------------------------------
+    def apply_deploy_config(self, config: dict) -> dict:
+        """Reconcile live state to a validated declarative config: import
+        each application's target, apply per-deployment overrides, deploy,
+        and delete config-owned deployments the new config dropped.
+        Code-deployed apps (serve.run) are left alone."""
+        import cloudpickle
+        import ray_tpu
+        from ray_tpu.serve.api import Application
+        from ray_tpu.serve.batching import uses_batching
+        from ray_tpu.serve.handle import DeploymentHandle
+        from ray_tpu.serve.schema import _UNSET, import_target, parse_deploy_config
+
+        schema = parse_deploy_config(config)
+        self_handle = ray_tpu.get_actor(CONTROLLER_NAME)
+        deployed: List[str] = []
+        warnings: List[str] = []
+
+        def deploy_app(app_schema, a, is_root: bool):
+            d = a.deployment
+            ov = next((o for o in app_schema.deployments
+                       if o.name == d.name), None)
+            if ov is not None:
+                d = d.options(
+                    num_replicas=ov.num_replicas,
+                    max_concurrent_queries=ov.max_concurrent_queries,
+                    user_config=ov.user_config,
+                    ray_actor_options=ov.ray_actor_options,
+                    route_prefix=ov.route_prefix,  # shares options()'s
+                    # "__unset__" sentinel value
+                    autoscaling_config=(ov.autoscaling_config
+                                        if ov.autoscaling_config is not None
+                                        else "__unset__"),
+                )
+            if (is_root and app_schema.route_prefix != _UNSET
+                    and (ov is None or ov.route_prefix == _UNSET)):
+                d = d.options(route_prefix=app_schema.route_prefix)
+            args = tuple(
+                deploy_app(app_schema, v, False) if isinstance(v, Application)
+                else v for v in a.args)
+            kwargs = {
+                k: deploy_app(app_schema, v, False) if isinstance(v, Application)
+                else v for k, v in a.kwargs.items()}
+            goal = {
+                "serialized_def": cloudpickle.dumps(d._func_or_class),
+                "init_args": args,
+                "init_kwargs": kwargs,
+                "config": d.config,
+                "route_prefix": d.route_prefix,
+                "uses_batching": uses_batching(d._func_or_class),
+            }
+            self.deploy(d.name, goal)
+            deployed.append(d.name)
+            return DeploymentHandle(d.name, self_handle)
+
+        for app_schema in schema.applications:
+            if app_schema.runtime_env:
+                warnings.append(
+                    f"app {app_schema.name!r}: runtime_env is recorded but "
+                    "not applied to config imports (import_path must be "
+                    "importable in the controller's environment)")
+            deploy_app(app_schema, import_target(app_schema.import_path), True)
+
+        prev_owned = set(getattr(self, "_config_owned", ()))
+        for name in prev_owned - set(deployed):
+            self.delete_deployment(name)
+        self._config_owned = set(deployed)
+        self._goal_config = schema.to_dict()
+        out = {"deployed": deployed}
+        if warnings:
+            out["warnings"] = warnings
+        return out
+
+    def get_deploy_config(self) -> Optional[dict]:
+        """The last applied declarative config (goal), or None."""
+        return getattr(self, "_goal_config", None)
+
     def graceful_shutdown(self) -> bool:
         """Kill every replica; the controller actor itself is killed by
         serve.shutdown() afterwards."""

@@ -1,0 +1,161 @@
+"""Declarative serve config deploy: schema validation, REST PUT through
+the dashboard, controller reconciliation (deploy/update/delete), and
+goal-vs-actual readback (serve/schema.py + dashboard serve REST analog)."""
+
+import gc
+import json
+import os
+import sys
+import textwrap
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+from ray_tpu.serve.schema import SchemaError, parse_deploy_config
+
+
+def test_schema_validation_errors():
+    with pytest.raises(SchemaError, match="applications"):
+        parse_deploy_config({})
+    with pytest.raises(SchemaError, match="import_path"):
+        parse_deploy_config({"applications": [{"name": "a"}]})
+    with pytest.raises(SchemaError, match="route_prefix"):
+        parse_deploy_config({"applications": [
+            {"import_path": "m:x", "route_prefix": "noslash"}]})
+    with pytest.raises(SchemaError, match="num_replicas"):
+        parse_deploy_config({"applications": [
+            {"import_path": "m:x",
+             "deployments": [{"name": "d", "num_replicas": -1}]}]})
+    with pytest.raises(SchemaError, match="unknown fields"):
+        parse_deploy_config({"applications": [
+            {"import_path": "m:x", "bogus": 1}]})
+    ok = parse_deploy_config({"applications": [
+        {"import_path": "m:x", "name": "app",
+         "deployments": [{"name": "d", "num_replicas": 2}]}]})
+    assert ok.applications[0].deployments[0].num_replicas == 2
+
+
+APP_MODULE = """
+from ray_tpu import serve
+
+@serve.deployment
+class ConfigApp:
+    def __init__(self, greeting="hello"):
+        self.greeting = greeting
+        self.threshold = 0.0
+
+    def reconfigure(self, cfg):
+        self.threshold = cfg.get("threshold", 0.0)
+
+    def __call__(self, request=None):
+        return {"greeting": self.greeting, "threshold": self.threshold}
+
+app = ConfigApp.bind()
+"""
+
+
+@pytest.fixture
+def config_app_module(tmp_path):
+    (tmp_path / "serve_cfg_testmod.py").write_text(textwrap.dedent(APP_MODULE))
+    old_pp = os.environ.get("PYTHONPATH", "")
+    os.environ["PYTHONPATH"] = f"{tmp_path}{os.pathsep}{old_pp}"
+    sys.path.insert(0, str(tmp_path))
+    yield "serve_cfg_testmod"
+    sys.path.remove(str(tmp_path))
+    os.environ["PYTHONPATH"] = old_pp
+    sys.modules.pop("serve_cfg_testmod", None)
+
+
+@pytest.fixture
+def serve_cluster():
+    ray_tpu.init(num_cpus=4)
+    serve.start(serve.HTTPOptions(host="127.0.0.1", port=0))
+    yield
+    try:
+        serve.shutdown()
+    finally:
+        ray_tpu.shutdown()
+
+
+def _dashboard_port():
+    from ray_tpu._private import node as node_mod
+
+    heads = [o for o in gc.get_objects()
+             if isinstance(o, node_mod.Node) and not o._shutdown]
+    return heads[-1].dashboard.address[1]
+
+
+def _put_config(port, config):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api/serve/applications",
+        data=json.dumps(config).encode(),
+        headers={"Content-Type": "application/json"}, method="PUT")
+    try:
+        with urllib.request.urlopen(req, timeout=180) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_rest_config_deploy_update_delete(config_app_module, serve_cluster):
+    port = _dashboard_port()
+    # bad config -> 400 with the offending path
+    code, out = _put_config(port, {"applications": [{"name": "x"}]})
+    assert code == 400 and "import_path" in out["error"]
+
+    # deploy from config
+    config = {"applications": [{
+        "name": "cfgapp",
+        "import_path": f"{config_app_module}:app",
+        "route_prefix": "/cfg",
+        "deployments": [{"name": "ConfigApp", "num_replicas": 1,
+                         "user_config": {"threshold": 0.25}}],
+    }]}
+    code, out = _put_config(port, config)
+    assert code == 200, out
+    assert out["deployed"] == ["ConfigApp"]
+
+    # the app serves HTTP on the configured route with the user_config
+    host, hport = serve.get_http_address()
+    deadline_ok = None
+    for _ in range(60):
+        try:
+            with urllib.request.urlopen(
+                    f"http://{host}:{hport}/cfg", timeout=30) as r:
+                deadline_ok = json.loads(r.read())
+            break
+        except Exception:
+            import time
+
+            time.sleep(0.5)
+    assert deadline_ok == {"greeting": "hello", "threshold": 0.25}
+
+    # goal config is readable back (goal vs actual)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/api/serve/config", timeout=30) as r:
+        goal = json.loads(r.read())
+    assert goal["applications"][0]["name"] == "cfgapp"
+    assert serve.status()["ConfigApp"]["num_replicas_goal"] == 1
+
+    # config update: num_replicas 2 reconciles live
+    config["applications"][0]["deployments"][0]["num_replicas"] = 2
+    code, out = _put_config(port, config)
+    assert code == 200, out
+    import time
+
+    for _ in range(120):
+        if serve.status()["ConfigApp"]["num_replicas_goal"] == 2:
+            break
+        time.sleep(0.5)
+    assert serve.status()["ConfigApp"]["num_replicas_goal"] == 2
+
+    # an empty config deletes every config-owned deployment
+    code, out = _put_config(port, {"applications": []})
+    assert code == 200, out
+    for _ in range(60):
+        if "ConfigApp" not in serve.status():
+            break
+        time.sleep(0.5)
+    assert "ConfigApp" not in serve.status()
