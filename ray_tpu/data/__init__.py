@@ -12,6 +12,7 @@ from ray_tpu.data.datasource import Datasource, FileBasedDatasource, ReadTask
 from ray_tpu.data.read_api import (
     from_items,
     from_block_generator,
+    from_arrow,
     from_numpy,
     from_pandas,
     range,
@@ -38,6 +39,7 @@ __all__ = [
     "BlockAccessor",
     "from_items",
     "from_block_generator",
+    "from_arrow",
     "from_numpy",
     "from_pandas",
     "range",

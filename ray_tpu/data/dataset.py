@@ -16,6 +16,7 @@ from __future__ import annotations
 import math
 import queue as queue_mod
 import threading
+from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
@@ -122,18 +123,78 @@ class Dataset:
         return Dataset(self._plan.with_stage(stage))
 
     def _iter_block_refs(self):
-        """Block refs in order, streaming when possible: a stage-free plan
-        over an ObjectRefGenerator yields refs AS THE PRODUCER TASK YIELDS
-        THEM (never materializing the full block list); anything else
-        executes the plan first."""
+        """Block refs in order, streaming when possible (the
+        streaming_executor analog, ``_internal/streaming_executor.py``):
+
+        - a stage-free plan over an ObjectRefGenerator yields refs AS THE
+          PRODUCER TASK YIELDS THEM (never materializing the block list)
+        - a plan whose trailing stages are all one-to-one streams them:
+          the fused map task for block N+W is submitted only as block N
+          is handed to the consumer (bounded in-flight window W), so
+          reads/transforms overlap training ingest with backpressure
+          instead of materializing stage-by-stage
+        - anything else (a trailing shuffle/actor-pool stage) executes
+          the plan first
+        """
+        import time as _time
+
         from ray_tpu._private.object_ref import ObjectRefGenerator
+        from ray_tpu.data.plan import OneToOneStage, fuse_one_to_one
 
         plan = self._plan
+        if plan._out is not None:
+            yield from plan._out[0]
+            return
         if (isinstance(plan.input_refs, ObjectRefGenerator)
-                and not plan.stages and plan._out is None):
+                and not plan.stages):
             yield from plan.input_refs
             return
-        yield from self._blocks
+        # split the plan at the last barrier stage; the one-to-one suffix
+        # streams over the prefix's output refs
+        barrier = -1
+        for i, s in enumerate(plan.stages):
+            if not isinstance(s, OneToOneStage):
+                barrier = i
+        suffix = plan.stages[barrier + 1:]
+        if not suffix:
+            yield from self._blocks
+            return
+        if barrier >= 0:
+            # run (once) and cache the barrier prefix on the main plan —
+            # a second epoch must not redo the shuffle
+            refs_in = getattr(plan, "_stream_prefix_out", None)
+            if refs_in is None:
+                prefix_plan = ExecutionPlan(
+                    plan.input_refs, plan.input_counts,
+                    plan.stages[:barrier + 1])
+                refs_in = prefix_plan.execute()[0]
+                plan._stream_prefix_out = refs_in
+                plan._stats.extend(prefix_plan.stats())
+        else:
+            refs_in = plan.input_refs
+            if isinstance(refs_in, list):
+                refs_in = list(refs_in)
+        task, fns, name = fuse_one_to_one(suffix)
+        t0 = _time.perf_counter()
+        window: deque = deque()
+        out_refs: List[Any] = []
+        W = 8  # in-flight fused tasks; balances overlap vs flood
+        for ref in refs_in:
+            window.append(task.remote(ref, fns))
+            if len(window) >= W:
+                r = window.popleft()
+                out_refs.append(r)
+                yield r
+        while window:
+            r = window.popleft()
+            out_refs.append(r)
+            yield r
+        # full exhaustion: cache as the plan's result so re-iteration and
+        # count()/take() reuse these refs instead of re-running the plan
+        plan._out = (out_refs, None)
+        plan._stats.append({"stage": f"{name} (streamed)",
+                            "wall_s": round(_time.perf_counter() - t0, 4),
+                            "blocks": len(out_refs)})
 
     def stats(self) -> List[Dict[str, Any]]:
         """Per-stage execution stats (the _internal/stats.py analog)."""
@@ -438,6 +499,8 @@ class Dataset:
             import pandas as pd
 
             return pd.DataFrame(acc.to_rows())
+        if batch_format in ("pyarrow", "arrow"):
+            return acc.to_arrow()
         raise ValueError(f"unknown batch_format {batch_format!r}")
 
     def to_numpy(self, column: Optional[str] = None) -> np.ndarray:

@@ -146,18 +146,30 @@ class ParquetDatasource(FileBasedDatasource):
     suffix = ".parquet"
 
     def read_file(self, path: str, **kw) -> Block:
-        import pandas as pd
+        try:
+            import pyarrow.parquet as pq
 
-        df = pd.read_parquet(path, **kw)
-        return {c: df[c].to_numpy() for c in df.columns}
+            # native Arrow blocks: zero-copy into the store (pickle-5
+            # out-of-band buffers), zero-copy slicing downstream
+            return pq.read_table(path, **kw)
+        except ImportError:
+            import pandas as pd
+
+            df = pd.read_parquet(path, **kw)
+            return {c: df[c].to_numpy() for c in df.columns}
 
     def write_block(self, block: Block, path: str, index: int, **kw) -> str:
-        import pandas as pd
-
         from ray_tpu.data.block import BlockAccessor
 
         out = os.path.join(path, f"part-{index:05d}.parquet")
-        pd.DataFrame(BlockAccessor(block).to_batch()).to_parquet(out, **kw)
+        try:
+            import pyarrow.parquet as pq
+
+            pq.write_table(BlockAccessor(block).to_arrow(), out, **kw)
+        except ImportError:
+            import pandas as pd
+
+            pd.DataFrame(BlockAccessor(block).to_batch()).to_parquet(out, **kw)
         return out
 
 

@@ -58,6 +58,15 @@ def _run_fused(block: Block, fns: List[Callable[[Block], Block]]) -> Block:
     return block
 
 
+def fuse_one_to_one(stages: List["OneToOneStage"]):
+    """(remote task, fns, fused name) for a run of one-to-one stages —
+    shared by eager execution and the streaming iterator so fusion
+    semantics can never diverge."""
+    fns = [s.fn for s in stages]
+    task = ray_tpu.remote(num_cpus=max(s.num_cpus for s in stages))(_run_fused)
+    return task, fns, "+".join(s.name for s in stages)
+
+
 @dataclass
 class ExecutionPlan:
     """Input block refs + recorded stages; executes at most once."""
@@ -102,11 +111,9 @@ class ExecutionPlan:
                 while i + 1 < len(self.stages) and isinstance(self.stages[i + 1], OneToOneStage):
                     i += 1
                     run.append(self.stages[i])
-                fns = [s.fn for s in run]
-                task = ray_tpu.remote(num_cpus=max(s.num_cpus for s in run))(_run_fused)
+                task, fns, name = fuse_one_to_one(run)
                 refs = [task.remote(r, fns) for r in refs]
                 counts = None  # row counts unknown after a transform
-                name = "+".join(s.name for s in run)
             elif isinstance(stage, ActorPoolStage):
                 refs = stage.submit(refs)
                 counts = None

@@ -93,6 +93,20 @@ def from_pandas(df) -> Dataset:
     return Dataset([ray_tpu.put(block)], [len(df)])
 
 
+def from_arrow(tables) -> Dataset:
+    """Dataset over Arrow table block(s) — zero-copy into the store
+    (``from_arrow``, ``python/ray/data/read_api.py`` analog)."""
+    import pyarrow as pa
+
+    if isinstance(tables, pa.Table):
+        tables = [tables]
+    for t in tables:
+        if not isinstance(t, pa.Table):
+            raise TypeError(f"from_arrow expects pyarrow.Table(s), got {type(t)}")
+    return Dataset([ray_tpu.put(t) for t in tables],
+                   [t.num_rows for t in tables])
+
+
 def read_csv(paths: Union[str, List[str]], *, parallelism: int = DEFAULT_BLOCKS, **kw) -> Dataset:
     return read_datasource(CSVDatasource(paths, **kw), parallelism=parallelism)
 

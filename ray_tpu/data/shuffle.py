@@ -24,8 +24,17 @@ from ray_tpu.data.block import Block, BlockAccessor
 from ray_tpu.data.plan import AllToAllStage
 
 
+def _columnar(block: Block) -> Block:
+    """Arrow blocks take the columnar fast paths as dict tables (a copy,
+    but row-wise Python bucketing would be far worse); other layouts pass
+    through untouched."""
+    acc = BlockAccessor(block)
+    return acc.to_batch() if acc.is_arrow else block
+
+
 def _partition_random(block: Block, n: int, seed: Optional[int]):
     """Assign each row to a random partition (map side of the shuffle)."""
+    block = _columnar(block)
     acc = BlockAccessor(block)
     rows = acc.num_rows()
     rng = np.random.default_rng(seed)

@@ -82,7 +82,12 @@ class DataParallelTrainer(BaseTrainer):
         n = self.scaling_config.num_workers
         shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
         for name, ds in self.datasets.items():
-            if hasattr(ds, "split"):
+            if n == 1 and hasattr(ds, "iter_batches"):
+                # single worker: hand over the dataset WITH its lazy plan —
+                # splitting would execute it eagerly and the worker's
+                # iter_batches could no longer stream read+transform
+                parts = [ds]
+            elif hasattr(ds, "split"):
                 parts = ds.split(n)
             else:  # plain sequence: even slices
                 per = len(ds) // n
