@@ -97,6 +97,16 @@ class _Entry:
 # Objects touched within this window are not spill candidates — closes the
 # race where a get reply carrying an shm location is in flight while the
 # head spills the segment out from under the consumer.
+#
+# Why eviction candidate selection is safe PYTHON-side (vs the reference's
+# in-store eviction_policy.h): the native arena is single-writer — only
+# the head process allocates/frees (store_core.cc's contract), and every
+# registry mutation (create/seal/pin/spill) happens under this registry's
+# lock in that same process.  A concurrent seal therefore cannot race a
+# spill decision: both serialize on self._lock, and the C layer is only
+# ever called while it is held.  Readers in other processes see sealed
+# slices via control-plane locations and are protected by the idle window
+# + pin counts, not by store-internal locking.
 _SPILL_MIN_IDLE_S = 5.0
 
 

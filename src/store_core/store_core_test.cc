@@ -1,0 +1,228 @@
+// Unit tests for the native store core (assert-based; no gtest in the
+// image).  Covers the invariants the Python suite can't see from outside
+// the C ABI: free-list reuse, neighbor coalescing, bump retreat,
+// fragmentation behavior, capacity accounting, and index lifecycle.
+// `make test` runs them under AddressSanitizer (the plasma component is
+// where memory bugs corrupt user payloads — reference keeps its
+// eviction/alloc under sanitizers the same way).
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <unistd.h>
+
+extern "C" {
+void* rtpu_store_create(const char* path, uint64_t capacity);
+int rtpu_store_put(void* h, const uint8_t* oid, uint64_t size, uint64_t* off);
+int rtpu_store_seal(void* h, const uint8_t* oid);
+int rtpu_store_get(void* h, const uint8_t* oid, uint64_t* off, uint64_t* size,
+                   int* sealed);
+int rtpu_store_delete(void* h, const uint8_t* oid);
+uint64_t rtpu_store_bytes_used(void* h);
+uint64_t rtpu_store_capacity(void* h);
+uint64_t rtpu_store_num_objects(void* h);
+uint64_t rtpu_store_num_free_blocks(void* h);
+void rtpu_store_close(void* h, int unlink_file);
+}
+
+namespace {
+
+constexpr uint64_t kAlign = 64;
+
+struct Oid {
+  uint8_t b[16];
+  explicit Oid(int i) {
+    std::memset(b, 0, sizeof(b));
+    std::memcpy(b, &i, sizeof(i));
+  }
+};
+
+std::string tmp_path() {
+  static int n = 0;
+  return "/tmp/rtpu-store-test-" + std::to_string(::getpid()) + "-" +
+         std::to_string(n++);
+}
+
+void test_create_put_get_seal_delete() {
+  auto p = tmp_path();
+  void* h = rtpu_store_create(p.c_str(), 1 << 20);
+  assert(h != nullptr);
+  // duplicate create must fail (O_EXCL)
+  assert(rtpu_store_create(p.c_str(), 1 << 20) == nullptr);
+
+  Oid a(1);
+  uint64_t off = 0, size = 0;
+  int sealed = -1;
+  assert(rtpu_store_put(h, a.b, 1000, &off) == 0);
+  assert(off >= 4096 && off % kAlign == 0);  // data starts after the header
+  assert(rtpu_store_put(h, a.b, 1000, &off) == -1);  // dup oid
+  assert(rtpu_store_get(h, a.b, &off, &size, &sealed) == 0);
+  assert(size == 1000 && sealed == 0);
+  assert(rtpu_store_seal(h, a.b) == 0);
+  assert(rtpu_store_get(h, a.b, &off, &size, &sealed) == 0 && sealed == 1);
+  assert(rtpu_store_num_objects(h) == 1);
+  assert(rtpu_store_bytes_used(h) == (1000 + kAlign - 1) / kAlign * kAlign);
+  assert(rtpu_store_delete(h, a.b) == 0);
+  assert(rtpu_store_delete(h, a.b) == -1);
+  assert(rtpu_store_get(h, a.b, &off, &size, &sealed) == -1);
+  assert(rtpu_store_num_objects(h) == 0 && rtpu_store_bytes_used(h) == 0);
+  rtpu_store_close(h, 1);
+  std::puts("  create/put/get/seal/delete OK");
+}
+
+void test_free_list_reuse_and_coalescing() {
+  auto p = tmp_path();
+  void* h = rtpu_store_create(p.c_str(), 1 << 20);
+  uint64_t off[4];
+  for (int i = 0; i < 4; ++i) {
+    Oid o(i);
+    assert(rtpu_store_put(h, o.b, 4096, &off[i]) == 0);
+  }
+  // delete middle neighbors -> ONE coalesced free block
+  Oid o1(1), o2(2);
+  assert(rtpu_store_delete(h, o1.b) == 0);
+  assert(rtpu_store_num_free_blocks(h) == 1);
+  assert(rtpu_store_delete(h, o2.b) == 0);
+  assert(rtpu_store_num_free_blocks(h) == 1);  // coalesced, not 2
+  // a fit into the hole reuses the SAME offset (first-fit recycling)
+  Oid o4(4);
+  uint64_t off4 = 0;
+  assert(rtpu_store_put(h, o4.b, 8192, &off4) == 0);
+  assert(off4 == off[1]);
+  assert(rtpu_store_num_free_blocks(h) == 0);
+  // deleting the LAST object retreats the bump instead of listing
+  Oid o3(3);
+  assert(rtpu_store_delete(h, o3.b) == 0);
+  assert(rtpu_store_num_free_blocks(h) == 0);
+  // ...so the next alloc lands exactly where object 3 was
+  Oid o5(5);
+  uint64_t off5 = 0;
+  assert(rtpu_store_put(h, o5.b, 64, &off5) == 0);
+  assert(off5 == off[3]);
+  rtpu_store_close(h, 1);
+  std::puts("  free-list reuse + coalescing OK");
+}
+
+void test_fragmentation_and_split() {
+  auto p = tmp_path();
+  void* h = rtpu_store_create(p.c_str(), 1 << 20);
+  uint64_t off[8];
+  for (int i = 0; i < 8; ++i) {
+    Oid o(i);
+    assert(rtpu_store_put(h, o.b, 1024, &off[i]) == 0);
+  }
+  // checkerboard delete -> 4 disjoint holes
+  for (int i = 0; i < 8; i += 2) {
+    Oid o(i);
+    assert(rtpu_store_delete(h, o.b) == 0);
+  }
+  assert(rtpu_store_num_free_blocks(h) == 4);
+  // small alloc splits a hole, leaving remainder on the list
+  Oid s(100);
+  uint64_t soff = 0;
+  assert(rtpu_store_put(h, s.b, 128, &soff) == 0);
+  assert(soff == off[0]);
+  assert(rtpu_store_num_free_blocks(h) == 4);  // split kept the remainder
+  // an alloc larger than any hole must go to the bump frontier
+  Oid big(101);
+  uint64_t boff = 0;
+  assert(rtpu_store_put(h, big.b, 4096, &boff) == 0);
+  assert(boff > off[7]);
+  rtpu_store_close(h, 1);
+  std::puts("  fragmentation/split OK");
+}
+
+void test_capacity_exhaustion() {
+  auto p = tmp_path();
+  void* h = rtpu_store_create(p.c_str(), 64 << 10);
+  Oid a(1), b(2), c(3);
+  uint64_t off = 0;
+  assert(rtpu_store_put(h, a.b, 40 << 10, &off) == 0);
+  assert(rtpu_store_put(h, b.b, 40 << 10, &off) == -2);  // doesn't fit
+  // freeing makes room again (recycled, not grown)
+  assert(rtpu_store_delete(h, a.b) == 0);
+  assert(rtpu_store_put(h, c.b, 40 << 10, &off) == 0);
+  // zero-size objects still get a distinct slot
+  Oid z(4);
+  uint64_t zoff = 0;
+  assert(rtpu_store_put(h, z.b, 0, &zoff) == 0);
+  uint64_t got_off = 0, got_size = 1;
+  int sealed = 0;
+  assert(rtpu_store_get(h, z.b, &got_off, &got_size, &sealed) == 0);
+  assert(got_size == 0);
+  rtpu_store_close(h, 1);
+  std::puts("  capacity exhaustion OK");
+}
+
+void test_churn_invariants() {
+  // randomized churn: used-bytes accounting must track exactly, and all
+  // live offsets must stay disjoint (the corruption class ASAN can't see
+  // because the arena is one allocation)
+  auto p = tmp_path();
+  void* h = rtpu_store_create(p.c_str(), 4 << 20);
+  std::vector<int> live;
+  uint64_t expect_used = 0;
+  unsigned seed = 12345;
+  auto rnd = [&seed]() { return seed = seed * 1103515245 + 12345; };
+  int next_id = 0;
+  for (int step = 0; step < 5000; ++step) {
+    if (live.empty() || rnd() % 3) {
+      int id = next_id++;
+      uint64_t sz = 64 + rnd() % 8000;
+      Oid o(id);
+      uint64_t off = 0;
+      int rc = rtpu_store_put(h, o.b, sz, &off);
+      if (rc == 0) {
+        live.push_back(id);
+        expect_used += (sz + kAlign - 1) / kAlign * kAlign;
+      } else {
+        assert(rc == -2);
+      }
+    } else {
+      int idx = rnd() % live.size();
+      int id = live[idx];
+      Oid o(id);
+      uint64_t off = 0, sz = 0;
+      int sealed = 0;
+      assert(rtpu_store_get(h, o.b, &off, &sz, &sealed) == 0);
+      assert(rtpu_store_delete(h, o.b) == 0);
+      expect_used -= (sz + kAlign - 1) / kAlign * kAlign;
+      live[idx] = live.back();
+      live.pop_back();
+    }
+    assert(rtpu_store_bytes_used(h) == expect_used);
+  }
+  // verify all live blocks are disjoint [offset, offset+size)
+  std::vector<std::pair<uint64_t, uint64_t>> spans;
+  for (int id : live) {
+    Oid o(id);
+    uint64_t off = 0, sz = 0;
+    int sealed = 0;
+    assert(rtpu_store_get(h, o.b, &off, &sz, &sealed) == 0);
+    spans.emplace_back(off, off + ((sz + kAlign - 1) / kAlign * kAlign));
+  }
+  for (size_t i = 0; i < spans.size(); ++i)
+    for (size_t j = i + 1; j < spans.size(); ++j) {
+      bool disjoint = spans[i].second <= spans[j].first ||
+                      spans[j].second <= spans[i].first;
+      assert(disjoint);
+    }
+  rtpu_store_close(h, 1);
+  std::puts("  churn invariants OK");
+}
+
+}  // namespace
+
+int main() {
+  test_create_put_get_seal_delete();
+  test_free_list_reuse_and_coalescing();
+  test_fragmentation_and_split();
+  test_capacity_exhaustion();
+  test_churn_invariants();
+  std::puts("store_core_test: ALL OK");
+  return 0;
+}

@@ -130,3 +130,23 @@ def test_zero_copy_views_pin_arena_slots(ray_start_regular):
             break
         time.sleep(0.3)
     assert arena.stats()["bytes_used"] < used_with_pin
+
+
+def test_cpp_unit_tests_under_asan():
+    """Build + run the C++ allocator unit tests under ASan/UBSan
+    (src/store_core/store_core_test.cc): free-list reuse, coalescing,
+    fragmentation, accounting, randomized churn invariants."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    if shutil.which("make") is None:
+        pytest.skip("make not available")
+    src_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src", "store_core")
+    out = subprocess.run(["make", "test"], cwd=src_dir,
+                         capture_output=True, text=True, timeout=300)
+    sys.stdout.write(out.stdout[-1000:])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "ALL OK" in out.stdout
