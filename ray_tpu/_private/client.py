@@ -10,6 +10,7 @@ by request id (the client-call manager pattern of ``src/ray/rpc/client_call.h``)
 from __future__ import annotations
 
 import itertools
+import pickle
 import threading
 import time
 from multiprocessing.connection import Client as MPClient
@@ -31,9 +32,12 @@ class CoreClient:
             target, family = address, "AF_UNIX"
         # The handshake occasionally loses a challenge race when several
         # processes connect at once — retry, it is not a credentials problem.
+        from ray_tpu._private import wire
+
         for attempt in range(5):
             try:
-                self.conn = MPClient(target, family=family, authkey=authkey)
+                self.conn = wire.wrap(
+                    MPClient(target, family=family, authkey=authkey))
                 break
             except (AuthenticationError, OSError, EOFError):
                 if attempt == 4:
@@ -128,7 +132,10 @@ class CoreClient:
         while not self.closed:
             try:
                 msg = self.conn.recv()
-            except (EOFError, OSError):
+            except (EOFError, OSError, pickle.UnpicklingError):
+                # UnpicklingError covers wire.WireDecodeError: a corrupt
+                # or version-mismatched frame is a broken connection, not
+                # a reason to leave request() waiters hanging
                 self.closed = True
                 # wake all waiters with a connection error
                 with self._pending_lock:

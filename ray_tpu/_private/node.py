@@ -43,7 +43,7 @@ from dataclasses import dataclass, field
 from multiprocessing.connection import Connection, Listener
 from typing import Any, Dict, List, Optional, Tuple
 
-from ray_tpu._private import logging_utils
+from ray_tpu._private import logging_utils, wire
 from ray_tpu._private.config import get_config
 from ray_tpu._private.gcs import (
     ActorInfo,
@@ -800,7 +800,7 @@ class Node:
         failures = 0
         while not self._shutdown:
             try:
-                conn = listener.accept()
+                conn = wire.wrap(listener.accept())
                 failures = 0
             except (AuthenticationError, OSError, EOFError):
                 # one peer dying mid-handshake (EOF/reset) or failing auth

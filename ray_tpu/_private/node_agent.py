@@ -22,6 +22,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import pickle
 import subprocess
 import sys
 import threading
@@ -81,7 +82,10 @@ class NodeAgent:
         self._lock = threading.Lock()
         self._shutdown = False
 
-        self.conn = MPClient(self.head_addr, family="AF_INET", authkey=authkey)
+        from ray_tpu._private import wire
+
+        self.conn = wire.wrap(
+            MPClient(self.head_addr, family="AF_INET", authkey=authkey))
         self._send_lock = threading.Lock()
         self._send({
             "type": "register_node",
@@ -108,7 +112,9 @@ class NodeAgent:
             while not self._shutdown:
                 try:
                     msg = self.conn.recv()
-                except (EOFError, OSError):
+                except (EOFError, OSError, pickle.UnpicklingError):
+                    # incl. wire.WireDecodeError: treat a bad frame as a
+                    # lost head connection, not an agent crash
                     logger.warning("head connection lost; shutting down node")
                     break
                 try:
