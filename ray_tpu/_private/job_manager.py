@@ -53,9 +53,28 @@ class JobManager:
             self.jobs[job_id] = placeholder
         env = dict(os.environ)
         cwd = None
+        module_paths: list = []
         if runtime_env:
+            from ray_tpu._private.runtime_env_packaging import (
+                PKG_KV_NAMESPACE, ensure_package_local, is_package_uri,
+            )
+
+            def materialize(uri: str) -> str:
+                # a remote submitter uploaded local code as content-
+                # addressed packages; extract from the head's own KV
+                return ensure_package_local(
+                    lambda u: self.node.gcs.kv_get(
+                        PKG_KV_NAMESPACE, u.encode()), uri)
+
             env.update(runtime_env.get("env_vars") or {})
             cwd = runtime_env.get("working_dir")
+            if is_package_uri(cwd):
+                cwd = materialize(cwd)
+            # py_modules go on the DRIVER's PYTHONPATH (the reference
+            # installs them through the agent before the driver starts)
+            for m in runtime_env.get("py_modules") or []:
+                module_paths.append(materialize(m) if is_package_uri(m)
+                                    else m)
         host, port = self.node.tcp_address
         env["RAY_TPU_ADDRESS"] = f"tcp://{host}:{port}"
         env["RAY_TPU_AUTHKEY"] = self.node.authkey.hex()
@@ -63,8 +82,10 @@ class JobManager:
         # the entrypoint driver must resolve this framework regardless of
         # its cwd (the reference ships the working dir via runtime_env)
         pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-        env["PYTHONPATH"] = (pkg_root + os.pathsep + env["PYTHONPATH"]
-                             if env.get("PYTHONPATH") else pkg_root)
+        parts = module_paths + [pkg_root]
+        if env.get("PYTHONPATH"):
+            parts.append(env["PYTHONPATH"])
+        env["PYTHONPATH"] = os.pathsep.join(parts)
         info = JobInfo(job_id=job_id, entrypoint=entrypoint,
                        metadata=dict(metadata or {}), log_path=log_path)
         log_f = open(log_path, "wb")

@@ -92,17 +92,32 @@ def _runtime_env_key(runtime_env: Optional[dict]) -> Optional[str]:
 
 
 def _apply_runtime_env(env: Dict[str, str], runtime_env: Optional[dict]) -> Optional[str]:
-    """Fold env_vars into a worker's spawn env; returns the cwd override."""
+    """Fold env_vars into a worker's spawn env; returns the cwd override.
+
+    Package URIs (``gcs://pkg-…`` working_dir / py_modules, uploaded by
+    the driver) can't chdir at spawn — the worker materializes them
+    itself from ``RAY_TPU_RUNTIME_ENV`` right after it registers
+    (``runtime_env_packaging.apply_packages_in_worker``), which works
+    identically for head-local and agent-spawned remote workers."""
     if not runtime_env:
         return None
+    from ray_tpu._private.runtime_env_packaging import is_package_uri
+
     env.update(runtime_env.get("env_vars") or {})
-    return runtime_env.get("working_dir")
+    wd = runtime_env.get("working_dir")
+    if is_package_uri(wd) or runtime_env.get("py_modules"):
+        env["RAY_TPU_RUNTIME_ENV"] = json.dumps({
+            "working_dir": wd if is_package_uri(wd) else None,
+            "py_modules": runtime_env.get("py_modules"),
+        })
+    return wd if wd is not None and not is_package_uri(wd) else None
 
 
 def _worker_argv(runtime_env: Optional[dict]) -> List[str]:
     from ray_tpu._private.runtime_env_setup import worker_argv
 
-    return worker_argv((runtime_env or {}).get("pip"))
+    return worker_argv((runtime_env or {}).get("pip"),
+                       (runtime_env or {}).get("conda"))
 
 
 def _set_child_subreaper() -> bool:
@@ -1200,7 +1215,9 @@ class Node:
         # plain workers fork from the warm template (~20ms vs a ~2s cold
         # CPython boot); pip runtime_envs need the venv's interpreter, so
         # they (and any forkserver failure) take the classic Popen path
-        if self._forkserver is not None and not (runtime_env or {}).get("pip"):
+        if self._forkserver is not None and not (
+                (runtime_env or {}).get("pip")
+                or (runtime_env or {}).get("conda")):
             proc = self._forkserver.spawn(env, cwd)
             if proc is not None:
                 return proc
@@ -1222,7 +1239,8 @@ class Node:
             env, cwd = self._remote_env_overrides(worker_id, runtime_env, extra_env)
             ns.agent_send({"type": "spawn_worker", "worker_id": worker_id.hex(),
                            "env_overrides": env, "cwd": cwd,
-                           "pip": (runtime_env or {}).get("pip")})
+                           "pip": (runtime_env or {}).get("pip"),
+                           "conda": (runtime_env or {}).get("conda")})
             return None
         return self._spawn_worker_process(ns, worker_id, runtime_env, extra_env)
 

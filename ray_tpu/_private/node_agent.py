@@ -181,7 +181,8 @@ class NodeAgent:
         from ray_tpu._private.runtime_env_setup import worker_argv
 
         try:
-            proc = subprocess.Popen(worker_argv(msg.get("pip")), env=env, cwd=cwd)
+            proc = subprocess.Popen(
+                worker_argv(msg.get("pip"), msg.get("conda")), env=env, cwd=cwd)
         except OSError as e:
             self._send({"type": "worker_exited", "worker_id": wid,
                         "returncode": -1, "error": str(e)})

@@ -20,13 +20,17 @@ ACTOR_OPTIONS = {
     "max_concurrency",
 }
 
-# env_vars/working_dir apply at spawn; pip builds a hash-keyed cached venv
-# in the worker's bootstrap (``runtime_env_setup.py``; reference
-# ``python/ray/_private/runtime_env/pip.py``).  Anything else the
-# reference installs through its agent (conda/container/py_modules,
-# ``runtime_env/plugin.py``) is rejected loudly instead of silently
-# dropped.
-SUPPORTED_RUNTIME_ENV_KEYS = {"env_vars", "working_dir", "pip"}
+# env_vars/working_dir apply at spawn; pip/conda build hash-keyed cached
+# envs in the worker's bootstrap (``runtime_env_setup.py``; reference
+# ``python/ray/_private/runtime_env/{pip,conda}.py``); working_dir and
+# py_modules local paths are zipped into content-addressed ``gcs://``
+# packages shipped through the cluster KV
+# (``runtime_env_packaging.py``; reference ``runtime_env/packaging.py``).
+# ``container`` (podman rootless containers) is rejected loudly instead
+# of silently dropped — no container runtime in the TPU image.
+SUPPORTED_RUNTIME_ENV_KEYS = {
+    "env_vars", "working_dir", "pip", "conda", "py_modules", "excludes",
+}
 
 
 def validate_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -36,9 +40,12 @@ def validate_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict
         raise TypeError(f"runtime_env must be a dict, got {type(runtime_env)}")
     unsupported = set(runtime_env) - SUPPORTED_RUNTIME_ENV_KEYS
     if unsupported:
+        hint = (" ('container' needs a container runtime, absent from the "
+                "TPU image — use 'pip'/'conda' + 'py_modules' instead)"
+                if "container" in unsupported else "")
         raise ValueError(
             f"Unsupported runtime_env keys {sorted(unsupported)}; this build "
-            f"supports {sorted(SUPPORTED_RUNTIME_ENV_KEYS)}"
+            f"supports {sorted(SUPPORTED_RUNTIME_ENV_KEYS)}{hint}"
         )
     env_vars = runtime_env.get("env_vars")
     if env_vars is not None:
@@ -48,11 +55,45 @@ def validate_runtime_env(runtime_env: Optional[Dict[str, Any]]) -> Optional[Dict
             raise TypeError("runtime_env['env_vars'] must be a Dict[str, str]")
     working_dir = runtime_env.get("working_dir")
     if working_dir is not None:
-        if not isinstance(working_dir, str) or not os.path.isdir(working_dir):
+        ok = isinstance(working_dir, str) and (
+            working_dir.startswith("gcs://")  # already-packaged URI
+            or os.path.isdir(working_dir)
+            or (working_dir.endswith(".zip") and os.path.isfile(working_dir)))
+        if not ok:
             raise ValueError(
-                f"runtime_env['working_dir'] must be an existing local directory, "
+                f"runtime_env['working_dir'] must be an existing local "
+                f"directory, a .zip file, or a gcs:// package URI, "
                 f"got {working_dir!r}"
             )
+    py_modules = runtime_env.get("py_modules")
+    if py_modules is not None:
+        if not isinstance(py_modules, list):
+            raise TypeError("runtime_env['py_modules'] must be a list of "
+                            "local dirs / .zip files / gcs:// URIs")
+        for m in py_modules:
+            ok = isinstance(m, str) and (
+                m.startswith("gcs://") or os.path.isdir(m)
+                or (m.endswith(".zip") and os.path.isfile(m)))
+            if not ok:
+                raise ValueError(
+                    f"runtime_env['py_modules'] entry {m!r} is not an "
+                    f"existing local directory, .zip file, or gcs:// URI")
+    excludes = runtime_env.get("excludes")
+    if excludes is not None and not (
+            isinstance(excludes, list)
+            and all(isinstance(e, str) for e in excludes)):
+        raise TypeError("runtime_env['excludes'] must be List[str] of "
+                        "glob patterns")
+    conda = runtime_env.get("conda")
+    if conda is not None:
+        if runtime_env.get("pip"):
+            raise ValueError(
+                "runtime_env cannot specify both 'pip' and 'conda' "
+                "(build one env: put pip packages under the conda spec)")
+        if not isinstance(conda, (str, dict)):
+            raise TypeError(
+                "runtime_env['conda'] must be an env NAME (str) or an "
+                "environment.yml dict")
     pip = runtime_env.get("pip")
     if pip is not None:
         # list of requirements, or {"packages": [...], "pip_install_options":

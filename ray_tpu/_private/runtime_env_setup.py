@@ -83,31 +83,115 @@ def ensure_pip_env(pip: PipSpec, base_dir: str = DEFAULT_BASE_DIR) -> Tuple[str,
             fcntl.flock(lock, fcntl.LOCK_UN)
 
 
-def worker_argv(pip: Union[PipSpec, None]) -> List[str]:
+# ---------------------------------------------------------------------------
+# conda (reference python/ray/_private/runtime_env/conda.py)
+
+CondaSpec = Union[str, Dict[str, Any]]
+
+
+def conda_env_key(conda: CondaSpec) -> str:
+    blob = json.dumps(conda, sort_keys=True).encode()
+    return hashlib.sha1(blob).hexdigest()[:16]
+
+
+def _conda_exe() -> str:
+    import shutil as _shutil
+
+    exe = os.environ.get("RAY_TPU_CONDA_EXE") or _shutil.which("conda")
+    if not exe:
+        raise RuntimeError(
+            "runtime_env['conda'] requires a conda binary on this node's "
+            "PATH (or RAY_TPU_CONDA_EXE); none found. Use "
+            "runtime_env['pip'] for venv-based isolation instead.")
+    return exe
+
+
+def ensure_conda_env(conda: CondaSpec,
+                     base_dir: str = DEFAULT_BASE_DIR) -> Tuple[str, bool]:
+    """Resolve (or create) the conda env for ``conda``; returns
+    ``(python_exe, created)``.
+
+    - str: the NAME of a pre-existing conda env — resolved, never built
+      (the reference's named-env path).
+    - dict: an environment.yml body — materialized under a hash-keyed
+      prefix exactly once per node, flock-serialized like the pip cache.
+    """
+    exe = _conda_exe()
+    if isinstance(conda, str):
+        proc = subprocess.run(
+            [exe, "run", "-n", conda, "python", "-c",
+             "import sys; print(sys.executable)"],
+            capture_output=True, text=True, timeout=120,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"conda env {conda!r} not usable:\n{proc.stderr[-2000:]}")
+        return proc.stdout.strip().splitlines()[-1], False
+    key = conda_env_key(conda)
+    prefix = os.path.join(base_dir, f"conda-{key}")
+    python = os.path.join(prefix, "bin", "python")
+    ready = os.path.join(base_dir, f"conda-{key}.ready")
+    if os.path.exists(ready):
+        return python, False
+    os.makedirs(base_dir, exist_ok=True)
+    with open(os.path.join(base_dir, f"conda-{key}.lock"), "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if os.path.exists(ready):
+                return python, False
+            spec_path = os.path.join(base_dir, f"conda-{key}.yml")
+            with open(spec_path, "w") as f:
+                json.dump(conda, f)  # YAML is a JSON superset
+            proc = subprocess.run(
+                [exe, "env", "create", "--prefix", prefix, "--file",
+                 spec_path, "--yes"],
+                capture_output=True, text=True, timeout=1800,
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"conda env create failed:\n{proc.stderr[-2000:]}")
+            open(ready, "w").close()
+            return python, True
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def worker_argv(pip: Union[PipSpec, None],
+                conda: Union[CondaSpec, None] = None) -> List[str]:
     """Worker process argv — shared by the head and node agents so local
-    and remote spawns can never drift.  A pip spec boots through this
-    module's shim (venv build in the worker process), which then execs the
-    venv's python into the normal entrypoint."""
+    and remote spawns can never drift.  A pip/conda spec boots through
+    this module's shim (env build in the worker process), which then
+    execs that env's python into the normal entrypoint."""
     if pip:
         return [sys.executable, "-m", "ray_tpu._private.runtime_env_setup",
                 "--pip-spec", json.dumps(pip)]
+    if conda:
+        return [sys.executable, "-m", "ray_tpu._private.runtime_env_setup",
+                "--conda-spec", json.dumps(conda)]
     return [sys.executable, "-m", "ray_tpu._private.worker"]
 
 
 def main() -> None:
-    """Worker bootstrap: materialize the env, then exec the venv's python
-    into the worker entrypoint (argv after ``--``)."""
+    """Worker bootstrap: materialize the env, then exec the env's python
+    into the worker entrypoint."""
     import argparse
 
     p = argparse.ArgumentParser()
-    p.add_argument("--pip-spec", required=True, help="JSON pip spec")
+    p.add_argument("--pip-spec", help="JSON pip spec")
+    p.add_argument("--conda-spec", help="JSON conda spec (name or env dict)")
     p.add_argument("--base-dir", default=DEFAULT_BASE_DIR)
     args = p.parse_args()
     try:
-        python, _created = ensure_pip_env(
-            json.loads(args.pip_spec), base_dir=args.base_dir)
+        if args.pip_spec:
+            python, _ = ensure_pip_env(
+                json.loads(args.pip_spec), base_dir=args.base_dir)
+        elif args.conda_spec:
+            python, _ = ensure_conda_env(
+                json.loads(args.conda_spec), base_dir=args.base_dir)
+        else:
+            raise ValueError("one of --pip-spec/--conda-spec is required")
     except Exception as e:  # noqa: BLE001 — the exit code IS the signal
-        print(f"runtime_env pip setup failed: {e}", file=sys.stderr)
+        print(f"runtime_env setup failed: {e}", file=sys.stderr)
         raise SystemExit(77)
     os.execv(python, [python, "-m", "ray_tpu._private.worker"])
 

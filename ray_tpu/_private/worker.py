@@ -17,6 +17,7 @@ import hashlib
 import inspect
 import os
 import queue
+import sys
 import threading
 import time
 import traceback
@@ -62,6 +63,9 @@ class Worker:
         self.worker_id: bytes = b""
         self.function_cache: Dict[bytes, Any] = {}
         self.registered_fn_ids: set = set()
+        # runtime_env package uploads are once per unique env per driver
+        # (content addressing dedups across drivers at the KV)
+        self._prepared_envs: Dict[str, dict] = {}
         self.current_task_id: Optional[bytes] = None
         self.current_actor_id: Optional[bytes] = None
         self.actor_instance: Any = None
@@ -309,6 +313,20 @@ class Worker:
         release_cpu_after_start: bool = False,
     ) -> Tuple[dict, List[ObjectRef]]:
         cfg = get_config()
+        if runtime_env and (runtime_env.get("working_dir")
+                            or runtime_env.get("py_modules")):
+            import json as _json
+
+            from ray_tpu._private.runtime_env_packaging import (
+                prepare_runtime_env,
+            )
+
+            ck = _json.dumps(runtime_env, sort_keys=True)
+            prepared = self._prepared_envs.get(ck)
+            if prepared is None:
+                prepared = prepare_runtime_env(runtime_env, self.client)
+                self._prepared_envs[ck] = prepared
+            runtime_env = prepared
         dep_ids: List[bytes] = []
 
         def _convert(v):
@@ -745,12 +763,32 @@ def main() -> None:
         client = CoreClient(address, authkey, worker_id=worker_id, node_id=node_id)
         client._exec_queue = queue.Queue()
         w.client = client
-        client.register_worker()
     except (OSError, EOFError, AuthenticationError):
         # our head died while we were booting (connect refused / reset) or
         # we're a straggler from a killed session whose port got reused
         # (authkey mismatch): exit quietly — a traceback on the inherited
         # stderr reads like a live-session failure
+        os._exit(0)
+
+    # materialize package URIs (working_dir chdir / py_modules sys.path)
+    # BEFORE registering: a persistently failing package then dies
+    # pre-registration, which is what the spawn-failure circuit breaker
+    # counts — registering first would reset the breaker every respawn
+    # and loop forever (the same pre-registration invariant the pip
+    # bootstrap shim keeps by exiting 77 before exec)
+    try:
+        from ray_tpu._private.runtime_env_packaging import (
+            apply_packages_in_worker,
+        )
+
+        apply_packages_in_worker(client)
+    except Exception as e:  # noqa: BLE001
+        print(f"runtime_env package setup failed: {e}", file=sys.stderr)
+        os._exit(77)
+
+    try:
+        client.register_worker()
+    except (OSError, EOFError, AuthenticationError):
         os._exit(0)
 
     # ad-hoc worker profiling: RAY_TPU_SAMPLE_PROFILE=/path/prefix dumps a

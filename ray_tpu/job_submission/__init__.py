@@ -48,6 +48,16 @@ class JobSubmissionClient:
     def submit_job(self, *, entrypoint: str, runtime_env: Optional[dict] = None,
                    job_id: Optional[str] = None,
                    metadata: Optional[Dict[str, str]] = None) -> str:
+        if runtime_env and (runtime_env.get("working_dir")
+                            or runtime_env.get("py_modules")):
+            # ship local code as content-addressed packages so the job
+            # driver runs inside it on the HEAD host (reference
+            # sdk.py upload_working_dir_if_needed)
+            from ray_tpu._private.runtime_env_packaging import (
+                prepare_runtime_env,
+            )
+
+            runtime_env = prepare_runtime_env(runtime_env, self._client)
         reply = self._client.request({
             "type": "submit_job", "entrypoint": entrypoint,
             "runtime_env": runtime_env, "job_id": job_id, "metadata": metadata,

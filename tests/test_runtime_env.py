@@ -24,14 +24,22 @@ def test_task_sees_env_vars(ray_start_regular):
 
 
 def test_task_sees_working_dir(ray_start_regular):
+    """working_dir is a SNAPSHOT (reference semantics): the tree is
+    packaged, shipped through the cluster KV, and the worker chdirs into
+    its extracted copy — relative reads work, later local edits don't
+    leak in."""
     wd = tempfile.mkdtemp(prefix="rtpu_wd_")
-    real_wd = os.path.realpath(wd)
+    with open(os.path.join(wd, "data.txt"), "w") as f:
+        f.write("snapshot-payload")
 
     @ray_tpu.remote(runtime_env={"working_dir": wd})
-    def read_cwd():
-        return os.path.realpath(os.getcwd())
+    def read_rel():
+        with open("data.txt") as f:
+            return f.read(), os.path.realpath(os.getcwd())
 
-    assert ray_tpu.get(read_cwd.remote(), timeout=60) == real_wd
+    content, cwd = ray_tpu.get(read_rel.remote(), timeout=60)
+    assert content == "snapshot-payload"
+    assert cwd != os.path.realpath(wd)  # the extracted copy, not the live dir
 
 
 def test_plain_task_not_polluted(ray_start_regular):
@@ -50,24 +58,27 @@ def test_plain_task_not_polluted(ray_start_regular):
 
 def test_actor_runtime_env(ray_start_regular):
     wd = tempfile.mkdtemp(prefix="rtpu_awd_")
+    with open(os.path.join(wd, "marker.txt"), "w") as f:
+        f.write("actor-snapshot")
 
     @ray_tpu.remote
     class EnvActor:
         def probe(self):
-            return os.environ.get("RTPU_ACTOR_FLAG"), os.path.realpath(os.getcwd())
+            with open("marker.txt") as f:
+                return os.environ.get("RTPU_ACTOR_FLAG"), f.read()
 
     a = EnvActor.options(
         runtime_env={"env_vars": {"RTPU_ACTOR_FLAG": "actorenv"},
                      "working_dir": wd}
     ).remote()
-    flag, cwd = ray_tpu.get(a.probe.remote(), timeout=60)
+    flag, content = ray_tpu.get(a.probe.remote(), timeout=60)
     assert flag == "actorenv"
-    assert cwd == os.path.realpath(wd)
+    assert content == "actor-snapshot"  # snapshot extracted on the worker
 
 
 def test_unsupported_runtime_env_key_errors(ray_start_regular):
-    with pytest.raises(ValueError, match="conda"):
-        @ray_tpu.remote(runtime_env={"conda": "myenv"})
+    with pytest.raises(ValueError, match="container"):
+        @ray_tpu.remote(runtime_env={"container": {"image": "x"}})
         def f():
             pass
 
@@ -104,9 +115,8 @@ def test_user_pythonpath_merged_not_clobbered(ray_start_regular):
 
 
 def test_unspawnable_env_surfaces_error(ray_start_regular):
-    """A runtime_env whose worker cannot even spawn (working_dir deleted
-    after validation) must raise, not defer the task forever (the
-    spawn-failure circuit breaker)."""
+    """A working_dir deleted between validation and submission must raise
+    a clear error at packaging time, not defer the task forever."""
     import shutil
 
     wd = tempfile.mkdtemp(prefix="rtpu_gone_")
@@ -115,8 +125,8 @@ def test_unspawnable_env_surfaces_error(ray_start_regular):
     def f():
         return 1
 
-    shutil.rmtree(wd)  # dies between validation and spawn
-    with pytest.raises(Exception, match="runtime_env|died|Worker"):
+    shutil.rmtree(wd)  # dies between validation and packaging
+    with pytest.raises(Exception, match="runtime_env|does not exist"):
         ray_tpu.get(f.remote(), timeout=120)
 
 
@@ -135,8 +145,8 @@ def test_actor_unspawnable_env_surfaces_error(ray_start_regular):
 
     handle = A.options(runtime_env={"working_dir": wd})
     shutil.rmtree(wd)
-    a = handle.remote()
-    with pytest.raises(Exception, match="spawn|died|Actor"):
+    with pytest.raises(Exception, match="spawn|died|Actor|does not exist"):
+        a = handle.remote()
         ray_tpu.get(a.ping.remote(), timeout=120)
 
     # the node is not drained: plain tasks still run
@@ -244,3 +254,197 @@ def test_pip_runtime_env_bad_package_fails(ray_start_regular):
 
     with pytest.raises(Exception, match="runtime_env|died|setup"):
         ray_tpu.get(doomed.remote(), timeout=300)
+
+
+# ---------------------------------------------------------------------------
+# round 5: URI packaging (py_modules / working_dir snapshots) + conda
+# (reference python/ray/_private/runtime_env/{packaging,py_modules,conda}.py)
+
+
+def _make_module_dir(tmp, name, magic):
+    mod = os.path.join(tmp, name)
+    os.makedirs(mod, exist_ok=True)
+    with open(os.path.join(mod, "__init__.py"), "w") as f:
+        f.write(f"MAGIC = {magic}\n")
+    return mod
+
+
+def test_py_modules_importable(ray_start_regular):
+    tmp = tempfile.mkdtemp(prefix="rtpu_pym_")
+    _make_module_dir(tmp, "rtpu_pymod_a", 7)
+    _make_module_dir(tmp, "rtpu_pymod_b", 8)
+
+    @ray_tpu.remote(runtime_env={"py_modules": [
+        os.path.join(tmp, "rtpu_pymod_a"), os.path.join(tmp, "rtpu_pymod_b"),
+    ]})
+    def use_modules():
+        import rtpu_pymod_a
+        import rtpu_pymod_b
+
+        return rtpu_pymod_a.MAGIC + rtpu_pymod_b.MAGIC
+
+    assert ray_tpu.get(use_modules.remote(), timeout=120) == 15
+
+
+def test_py_modules_snapshot_shipped_via_kv(ray_start_regular):
+    """The module tree travels as a content-addressed package through the
+    cluster KV — deleting the source dir after submission must not break
+    later tasks (the worker extracts from the KV, not the driver disk)."""
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="rtpu_pym_")
+    _make_module_dir(tmp, "rtpu_pymod_gone", 21)
+    env = {"py_modules": [os.path.join(tmp, "rtpu_pymod_gone")]}
+
+    @ray_tpu.remote(runtime_env=env)
+    def one():
+        import rtpu_pymod_gone
+
+        return rtpu_pymod_gone.MAGIC
+
+    assert ray_tpu.get(one.remote(), timeout=120) == 21
+
+    # the identical env resubmitted AFTER the source dir is gone hits the
+    # driver's prepared-env cache (no re-zip of a deleted tree) and the
+    # worker still serves it from the KV package
+    @ray_tpu.remote(runtime_env=env)
+    def two():
+        import rtpu_pymod_gone
+
+        return rtpu_pymod_gone.MAGIC * 2
+
+    shutil.rmtree(tmp)
+    assert ray_tpu.get(two.remote(), timeout=120) == 42
+
+
+def test_working_dir_excludes(ray_start_regular):
+    wd = tempfile.mkdtemp(prefix="rtpu_wdx_")
+    with open(os.path.join(wd, "keep.txt"), "w") as f:
+        f.write("k")
+    os.makedirs(os.path.join(wd, "big_data"))
+    with open(os.path.join(wd, "big_data", "blob.bin"), "w") as f:
+        f.write("x" * 1000)
+
+    @ray_tpu.remote(runtime_env={"working_dir": wd,
+                                 "excludes": ["big_data"]})
+    def listing():
+        return sorted(os.listdir("."))
+
+    names = ray_tpu.get(listing.remote(), timeout=120)
+    assert "keep.txt" in names and "big_data" not in names
+
+
+def test_packaging_determinism_and_cache(tmp_path):
+    from ray_tpu._private.runtime_env_packaging import (
+        ensure_package_local, package_uri, zip_directory,
+    )
+
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "a.py").write_text("A = 1\n")
+    (src / "__pycache__").mkdir()
+    (src / "__pycache__" / "junk.pyc").write_text("junk")
+
+    z1 = zip_directory(str(src), top_level=False)
+    z2 = zip_directory(str(src), top_level=False)
+    assert z1 == z2, "zips must be deterministic for content addressing"
+    assert package_uri(z1) == package_uri(z2)
+    import zipfile as _zf
+    import io as _io
+
+    assert _zf.ZipFile(_io.BytesIO(z1)).namelist() == ["a.py"]
+
+    calls = []
+
+    def fetch(uri):
+        calls.append(uri)
+        return z1
+
+    base = str(tmp_path / "cache")
+    d1 = ensure_package_local(fetch, package_uri(z1), base)
+    d2 = ensure_package_local(fetch, package_uri(z1), base)
+    assert d1 == d2 and len(calls) == 1, "second ensure must hit the cache"
+    assert (os.path.join(d1, "a.py"), open(os.path.join(d1, "a.py")).read()) \
+        == (os.path.join(d1, "a.py"), "A = 1\n")
+
+
+def test_package_size_limit(tmp_path, monkeypatch):
+    from ray_tpu._private import runtime_env_packaging as pkg
+
+    src = tmp_path / "big"
+    src.mkdir()
+    (src / "blob").write_bytes(b"x" * 4096)
+    monkeypatch.setattr(pkg, "_SIZE_LIMIT", 1024)
+    with pytest.raises(ValueError, match="exceeds"):
+        pkg.zip_directory(str(src), top_level=False)
+
+
+def test_conda_named_env_with_fake_binary(ray_start_regular, tmp_path,
+                                          monkeypatch):
+    """conda runtime_env resolves an env's python through the conda
+    binary; a fake conda proves the full spawn path without the real
+    tool (the image has none — the gcloud-provider test pattern)."""
+    import stat
+    import sys as _sys
+
+    fake = tmp_path / "conda"
+    # `conda run -n NAME python -c ...` -> print THIS interpreter, i.e.
+    # the "env" is the current python (the resolution contract is what we
+    # test; package isolation is pip's covered path)
+    fake.write_text(
+        "#!/bin/sh\n"
+        f"echo {_sys.executable}\n"
+    )
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("RAY_TPU_CONDA_EXE", str(fake))
+
+    @ray_tpu.remote(runtime_env={"conda": "base",
+                                 "env_vars": {"RAY_TPU_CONDA_EXE": str(fake)}})
+    def in_conda():
+        return os.environ.get("RAY_TPU_CONDA_EXE") is not None
+
+    assert ray_tpu.get(in_conda.remote(), timeout=120) is True
+
+
+def test_conda_missing_binary_fails_loudly(ray_start_regular, monkeypatch):
+    monkeypatch.delenv("RAY_TPU_CONDA_EXE", raising=False)
+    monkeypatch.setenv("PATH", "/nonexistent")
+
+    from ray_tpu._private.runtime_env_setup import ensure_conda_env
+
+    with pytest.raises(RuntimeError, match="conda binary"):
+        ensure_conda_env("whatever")
+
+
+def test_conda_plus_pip_rejected(ray_start_regular):
+    with pytest.raises(ValueError, match="both 'pip' and 'conda'"):
+        @ray_tpu.remote(runtime_env={"conda": "base", "pip": ["x"]})
+        def nope():
+            return 1
+
+
+def test_container_rejected_with_hint(ray_start_regular):
+    with pytest.raises(ValueError, match="container"):
+        @ray_tpu.remote(runtime_env={"container": {"image": "img"}})
+        def nope():
+            return 1
+
+
+def test_package_setup_failure_trips_breaker(ray_start_regular):
+    """A worker that cannot materialize its packages dies BEFORE
+    registration, so the spawn circuit breaker errors the task instead
+    of respawning forever (the pip-shim exit-77 invariant)."""
+    wd = tempfile.mkdtemp(prefix="rtpu_brk_")
+    with open(os.path.join(wd, "x.txt"), "w") as f:
+        f.write("x")
+
+    @ray_tpu.remote(runtime_env={
+        "working_dir": wd,
+        # unwritable package cache -> extraction fails in every respawn
+        "env_vars": {"RAY_TPU_RUNTIME_ENV_DIR": "/proc/nope"},
+    }, max_retries=0)
+    def doomed():
+        return 1
+
+    with pytest.raises(Exception, match="runtime_env|died|setup|spawn"):
+        ray_tpu.get(doomed.remote(), timeout=180)
