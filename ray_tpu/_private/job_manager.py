@@ -39,6 +39,21 @@ class JobManager:
         self.log_dir = os.path.join(node.session_dir, "jobs")
         os.makedirs(self.log_dir, exist_ok=True)
 
+    def _fail_pre_launch(self, job_id: str, entrypoint: str, log_path: str,
+                         message: str) -> str:
+        """Record a job that failed before its process launched."""
+        info = JobInfo(job_id=job_id, entrypoint=entrypoint,
+                       log_path=log_path, status="FAILED",
+                       end_time=time.time())
+        with self.lock:
+            self.jobs[job_id] = info
+        try:
+            with open(log_path, "w") as f:
+                f.write(message + "\n")
+        except OSError:
+            pass
+        return job_id
+
     def submit(self, entrypoint: str, runtime_env: Optional[dict] = None,
                job_id: Optional[str] = None,
                metadata: Optional[Dict[str, str]] = None) -> str:
@@ -54,6 +69,7 @@ class JobManager:
         env = dict(os.environ)
         cwd = None
         module_paths: list = []
+        materialized: list = []  # package dirs pinned by THIS process
         if runtime_env:
             from ray_tpu._private.runtime_env_packaging import (
                 PKG_KV_NAMESPACE, ensure_package_local, is_package_uri,
@@ -62,19 +78,33 @@ class JobManager:
             def materialize(uri: str) -> str:
                 # a remote submitter uploaded local code as content-
                 # addressed packages; extract from the head's own KV
-                return ensure_package_local(
+                d = ensure_package_local(
                     lambda u: self.node.gcs.kv_get(
                         PKG_KV_NAMESPACE, u.encode()), uri)
+                materialized.append(d)
+                return d
 
-            env.update(runtime_env.get("env_vars") or {})
-            cwd = runtime_env.get("working_dir")
-            if is_package_uri(cwd):
-                cwd = materialize(cwd)
-            # py_modules go on the DRIVER's PYTHONPATH (the reference
-            # installs them through the agent before the driver starts)
-            for m in runtime_env.get("py_modules") or []:
-                module_paths.append(materialize(m) if is_package_uri(m)
-                                    else m)
+            try:
+                env.update(runtime_env.get("env_vars") or {})
+                cwd = runtime_env.get("working_dir")
+                if is_package_uri(cwd):
+                    cwd = materialize(cwd)
+                # py_modules go on the DRIVER's PYTHONPATH (the reference
+                # installs them through the agent before the driver starts)
+                for m in runtime_env.get("py_modules") or []:
+                    module_paths.append(materialize(m) if is_package_uri(m)
+                                        else m)
+            except Exception as e:  # noqa: BLE001 — a bad/missing package
+                # fails THIS job with a readable log, never the reader
+                # loop (that would close the submitter's connection and
+                # leak the reserved job id)
+                from ray_tpu._private.runtime_env_packaging import unpin
+
+                for d in materialized:
+                    unpin(d)
+                return self._fail_pre_launch(
+                    job_id, entrypoint, log_path,
+                    f"runtime_env package setup failed: {e}")
         host, port = self.node.tcp_address
         env["RAY_TPU_ADDRESS"] = f"tcp://{host}:{port}"
         env["RAY_TPU_AUTHKEY"] = self.node.authkey.hex()
@@ -97,16 +127,23 @@ class JobManager:
             )
         except OSError as e:
             log_f.close()
-            info.status = "FAILED"
-            info.end_time = time.time()
-            with self.lock:
-                self.jobs[job_id] = info
-            with open(log_path, "w") as f:
-                f.write(f"failed to launch: {e}\n")
-            return job_id
+            from ray_tpu._private.runtime_env_packaging import unpin
+
+            for d in materialized:
+                unpin(d)
+            return self._fail_pre_launch(job_id, entrypoint, log_path,
+                                         f"failed to launch: {e}")
         finally:
             if not log_f.closed:
                 log_f.close()
+        # the packages now belong to the job process: transfer the head's
+        # pins so the cache can evict them once the job exits (a
+        # long-lived head must not pin every job's code forever)
+        if materialized:
+            from ray_tpu._private.runtime_env_packaging import repin
+
+            for d in materialized:
+                repin(d, proc.pid)
         info.status = "RUNNING"
         with self.lock:
             self.jobs[job_id] = info

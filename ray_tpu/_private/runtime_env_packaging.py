@@ -138,13 +138,29 @@ def upload_package_if_needed(client, path_or_zip: str, *, top_level: bool,
     return uri
 
 
-def _pin(dest: str) -> None:
-    """Mark ``dest`` in use by this process.  GC skips packages with any
-    live pin, so a long-lived worker's cwd/sys.path entry can't be
-    evicted out from under it.  Pins are pid-named: a dead process's pin
-    is ignored (checked against /proc)."""
+def _pin(dest: str, pid: Optional[int] = None) -> None:
+    """Mark ``dest`` in use by ``pid`` (default: this process).  GC
+    skips packages with any live pin, so a long-lived worker's
+    cwd/sys.path entry can't be evicted out from under it.  Pins are
+    pid-named: a dead process's pin is ignored (checked against
+    /proc)."""
     try:
-        open(os.path.join(dest, f".pin-{os.getpid()}"), "w").close()
+        open(os.path.join(dest, f".pin-{pid or os.getpid()}"), "w").close()
+    except OSError:
+        pass
+
+
+def repin(dest: str, pid: int) -> None:
+    """Transfer this process's pin to ``pid`` — used by the head after
+    launching a job driver whose cwd/PYTHONPATH is the package: the
+    package then lives exactly as long as the job process."""
+    _pin(dest, pid)
+    unpin(dest)
+
+
+def unpin(dest: str, pid: Optional[int] = None) -> None:
+    try:
+        os.unlink(os.path.join(dest, f".pin-{pid or os.getpid()}"))
     except OSError:
         pass
 
@@ -157,20 +173,17 @@ def ensure_package_local(fetch: Callable[[str], Optional[bytes]], uri: str,
     name = uri[len(PKG_URI_PREFIX):].removesuffix(".zip")
     dest = os.path.join(base_dir, name)
     ready = os.path.join(dest, ".ready")
-    if os.path.exists(ready):
-        # pin FIRST, then re-verify: a concurrent GC that beat the pin
-        # shows up as the marker vanishing, and we fall through to the
-        # locked (re)extract below
-        _pin(dest)
-        if os.path.exists(ready):
-            os.utime(ready)  # LRU touch
-            return dest
     os.makedirs(base_dir, exist_ok=True)
+    # pin + check happen UNDER the per-package flock — GC deletes under
+    # the same lock after re-verifying pins, so a package can never
+    # vanish between this check and a consumer using it.  ensure runs
+    # once per worker boot; the serialization is noise next to spawn.
     with open(os.path.join(base_dir, f"{name}.lock"), "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)
         try:
             if os.path.exists(ready):
                 _pin(dest)
+                os.utime(ready)  # LRU touch
                 return dest
             blob = fetch(uri)
             if blob is None:
@@ -180,9 +193,19 @@ def ensure_package_local(fetch: Callable[[str], Optional[bytes]], uri: str,
             shutil.rmtree(dest, ignore_errors=True)  # partial extract
             with zipfile.ZipFile(io.BytesIO(blob)) as zf:
                 zf.extractall(dest)
+                # zipfile.extractall drops external_attr: restore modes
+                # so executables keep their exec bit on the worker
+                for info in zf.infolist():
+                    mode = (info.external_attr >> 16) & 0o777
+                    if mode:
+                        try:
+                            os.chmod(os.path.join(dest, info.filename), mode)
+                        except OSError:
+                            pass
             os.makedirs(dest, exist_ok=True)  # empty package: no entries
             _pin(dest)
-            open(ready, "w").close()
+            with open(ready, "w") as f:
+                f.write(str(len(blob)))  # sized for cheap GC accounting
         finally:
             fcntl.flock(lock, fcntl.LOCK_UN)
     _gc_cache(base_dir)
@@ -222,10 +245,13 @@ def _gc_cache(base_dir: str, limit: int = 0) -> None:
             ready = os.path.join(full, ".ready")
             if not os.path.exists(ready):
                 continue
-            size = sum(
-                os.path.getsize(os.path.join(r, f))
-                for r, _, fs in os.walk(full) for f in fs
-                if os.path.isfile(os.path.join(r, f)))
+            try:  # extract-time size lives in .ready — no tree walk
+                size = int(open(ready).read() or 0)
+            except (OSError, ValueError):
+                size = sum(
+                    os.path.getsize(os.path.join(r, f))
+                    for r, _, fs in os.walk(full) for f in fs
+                    if os.path.isfile(os.path.join(r, f)))
             total += size
             if _is_pinned(full):
                 continue
@@ -233,7 +259,23 @@ def _gc_cache(base_dir: str, limit: int = 0) -> None:
         cands.sort()
         while total > limit and cands:
             _, victim, size = cands.pop(0)
-            shutil.rmtree(victim, ignore_errors=True)
+            # take the same per-package flock ensure_package_local holds
+            # and RE-verify pins under it: a worker on the fast path pins
+            # then re-checks .ready, so deleting only unpinned packages
+            # while holding the lock closes the pin/scan race
+            lock_path = os.path.join(base_dir,
+                                     os.path.basename(victim) + ".lock")
+            try:
+                with open(lock_path, "w") as lock:
+                    fcntl.flock(lock, fcntl.LOCK_EX)
+                    try:
+                        if _is_pinned(victim):
+                            continue
+                        shutil.rmtree(victim, ignore_errors=True)
+                    finally:
+                        fcntl.flock(lock, fcntl.LOCK_UN)
+            except OSError:
+                continue
             total -= size
     except OSError:
         pass  # cache GC is best-effort

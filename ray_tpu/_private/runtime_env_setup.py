@@ -142,6 +142,11 @@ def ensure_conda_env(conda: CondaSpec,
             spec_path = os.path.join(base_dir, f"conda-{key}.yml")
             with open(spec_path, "w") as f:
                 json.dump(conda, f)  # YAML is a JSON superset
+            # a prior failed create leaves a partial prefix that conda
+            # refuses to reuse — clear it (EnvBuilder(clear=True) analog)
+            import shutil
+
+            shutil.rmtree(prefix, ignore_errors=True)
             proc = subprocess.run(
                 [exe, "env", "create", "--prefix", prefix, "--file",
                  spec_path, "--yes"],

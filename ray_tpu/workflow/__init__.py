@@ -18,14 +18,31 @@ resumes from the last completed step instead of starting over.
 """
 
 from ray_tpu.workflow.api import (
+    EventListener,
+    TimerListener,
+    WorkflowCancellationError,
+    WorkflowError,
+    WorkflowExecutionError,
+    cancel,
+    continuation,
     delete,
+    get_metadata,
     get_output,
     get_status,
     list_all,
+    options,
     resume,
+    resume_all,
     run,
     run_async,
+    sleep,
+    wait_for_event,
 )
 
-__all__ = ["run", "run_async", "resume", "get_status", "get_output",
-           "list_all", "delete"]
+__all__ = [
+    "run", "run_async", "resume", "resume_all", "get_status", "get_output",
+    "get_metadata", "list_all", "delete", "cancel", "options",
+    "continuation", "sleep", "wait_for_event", "EventListener",
+    "TimerListener", "WorkflowError", "WorkflowExecutionError",
+    "WorkflowCancellationError",
+]

@@ -142,3 +142,185 @@ def test_workflow_rejects_actor_nodes(ray_start_regular):
 
     with pytest.raises(TypeError, match="task DAGs"):
         workflow.run(A.bind().go.bind(), workflow_id="bad")
+
+
+# ---------------------------------------------------------------------------
+# round 5: continuations, per-step options, events, cancel, metadata
+# (reference workflow/api.py continuation/options/wait_for_event/cancel)
+
+
+def test_workflow_continuation(ray_start_regular, tmp_path, monkeypatch):
+    """A step returning a DAG continues the workflow with it; sub-steps
+    checkpoint under the parent step's id (recursive factorial, the
+    reference's canonical continuation shape)."""
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path))
+
+    @ray_tpu.remote
+    def fact(n, acc=1):
+        if n <= 1:
+            return acc
+        return workflow.continuation(fact.bind(n - 1, acc * n))
+
+    assert workflow.run(fact.bind(5), workflow_id="fact5") == 120
+    assert workflow.get_status("fact5") == "SUCCEEDED"
+
+
+def test_workflow_step_retries(ray_start_regular, tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path))
+    marker = tmp_path / "attempts"
+
+    @workflow.options(max_retries=3)
+    @ray_tpu.remote
+    def flaky():
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        if n < 2:
+            raise RuntimeError(f"boom {n}")
+        return "ok"
+
+    assert workflow.run(flaky.bind(), workflow_id="retry-flow") == "ok"
+    assert int(marker.read_text()) == 3  # 2 failures + 1 success
+    meta = workflow.get_metadata("retry-flow")
+    step = next(iter(meta["steps"].values()))
+    assert step["status"] == "SUCCEEDED" and step["attempt"] == 2
+
+
+def test_workflow_catch_exceptions(ray_start_regular, tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path))
+
+    @workflow.options(catch_exceptions=True)
+    @ray_tpu.remote
+    def doomed():
+        raise ValueError("expected-failure")
+
+    @ray_tpu.remote
+    def handle(pair):
+        value, err = pair
+        return "handled" if err is not None else value
+
+    out = workflow.run(handle.bind(doomed.bind()), workflow_id="catch-flow")
+    assert out == "handled"
+    assert workflow.get_status("catch-flow") == "SUCCEEDED"
+
+
+def test_workflow_sleep_checkpoints_wakeup(ray_start_regular, tmp_path,
+                                           monkeypatch):
+    """workflow.sleep resolves after the duration; the wake TIME is
+    checkpointed so resume doesn't restart the clock."""
+    import time as _time
+
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path))
+
+    @ray_tpu.remote
+    def after(end_time):
+        return _time.time() >= end_time - 0.05
+
+    t0 = _time.time()
+    assert workflow.run(after.bind(workflow.sleep(1.0)),
+                        workflow_id="sleepy") is True
+    assert _time.time() - t0 >= 0.9
+
+
+def test_workflow_custom_event_listener(ray_start_regular, tmp_path,
+                                        monkeypatch):
+    """A file-based EventListener: the workflow blocks until the event
+    appears, then the commit step runs (checkpointed consumption)."""
+    import threading as _threading
+    import time as _time
+
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path))
+    event_file = tmp_path / "evt.txt"
+    ack_file = tmp_path / "ack.txt"
+
+    class FileListener(workflow.EventListener):
+        async def poll_for_event(self, path):
+            import asyncio
+            import os as _os
+
+            while not _os.path.exists(path):
+                await asyncio.sleep(0.05)
+            with open(path) as f:
+                return f.read()
+
+        async def event_checkpointed(self, event):
+            with open(str(ack_file), "w") as f:
+                f.write(event)
+
+    @ray_tpu.remote
+    def consume(evt):
+        return f"got:{evt}"
+
+    def fire():
+        _time.sleep(1.0)
+        event_file.write_text("payload-7")
+
+    _threading.Thread(target=fire, daemon=True).start()
+    dag = consume.bind(
+        workflow.wait_for_event(FileListener, str(event_file)))
+    assert workflow.run(dag, workflow_id="evt-flow",
+                        ) == "got:payload-7"
+    assert ack_file.read_text() == "payload-7"
+
+
+def test_workflow_cancel(ray_start_regular, tmp_path, monkeypatch):
+    import time as _time
+
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path))
+
+    @ray_tpu.remote
+    def forever():
+        _time.sleep(600)
+        return 1
+
+    h = workflow.run_async(forever.bind(), workflow_id="cancel-flow")
+    deadline = _time.time() + 60
+    while workflow.get_status("cancel-flow") != "RUNNING" \
+            and _time.time() < deadline:
+        _time.sleep(0.05)
+    _time.sleep(0.5)  # let the step task actually submit
+    workflow.cancel("cancel-flow")
+    with pytest.raises(Exception):
+        h.result(timeout=120)
+    assert workflow.get_status("cancel-flow") == "CANCELED"
+
+
+def test_workflow_resume_all(ray_start_regular, tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path / "wf"))
+    gate = tmp_path / "gate"
+
+    @ray_tpu.remote
+    def needs_gate():
+        if not gate.exists():
+            raise RuntimeError("gate closed")
+        return "opened"
+
+    with pytest.raises(Exception):
+        workflow.run(needs_gate.bind(), workflow_id="gated")
+    assert workflow.get_status("gated") == "FAILED"
+
+    gate.write_text("x")
+    results = workflow.resume_all(include_failed=True)
+    assert [wid for wid, _ in results] == ["gated"]
+    assert results[0][1].result(timeout=120) == "opened"
+    assert workflow.get_status("gated") == "SUCCEEDED"
+
+
+def test_workflow_options_validation(ray_start_regular):
+    with pytest.raises(ValueError, match="unknown workflow options"):
+        workflow.options(bogus=1)
+
+
+def test_workflow_cancel_unknown_and_terminal(ray_start_regular, tmp_path,
+                                              monkeypatch):
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path))
+    with pytest.raises(ValueError, match="no workflow"):
+        workflow.cancel("never-existed")
+    assert workflow.list_all() == []  # no phantom dir fabricated
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    workflow.run(one.bind(), workflow_id="done-flow")
+    workflow.cancel("done-flow")  # no-op, never downgrades terminal status
+    assert workflow.get_status("done-flow") == "SUCCEEDED"
