@@ -80,7 +80,8 @@ class JobManager:
                 # addressed packages; extract from the head's own KV
                 d = ensure_package_local(
                     lambda u: self.node.gcs.kv_get(
-                        PKG_KV_NAMESPACE, u.encode()), uri)
+                        PKG_KV_NAMESPACE, u.encode()), uri,
+                    pin_suffix=job_id)
                 materialized.append(d)
                 return d
 
@@ -101,7 +102,7 @@ class JobManager:
                 from ray_tpu._private.runtime_env_packaging import unpin
 
                 for d in materialized:
-                    unpin(d)
+                    unpin(d, suffix=job_id)
                 return self._fail_pre_launch(
                     job_id, entrypoint, log_path,
                     f"runtime_env package setup failed: {e}")
@@ -130,7 +131,7 @@ class JobManager:
             from ray_tpu._private.runtime_env_packaging import unpin
 
             for d in materialized:
-                unpin(d)
+                unpin(d, suffix=job_id)
             return self._fail_pre_launch(job_id, entrypoint, log_path,
                                          f"failed to launch: {e}")
         finally:
@@ -143,7 +144,7 @@ class JobManager:
             from ray_tpu._private.runtime_env_packaging import repin
 
             for d in materialized:
-                repin(d, proc.pid)
+                repin(d, proc.pid, suffix=job_id)
         info.status = "RUNNING"
         with self.lock:
             self.jobs[job_id] = info

@@ -1209,6 +1209,8 @@ class Node:
         env["RAY_TPU_NODE_ID"] = ns.node_id
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_WORKER_LOG"] = os.path.join(
+            self.session_dir, "logs", f"worker-{worker_id.hex()}.log")
         if extra_env:
             env.update(extra_env)
         env["PYTHONPATH"] = _worker_pythonpath(env.get("PYTHONPATH", ""))
@@ -1257,6 +1259,11 @@ class Node:
         env["RAY_TPU_AUTHKEY"] = self.authkey.hex()
         env["RAY_TPU_WORKER_ID"] = worker_id.hex()
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        # remote workers log under the AGENT host's session dir; the
+        # head's viewer shows local streams (per-node log agents are the
+        # reference's split too)
+        env["RAY_TPU_WORKER_LOG"] = os.path.join(
+            self.session_dir, "logs", f"worker-{worker_id.hex()}.log")
         if extra_env:
             env.update(extra_env)
         return env, cwd

@@ -138,38 +138,53 @@ def upload_package_if_needed(client, path_or_zip: str, *, top_level: bool,
     return uri
 
 
-def _pin(dest: str, pid: Optional[int] = None) -> None:
+def _pin_name(pid: Optional[int], suffix: Optional[str]) -> str:
+    # name shape: .pin-<pid>[-<suffix>] — the pid governs liveness; the
+    # suffix distinguishes concurrent consumers INSIDE one process (two
+    # job submits in the head sharing a package must not share one pin
+    # file, or the first unpin strips the other's protection)
+    name = f".pin-{pid or os.getpid()}"
+    return f"{name}-{suffix}" if suffix else name
+
+
+def _pin(dest: str, pid: Optional[int] = None,
+         suffix: Optional[str] = None) -> None:
     """Mark ``dest`` in use by ``pid`` (default: this process).  GC
     skips packages with any live pin, so a long-lived worker's
     cwd/sys.path entry can't be evicted out from under it.  Pins are
     pid-named: a dead process's pin is ignored (checked against
     /proc)."""
     try:
-        open(os.path.join(dest, f".pin-{pid or os.getpid()}"), "w").close()
+        open(os.path.join(dest, _pin_name(pid, suffix)), "w").close()
     except OSError:
         pass
 
 
-def repin(dest: str, pid: int) -> None:
-    """Transfer this process's pin to ``pid`` — used by the head after
-    launching a job driver whose cwd/PYTHONPATH is the package: the
-    package then lives exactly as long as the job process."""
+def repin(dest: str, pid: int, suffix: Optional[str] = None) -> None:
+    """Transfer this process's pin (``suffix``-scoped) to ``pid`` — used
+    by the head after launching a job driver whose cwd/PYTHONPATH is the
+    package: the package then lives exactly as long as the job
+    process."""
     _pin(dest, pid)
-    unpin(dest)
+    unpin(dest, suffix=suffix)
 
 
-def unpin(dest: str, pid: Optional[int] = None) -> None:
+def unpin(dest: str, pid: Optional[int] = None,
+          suffix: Optional[str] = None) -> None:
     try:
-        os.unlink(os.path.join(dest, f".pin-{pid or os.getpid()}"))
+        os.unlink(os.path.join(dest, _pin_name(pid, suffix)))
     except OSError:
         pass
 
 
 def ensure_package_local(fetch: Callable[[str], Optional[bytes]], uri: str,
-                         base_dir: str = DEFAULT_BASE_DIR) -> str:
+                         base_dir: str = DEFAULT_BASE_DIR, *,
+                         pin_suffix: Optional[str] = None) -> str:
     """Download + extract ``uri`` into the node-local cache; returns the
-    extracted directory, pinned for this process.  Safe under concurrent
-    workers (flock + .ready, the pip-venv cache pattern)."""
+    extracted directory, pinned for this process (``pin_suffix`` scopes
+    the pin when one process holds several concurrent consumers).  Safe
+    under concurrent workers (flock + .ready, the pip-venv cache
+    pattern)."""
     name = uri[len(PKG_URI_PREFIX):].removesuffix(".zip")
     dest = os.path.join(base_dir, name)
     ready = os.path.join(dest, ".ready")
@@ -182,7 +197,7 @@ def ensure_package_local(fetch: Callable[[str], Optional[bytes]], uri: str,
         fcntl.flock(lock, fcntl.LOCK_EX)
         try:
             if os.path.exists(ready):
-                _pin(dest)
+                _pin(dest, suffix=pin_suffix)
                 os.utime(ready)  # LRU touch
                 return dest
             blob = fetch(uri)
@@ -191,11 +206,13 @@ def ensure_package_local(fetch: Callable[[str], Optional[bytes]], uri: str,
                     f"runtime_env package {uri} not found in the cluster KV "
                     f"(head restarted since the driver uploaded it?)")
             shutil.rmtree(dest, ignore_errors=True)  # partial extract
+            extracted_size = 0
             with zipfile.ZipFile(io.BytesIO(blob)) as zf:
                 zf.extractall(dest)
                 # zipfile.extractall drops external_attr: restore modes
                 # so executables keep their exec bit on the worker
                 for info in zf.infolist():
+                    extracted_size += info.file_size
                     mode = (info.external_attr >> 16) & 0o777
                     if mode:
                         try:
@@ -203,9 +220,11 @@ def ensure_package_local(fetch: Callable[[str], Optional[bytes]], uri: str,
                         except OSError:
                             pass
             os.makedirs(dest, exist_ok=True)  # empty package: no entries
-            _pin(dest)
+            _pin(dest, suffix=pin_suffix)
             with open(ready, "w") as f:
-                f.write(str(len(blob)))  # sized for cheap GC accounting
+                # EXTRACTED size (what the cache cap governs), not the
+                # compressed blob size — cheap GC accounting
+                f.write(str(extracted_size))
         finally:
             fcntl.flock(lock, fcntl.LOCK_UN)
     _gc_cache(base_dir)
@@ -217,7 +236,7 @@ def _is_pinned(full: str) -> bool:
     try:
         for f in os.listdir(full):
             if f.startswith(".pin-"):
-                pid = f[len(".pin-"):]
+                pid = f[len(".pin-"):].split("-", 1)[0]
                 if pid.isdigit() and os.path.exists(f"/proc/{pid}"):
                     return True
                 try:  # stale pin from a dead process: clean it up

@@ -743,8 +743,32 @@ def _split_returns(out: Any, num_returns: int) -> List[Any]:
     return list(out)
 
 
+def _redirect_output_to_log() -> None:
+    """Tee this worker's stdout/stderr into its per-worker log file
+    (``RAY_TPU_WORKER_LOG``, set at spawn) so the dashboard log viewer
+    can show it (reference: per-worker log files under the session dir,
+    ``worker_setup_hook`` redirection).  dup2 at the fd level catches
+    subprocess and C-level writes too; self-redirection works for every
+    spawn path, including forkserver forks that inherit the template's
+    fds."""
+    path = os.environ.get("RAY_TPU_WORKER_LOG")
+    if not path:
+        return
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+        os.dup2(fd, 1)
+        os.dup2(fd, 2)
+        os.close(fd)
+        sys.stdout = os.fdopen(1, "w", buffering=1, closefd=False)
+        sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
+    except OSError:
+        pass  # logging must never block a worker boot
+
+
 def main() -> None:
     """Worker process entry point (python -m ray_tpu._private.worker)."""
+    _redirect_output_to_log()
     address = os.environ["RAY_TPU_ADDRESS"]
     authkey = bytes.fromhex(os.environ["RAY_TPU_AUTHKEY"])
     node_id = os.environ["RAY_TPU_NODE_ID"]

@@ -62,23 +62,71 @@ _INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
  th{background:#eef0f4;position:sticky;top:0} tr:hover td{background:#f3f6ff}
  .ALIVE,.RUNNING,.FINISHED,.true{color:#0a7d38}.DEAD,.FAILED,.false{color:#c0222b}
  #foot{color:#889;font-size:11px;padding:10px 14px}
+ #detail{position:fixed;top:0;right:0;width:46%;height:100%;background:#fff;
+   border-left:2px solid #1a1a2e;box-shadow:-4px 0 14px rgba(0,0,0,.15);
+   overflow:auto;padding:14px;display:none;z-index:5}
+ #detail pre{font-size:11px;white-space:pre-wrap;word-break:break-all}
+ #detail .x{float:right;cursor:pointer;border:none;background:#eee;
+   border-radius:5px;padding:3px 9px}
+ #logview{background:#10131c;color:#cfd6e4;font-family:monospace;
+   font-size:11px;padding:10px;border-radius:8px;white-space:pre-wrap;
+   max-height:70vh;overflow:auto}
+ tr.click{cursor:pointer}
 </style></head><body>
 <header><h1>ray_tpu</h1><span id="hdr"></span></header>
 <div id="cards"></div>
 <nav id="nav"></nav>
-<main><table id="tbl"><thead></thead><tbody></tbody></table></main>
-<div id="foot">auto-refresh 2s &middot; JSON API: /api/&lt;table&gt;,
- /api/cluster_status, /api/serve/applications,
+<main><table id="tbl"><thead></thead><tbody></tbody></table>
+<div id="logpane" style="display:none"><div id="streams"></div>
+<div id="logview"></div></div></main>
+<div id="detail"><button class="x" onclick="hideDetail()">close</button>
+<h3 id="dtitle"></h3><pre id="dbody"></pre></div>
+<div id="foot">auto-refresh 2s &middot; JSON API: /api/&lt;table&gt;[/&lt;id&gt;],
+ /api/cluster_status, /api/serve/applications, /api/logs[/&lt;stream&gt;],
+ <a href="/api/timeline">/api/timeline</a> (chrome://tracing),
  /api/profile?duration=3[&amp;worker_id=], /metrics</div>
 <script>
 const TABS=["nodes","actors","tasks","workers","objects","placement_groups",
-            "jobs","serve"];
-let tab="nodes";
+            "jobs","serve","logs"];
+const ID_FIELD={nodes:"node_id",actors:"actor_id",tasks:"task_id",
+ workers:"worker_id",placement_groups:"pg_id",jobs:"job_id"};
+let tab="nodes",timer=null;
 const nav=document.getElementById("nav");
 TABS.forEach(t=>{const b=document.createElement("button");b.textContent=t;
- b.onclick=()=>{tab=t;render()};nav.appendChild(b);});
+ b.onclick=()=>{tab=t;hideDetail();render()};nav.appendChild(b);});
 function cell(v){if(v===null)return"";if(typeof v==="object")
  return JSON.stringify(v);return String(v);}
+function hideDetail(){document.getElementById("detail").style.display="none";}
+async function showDetail(table,id){
+ const r=await fetch(`/api/${table}/${id}`);
+ if(!r.ok)return;
+ const d=await r.json();
+ document.getElementById("dtitle").textContent=`${table} ${id}`;
+ let html=JSON.stringify(d,null,2);
+ document.getElementById("dbody").textContent=html;
+ const panel=document.getElementById("detail");
+ panel.style.display="block";
+ if(d.log_stream){
+  const a=document.createElement("a");a.href=`/api/logs/${d.log_stream}`;
+  a.textContent="view log: "+d.log_stream;a.target="_blank";
+  document.getElementById("dtitle").appendChild(document.createElement("br"));
+  document.getElementById("dtitle").appendChild(a);
+ }
+}
+async function showLog(stream){
+ const r=await fetch(`/api/logs/${stream}?tail=500`);
+ document.getElementById("logview").textContent=
+  r.ok?await r.text():"(stream unavailable)";
+}
+async function renderLogs(){
+ document.getElementById("tbl").style.display="none";
+ const pane=document.getElementById("logpane");pane.style.display="block";
+ const streams=await (await fetch("/api/logs")).json();
+ document.getElementById("streams").innerHTML=streams.map(s=>
+  `<button onclick="showLog('${s.stream}')">${s.stream}
+   <small>(${s.kind}, ${Math.round(s.bytes/1024)}K)</small></button>`
+ ).join(" ")||"(no log streams yet)";
+}
 async function render(){
  [...nav.children].forEach(b=>b.classList.toggle("on",b.textContent===tab));
  try{
@@ -92,6 +140,9 @@ async function render(){
    ["store MB",Math.round((s.object_store.bytes_used??0)/1048576)]];
   document.getElementById("cards").innerHTML=cards.map(([k,v])=>
    `<div class=card><b>${v}</b><small>${k}</small></div>`).join("");
+  if(tab==="logs"){await renderLogs();return;}
+  document.getElementById("logpane").style.display="none";
+  document.getElementById("tbl").style.display="";
   const url=tab==="serve"?"/api/serve/applications":"/api/"+tab+"?limit=200";
   let rows=await (await fetch(url)).json();
   if(!Array.isArray(rows)){rows=Object.entries(rows||{}).map(([k,v])=>
@@ -102,11 +153,18 @@ async function render(){
    "<tr><td>(empty)</td></tr>";return;}
   const cols=Object.keys(rows[0]);
   thead.innerHTML="<tr>"+cols.map(c=>`<th>${c}</th>`).join("")+"</tr>";
-  tbody.innerHTML=rows.map(r=>"<tr>"+cols.map(c=>
-   `<td class="${cell(r[c])}">${cell(r[c])}</td>`).join("")+"</tr>").join("");
+  const idf=ID_FIELD[tab];
+  tbody.innerHTML=rows.map(r=>{
+   const id=idf?r[idf]:null;
+   const attrs=id?` class=click data-id="${id}"`:"";
+   return `<tr${attrs}>`+cols.map(c=>
+    `<td class="${cell(r[c])}">${cell(r[c])}</td>`).join("")+"</tr>";
+  }).join("");
+  if(idf)[...tbody.querySelectorAll("tr.click")].forEach(tr=>
+   tr.onclick=()=>showDetail(tab,tr.dataset.id));
  }catch(e){document.getElementById("hdr").textContent="error: "+e;}
 }
-render();setInterval(render,2000);
+render();timer=setInterval(render,2000);
 </script></body></html>"""
 
 
@@ -166,6 +224,17 @@ class Dashboard:
             duration = min(30.0, float(qs.get("duration", ["3"])[0]))
             wid = qs.get("worker_id", [None])[0]
             self._send(req, json.dumps(self._profile(wid, duration)))
+            return
+        if path.startswith("/api/logs/"):
+            # tail one log stream as plain text (reference log viewer:
+            # dashboard/modules/log)
+            tail = min(100_000, int(qs.get("tail", ["2000"])[0]))
+            text = self._log_tail(path[len("/api/logs/"):], tail)
+            if text is None:
+                req.send_response(404)
+                req.end_headers()
+                return
+            self._send(req, text, ctype="text/plain; charset=utf-8")
             return
         if path.startswith("/api/"):
             payload = self._api(path[len("/api/"):], limit)
@@ -239,6 +308,15 @@ class Dashboard:
             })
         if what == "serve/applications":
             return self._serve_status()
+        if what == "timeline":
+            # chrome-trace of task events (``ray_tpu timeline`` over HTTP;
+            # open in chrome://tracing / perfetto)
+            from ray_tpu.util.timeline import events_from_task_rows
+
+            return events_from_task_rows(
+                node._list_state("tasks", 100_000))
+        if what == "logs":
+            return self._log_streams()
         if what == "serve/config":
             # the declarative goal config last applied over PUT (empty if
             # serve is down or nothing was config-deployed)
@@ -251,11 +329,114 @@ class Dashboard:
                     controller.get_deploy_config.remote(), timeout=10) or {})
             except Exception:
                 return {}
+        if "/" in what:
+            # drill-down: /api/<table>/<id> -> full detail for one row
+            # (after every named serve/... route — must not shadow them)
+            table, _, key = what.partition("/")
+            return self._detail(table, key)
         try:
             # the state-API backend takes the right locks and strips blobs
             return _jsonable(node._list_state(what, limit))
         except ValueError:
             return None
+
+    # -- logs (reference dashboard/modules/log: per-worker files + job
+    # driver logs under the session dir) -----------------------------------
+    def _log_streams(self):
+        import os
+
+        node = self.node
+        streams = []
+        logs_dir = os.path.join(node.session_dir, "logs")
+        try:
+            for f in sorted(os.listdir(logs_dir)):
+                if f.endswith(".log"):
+                    full = os.path.join(logs_dir, f)
+                    streams.append({
+                        "stream": f[:-len(".log")], "kind": "worker",
+                        "bytes": os.path.getsize(full),
+                        "mtime": os.path.getmtime(full),
+                    })
+        except OSError:
+            pass
+        mgr = getattr(node, "job_manager", None)
+        if mgr is not None:
+            for info in mgr.list_jobs():
+                lp = info.get("log_path")
+                if lp and os.path.exists(lp):
+                    streams.append({
+                        "stream": f"job-{info['job_id']}", "kind": "job",
+                        "bytes": os.path.getsize(lp),
+                        "mtime": os.path.getmtime(lp),
+                    })
+        return streams
+
+    def _log_path(self, stream: str):
+        import os
+
+        node = self.node
+        if "/" in stream or ".." in stream:
+            return None  # path traversal
+        if stream.startswith("job-"):
+            mgr = getattr(node, "job_manager", None)
+            if mgr is not None:
+                for info in mgr.list_jobs():
+                    if f"job-{info['job_id']}" == stream:
+                        return info.get("log_path")
+            return None
+        path = os.path.join(node.session_dir, "logs", f"{stream}.log")
+        return path if os.path.exists(path) else None
+
+    def _log_tail(self, stream: str, tail_lines: int):
+        import os
+
+        path = self._log_path(stream)
+        if path is None:
+            return None
+        try:
+            size = os.path.getsize(path)
+            with open(path, "rb") as f:
+                # read at most ~200 bytes/line from the end, then trim
+                f.seek(max(0, size - tail_lines * 200))
+                data = f.read()
+        except OSError:
+            return None
+        lines = data.decode("utf-8", "replace").splitlines()
+        return "\n".join(lines[-tail_lines:])
+
+    # -- drill-down --------------------------------------------------------
+    def _detail(self, table: str, key: str):
+        """Everything about one task/actor/node/worker/pg/job — the row's
+        full record plus cross-references (its worker's log stream, an
+        actor's pending/running tasks) for the reference's detail pages
+        (dashboard/client src TaskDetail/ActorDetail)."""
+        node = self.node
+        try:
+            rows = node._list_state(table, 100_000)
+        except ValueError:
+            return None
+        id_fields = ("task_id", "actor_id", "node_id", "worker_id",
+                     "pg_id", "group_id", "job_id", "oid", "object_id")
+        match = None
+        for r in rows:
+            if any(str(r.get(f)) == key for f in id_fields if f in r):
+                match = dict(r)
+                break
+        if match is None:
+            return None
+        if table == "tasks":
+            wid = match.get("worker_id")
+            if wid:
+                match["log_stream"] = f"worker-{wid}"
+        elif table == "actors":
+            # the actor's tasks, newest first
+            aid = match.get("actor_id")
+            match["recent_tasks"] = [
+                t for t in node._list_state("tasks", 100_000)
+                if t.get("actor_id") == aid][-20:]
+        elif table == "workers":
+            match["log_stream"] = f"worker-{key}"
+        return _jsonable(match)
 
     def _route_put(self, req: BaseHTTPRequestHandler) -> None:
         path = urlparse(req.path).path.rstrip("/")

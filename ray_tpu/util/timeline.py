@@ -21,6 +21,13 @@ def timeline_events() -> List[dict]:
     tasks = global_worker.client.request(
         {"type": "list_state", "what": "tasks", "limit": 100_000}
     )["value"]
+    return events_from_task_rows(tasks)
+
+
+def events_from_task_rows(tasks: List[dict]) -> List[dict]:
+    """Render task-table rows as chrome-trace events.  Shared by the
+    driver CLI path above and the dashboard's ``/api/timeline`` (which
+    reads the head's table directly — no driver client there)."""
     events: List[dict] = []
     now = time.time()
     for t in tasks:

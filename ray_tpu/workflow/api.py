@@ -314,7 +314,10 @@ def _execute_durably(dag: DAGNode, storage: WorkflowStorage,
         kwargs = {k: node._resolve(v, results)
                   for k, v in node._bound_kwargs.items()}
         wopts = _wopts(node)
-        if wopts:
+        if wopts.get("max_retries") or wopts.get("catch_exceptions"):
+            # ONLY these two force a synchronization point (an
+            # error-as-data value must not flow downstream as a raising
+            # ObjectRef); name/checkpoint options keep the eager path
             value = _run_step_sync(node, args, kwargs, storage, sid,
                                    workflow_id, depth)
             if wopts.get("checkpoint", True):
@@ -339,7 +342,8 @@ def _execute_durably(dag: DAGNode, storage: WorkflowStorage,
                                              end=time.time(),
                                              error=str(e)[:500]))
             raise
-        storage.save_step(sid, value)
+        if _wopts(node).get("checkpoint", True):
+            storage.save_step(sid, value)
         storage.save_step_meta(sid, dict(step_meta, status="SUCCEEDED",
                                          end=time.time()))
         results[id(node)] = value
@@ -350,9 +354,13 @@ def _run_sync(dag: DAGNode, storage: WorkflowStorage,
               args: tuple, kwargs: dict) -> Any:
     wid = storage.workflow_id
     with _running_lock:
-        _running[wid] = {"cancel": False, "refs": set()}
-    storage.write_meta(status="RUNNING", started=time.time())
+        # setdefault, never overwrite: run_async/resume_all pre-register
+        # BEFORE their thread starts, so a cancel() in the start window
+        # lands on this entry instead of being lost
+        _running.setdefault(wid, {"cancel": False, "refs": set()})
     try:
+        _check_cancel(wid)  # cancelled before the first step ran
+        storage.write_meta(status="RUNNING", started=time.time())
         out = _execute_durably(dag, storage, args, kwargs, workflow_id=wid)
     except WorkflowCancellationError:
         storage.write_meta(status="CANCELED", ended=time.time())
@@ -401,6 +409,11 @@ class WorkflowHandle:
 def _start_async_run(dag: DAGNode, storage: WorkflowStorage, args: tuple,
                      kwargs: dict) -> WorkflowHandle:
     h = WorkflowHandle(storage.workflow_id)
+    with _running_lock:
+        # visible to cancel()/resume_all() from the moment the handle
+        # exists, not from whenever the thread gets scheduled
+        _running.setdefault(storage.workflow_id,
+                            {"cancel": False, "refs": set()})
 
     def runner():
         try:

@@ -324,3 +324,24 @@ def test_workflow_cancel_unknown_and_terminal(ray_start_regular, tmp_path,
     workflow.run(one.bind(), workflow_id="done-flow")
     workflow.cancel("done-flow")  # no-op, never downgrades terminal status
     assert workflow.get_status("done-flow") == "SUCCEEDED"
+
+
+def test_workflow_cancel_immediately_after_run_async(ray_start_regular,
+                                                     tmp_path, monkeypatch):
+    """cancel() in the window before the runner thread is scheduled must
+    not be lost: the handle is registered for cancellation from the
+    moment run_async returns."""
+    import time as _time
+
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path))
+
+    @ray_tpu.remote
+    def forever():
+        _time.sleep(600)
+        return 1
+
+    h = workflow.run_async(forever.bind(), workflow_id="insta-cancel")
+    workflow.cancel("insta-cancel")  # no wait: races the runner thread
+    with pytest.raises(Exception):
+        h.result(timeout=120)
+    assert workflow.get_status("insta-cancel") == "CANCELED"
