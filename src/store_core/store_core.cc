@@ -14,16 +14,23 @@
 //    fault-and-zero cost that made fresh per-object files ~2x slower.
 //  - an oid -> {offset, size, sealed} index with create/seal/get/delete.
 //
-// Single-writer: the head owns allocation/decommit; other processes only
-// read (their locations arrive via the control plane), so no shared-memory
-// locking is needed — the same split as plasma, where only the store
-// process mutates the arena.
+// Single-PROCESS writer: the head owns allocation/decommit; other
+// processes only read (their locations arrive via the control plane), so
+// no SHARED-memory locking is needed — the same split as plasma, where
+// only the store process mutates the arena.  WITHIN the head, however,
+// several threads hit this API concurrently (driver puts, thin-client
+// blob reader threads, reaper deletes) and ctypes releases the GIL for
+// the duration of each call — so the handle carries its own mutex; every
+// exported call serializes on it (the role of plasma's store event loop).
+// Uncontended cost is ~20ns against a multi-us allocation.
 //
 // Exposed as a C ABI for ctypes (no pybind11 in this image).
 
 #include <cstdint>
 #include <cstring>
 #include <map>
+#include <vector>
+#include <mutex>
 #include <string>
 #include <unordered_map>
 
@@ -54,7 +61,21 @@ struct Arena {
   // free blocks by offset -> size (coalescing needs ordered neighbors)
   std::map<uint64_t, uint64_t> free_blocks;
   std::unordered_map<std::string, Entry> index;
+  std::mutex mu;  // serializes all API calls (see header comment)
 };
+
+// Every Arena ever created stays reachable here (closed ones included):
+// close() cannot delete the struct — a GIL-released call can be blocked
+// on its mutex — so this keeps the intentional leak reachable (and
+// therefore invisible to LeakSanitizer, which is right: it IS reachable).
+std::mutex g_arenas_mu;
+std::vector<Arena*>& g_arenas() {
+  // heap-allocated and never destroyed: a static vector's destructor
+  // would run at exit BEFORE the leak checker, orphaning the arenas it
+  // is keeping reachable
+  static std::vector<Arena*>* v = new std::vector<Arena*>();
+  return *v;
+}
 
 std::string oid_key(const uint8_t* oid) {
   return std::string(reinterpret_cast<const char*>(oid), 16);
@@ -127,6 +148,10 @@ void* rtpu_store_create(const char* path, uint64_t capacity) {
   // magic header so sweepers can identify arena files
   static const char kMagic[] = "RTPUARENA1";
   (void)!::pwrite(fd, kMagic, sizeof(kMagic), 0);
+  {
+    std::lock_guard<std::mutex> g(g_arenas_mu);
+    g_arenas().push_back(a);
+  }
   return a;
 }
 
@@ -135,6 +160,7 @@ void* rtpu_store_create(const char* path, uint64_t capacity) {
 int rtpu_store_put(void* h, const uint8_t* oid, uint64_t size,
                    uint64_t* offset_out) {
   auto* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->mu);
   auto key = oid_key(oid);
   if (a->index.count(key)) return -1;
   uint64_t need = align_up(size ? size : 1);
@@ -147,6 +173,7 @@ int rtpu_store_put(void* h, const uint8_t* oid, uint64_t size,
 
 int rtpu_store_seal(void* h, const uint8_t* oid) {
   auto* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->mu);
   auto it = a->index.find(oid_key(oid));
   if (it == a->index.end()) return -1;
   it->second.sealed = true;
@@ -157,6 +184,7 @@ int rtpu_store_seal(void* h, const uint8_t* oid) {
 int rtpu_store_get(void* h, const uint8_t* oid, uint64_t* offset_out,
                    uint64_t* size_out, int* sealed_out) {
   auto* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->mu);
   auto it = a->index.find(oid_key(oid));
   if (it == a->index.end()) return -1;
   *offset_out = it->second.offset;
@@ -168,6 +196,7 @@ int rtpu_store_get(void* h, const uint8_t* oid, uint64_t* offset_out,
 // Delete + reclaim. Returns 0, or -1 if absent.
 int rtpu_store_delete(void* h, const uint8_t* oid) {
   auto* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->mu);
   auto it = a->index.find(oid_key(oid));
   if (it == a->index.end()) return -1;
   uint64_t off = it->second.offset, alloc = it->second.allocated;
@@ -181,7 +210,9 @@ int rtpu_store_delete(void* h, const uint8_t* oid) {
 }
 
 uint64_t rtpu_store_bytes_used(void* h) {
-  return static_cast<Arena*>(h)->used;
+  auto* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->mu);
+  return a->used;
 }
 
 uint64_t rtpu_store_capacity(void* h) {
@@ -189,18 +220,35 @@ uint64_t rtpu_store_capacity(void* h) {
 }
 
 uint64_t rtpu_store_num_objects(void* h) {
-  return static_cast<Arena*>(h)->index.size();
+  auto* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->mu);
+  return a->index.size();
 }
 
 uint64_t rtpu_store_num_free_blocks(void* h) {
-  return static_cast<Arena*>(h)->free_blocks.size();
+  auto* a = static_cast<Arena*>(h);
+  std::lock_guard<std::mutex> g(a->mu);
+  return a->free_blocks.size();
 }
 
 void rtpu_store_close(void* h, int unlink_file) {
   auto* a = static_cast<Arena*>(h);
-  if (a->fd >= 0) ::close(a->fd);
-  if (unlink_file) ::unlink(a->path.c_str());
-  delete a;
+  std::lock_guard<std::mutex> g(a->mu);
+  if (a->fd >= 0) {
+    ::close(a->fd);
+    a->fd = -1;  // idempotent: a second close is a no-op
+  }
+  if (unlink_file && !a->path.empty()) {
+    ::unlink(a->path.c_str());
+    a->path.clear();
+  }
+  a->index.clear();
+  a->free_blocks.clear();
+  // The Arena struct itself is intentionally NOT deleted: a reaper or
+  // blob-reader thread can be blocked on mu right now (ctypes releases
+  // the GIL, so shutdown can race an in-flight call), and destroying a
+  // held/contended mutex is UB.  One small struct leaks per session at
+  // process exit — the price of making every call safe against close.
 }
 
 }  // extern "C"
