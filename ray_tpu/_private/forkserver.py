@@ -41,8 +41,13 @@ def serve(sock_path: str) -> None:
         pass
     if os.getppid() != ppid:  # parent died in the window before prctl
         os._exit(0)
-    # preload: the expensive part of a worker cold boot
+    # preload: the expensive part of a worker cold boot.  Everything a
+    # worker touches before its first task — the worker module chain,
+    # the protobuf wire codec (google.protobuf is ~0.3s cold), pickle
+    # machinery — is imported ONCE here; forks inherit the warm modules.
     import ray_tpu._private.worker as worker_mod
+    import ray_tpu._private.wire  # noqa: F401  (pulls google.protobuf)
+    import cloudpickle  # noqa: F401
 
     try:
         os.unlink(sock_path)

@@ -244,6 +244,12 @@ void rtpu_store_close(void* h, int unlink_file) {
   }
   a->index.clear();
   a->free_blocks.clear();
+  // a put that was blocked on mu during this close must FAIL (-2), not
+  // publish an object into a closed/unlinked arena: zero the capacity
+  // so arena_alloc's bump check rejects everything from now on
+  a->capacity = 0;
+  a->bump = kDataStart;
+  a->used = 0;
   // The Arena struct itself is intentionally NOT deleted: a reaper or
   // blob-reader thread can be blocked on mu right now (ctypes releases
   // the GIL, so shutdown can race an in-flight call), and destroying a

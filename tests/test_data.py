@@ -319,7 +319,16 @@ def test_streaming_iter_overlaps_map(ray_start_regular):
 
     from ray_tpu.data import read_api
 
-    marker = ray_tpu.put(0)  # just to have the cluster up
+    # warm the worker pool first: under pytest the task closures pickle
+    # BY REFERENCE to this test module, so each worker's first task pays
+    # a one-time `import test_data` (numpy + ray_tpu chain) — ~1s/worker
+    # on this 1-core box.  That cost is real but is not what this test
+    # measures; the assertion targets streaming overlap, not cold boot.
+    @ray_tpu.remote
+    def warm():
+        return 0
+
+    ray_tpu.get([warm.remote() for _ in range(8)], timeout=120)
 
     def slow_inc(batch):
         _t.sleep(0.3)

@@ -1,0 +1,118 @@
+"""Integration shims: multiprocessing.Pool + joblib backend.
+
+Reference: ``python/ray/util/multiprocessing/pool.py`` (drop-in Pool over
+actors) and ``python/ray/util/joblib/ray_backend.py`` (sklearn et al.
+parallelize over the cluster via ``parallel_backend``).
+"""
+
+import operator
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.multiprocessing import Pool
+
+
+def _square(x):
+    return x * x
+
+
+def _add(a, b):
+    return a + b
+
+
+def _boom(x):
+    raise RuntimeError(f"boom-{x}")
+
+
+def _init_env(value):
+    import os
+
+    os.environ["RTPU_POOL_INIT"] = value
+
+
+def _read_env(_):
+    import os
+
+    return os.environ.get("RTPU_POOL_INIT")
+
+
+def test_pool_map_apply_starmap(ray_start_regular):
+    with Pool(2) as p:
+        assert p.map(_square, range(10)) == [x * x for x in range(10)]
+        assert p.apply(_add, (3, 4)) == 7
+        assert p.starmap(_add, [(1, 2), (3, 4)]) == [3, 7]
+        r = p.apply_async(_square, (9,))
+        assert r.get(timeout=120) == 81 and r.ready() and r.successful()
+
+
+def test_pool_imap_ordering(ray_start_regular):
+    with Pool(2) as p:
+        assert list(p.imap(_square, range(20), chunksize=3)) \
+            == [x * x for x in range(20)]
+        assert sorted(p.imap_unordered(_square, range(20), chunksize=3)) \
+            == sorted(x * x for x in range(20))
+
+
+def test_pool_initializer_and_errors(ray_start_regular):
+    with Pool(2, initializer=_init_env, initargs=("pool-7",)) as p:
+        assert set(p.map(_read_env, range(4))) == {"pool-7"}
+        # surfaces as RayTaskError carrying the worker-side traceback
+        with pytest.raises(Exception, match="boom"):
+            p.map(_boom, range(3))
+        r = p.apply_async(_boom, (1,))
+        r.wait(120)
+        assert r.ready() and not r.successful()
+
+
+def test_pool_callbacks(ray_start_regular):
+    import threading
+
+    got = {}
+    done = threading.Event()
+    with Pool(2) as p:
+        p.map_async(_square, range(5),
+                    callback=lambda v: (got.__setitem__("v", v), done.set()))
+        assert done.wait(120)
+    assert got["v"] == [0, 1, 4, 9, 16]
+
+
+def test_pool_lifecycle(ray_start_regular):
+    p = Pool(1)
+    p.close()
+    with pytest.raises(ValueError):
+        p.map(_square, [1])
+    p.join()  # closed: join succeeds
+
+
+def test_joblib_backend(ray_start_regular):
+    joblib = pytest.importorskip("joblib")
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        out = joblib.Parallel()(joblib.delayed(operator.mul)(i, i)
+                                for i in range(12))
+    assert out == [i * i for i in range(12)]
+
+
+def test_joblib_backend_sklearn_style(ray_start_regular):
+    """The canonical use: CPU-heavy independent fits in parallel."""
+    joblib = pytest.importorskip("joblib")
+    import numpy as np
+
+    from ray_tpu.util.joblib import register_ray_tpu
+
+    register_ray_tpu()
+
+    def fit_one(seed):
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(200, 8))
+        w = rng.normal(size=8)
+        y = X @ w
+        west, *_ = np.linalg.lstsq(X, y, rcond=None)
+        return float(np.abs(west - w).max())
+
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        errs = joblib.Parallel()(joblib.delayed(fit_one)(s) for s in range(6))
+    assert all(e < 1e-8 for e in errs)
