@@ -145,7 +145,17 @@ def validate_options(opts: Dict[str, Any], for_actor: bool) -> Dict[str, Any]:
 
 
 def resources_from_options(opts: Dict[str, Any], default_num_cpus: float) -> Dict[str, float]:
-    res: Dict[str, float] = dict(opts.get("resources") or {})
+    # coerce custom amounts at the source: a str amount (e.g. {"accel":
+    # "1"}) must become a float HERE, or the head's scheduler compares
+    # float >= str and dies; a non-numeric amount errors at submission
+    try:
+        res: Dict[str, float] = {
+            k: float(v) for k, v in (opts.get("resources") or {}).items()
+        }
+    except (TypeError, ValueError) as e:
+        raise TypeError(
+            f"resources amounts must be numeric: {opts.get('resources')!r}"
+        ) from e
     if "CPU" in res or "TPU" in res:
         raise ValueError("Use num_cpus/num_tpus instead of resources={'CPU': ...}")
     num_cpus = opts.get("num_cpus")

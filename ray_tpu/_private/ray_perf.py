@@ -386,7 +386,10 @@ if __name__ == "__main__":
     if args.scale or args.scale_only:
         res += scale_envelope(quick=args.quick)
     payload = {"benchmarks": res, "host": "single-node"}
-    if os.path.exists(args.out) and args.scale_only:
+    if os.path.exists(args.out):
+        # ALWAYS merge by metric name: a core-only run must not silently
+        # drop the scale-envelope rows (or vice versa) — only the metrics
+        # measured THIS run are refreshed
         try:
             with open(args.out) as f:
                 old = json.load(f)

@@ -21,6 +21,10 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
+# The suite runs over the TYPED wire protocol so every protobuf arm is
+# exercised by every cluster test (production defaults to the native
+# fast path for same-version peers — see _private/wire.py).
+os.environ.setdefault("RAY_TPU_WIRE", "proto")
 
 import jax  # noqa: E402
 

@@ -155,3 +155,79 @@ def test_pickled_envelope_arm_still_decodes():
     env = pb.Envelope(version=wire.WIRE_VERSION,
                       pickled=pickle.dumps({"type": "x", "v": 1}))
     assert wire.decode(env.SerializeToString()) == {"type": "x", "v": 1}
+
+
+def test_default_wire_cluster_end_to_end():
+    """A cluster in the DEFAULT send encoding (raw pickle frames; the
+    suite otherwise forces RAY_TPU_WIRE=proto) runs tasks/actors/puts.
+    Covers the production default's send path and the always-sniffing
+    receive invariant."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items() if k != "RAY_TPU_WIRE"}
+    proc = subprocess.run([sys.executable, "-c", """
+import ray_tpu
+ray_tpu.init(num_cpus=2)
+
+@ray_tpu.remote
+def f(x):
+    return x * 2
+
+@ray_tpu.remote
+class A:
+    def go(self):
+        return "actor-ok"
+
+assert ray_tpu.get([f.remote(i) for i in range(8)], timeout=120) \
+    == [i * 2 for i in range(8)]
+a = A.remote()
+assert ray_tpu.get(a.go.remote(), timeout=120) == "actor-ok"
+r = ray_tpu.put({"k": list(range(100))})
+assert ray_tpu.get(r)["k"][-1] == 99
+ray_tpu.shutdown()
+print("DEFAULT_WIRE_OK")
+"""], env=env, capture_output=True, text=True, timeout=300)
+    assert "DEFAULT_WIRE_OK" in proc.stdout, proc.stderr[-2000:]
+
+
+def test_mixed_mode_peers_interoperate():
+    """A proto-sending driver joins a default (pickle-sending) head:
+    both directions work because every receiver sniffs."""
+    import os
+    import subprocess
+    import sys
+
+    env = {k: v for k, v in os.environ.items() if k != "RAY_TPU_WIRE"}
+    proc = subprocess.run([sys.executable, "-c", """
+import os, subprocess, sys
+import ray_tpu
+# head + its workers: DEFAULT (pickle) senders
+ray_tpu.init(num_cpus=2)
+from ray_tpu._private.worker import global_worker
+node = global_worker.node
+host, port = node.tcp_address
+
+# a thin client in PROTO mode connects to the default head
+client = subprocess.run([sys.executable, "-c", '''
+import ray_tpu
+ray_tpu.init(address="client://%s:%d", _authkey=bytes.fromhex("%s"))
+
+@ray_tpu.remote
+def g(x):
+    return x + 100
+
+assert ray_tpu.get(g.remote(1), timeout=120) == 101
+print("MIXED_OK")
+''' % (host, port, node.authkey.hex())],
+    env=dict(os.environ, RAY_TPU_WIRE="proto", RAY_TPU_SESSION="foreign"),
+    capture_output=True, text=True, timeout=240)
+print(client.stdout)
+sys.stderr.write(client.stderr[-2000:])
+assert "MIXED_OK" in client.stdout
+ray_tpu.shutdown()
+print("HEAD_OK")
+"""], env=env, capture_output=True, text=True, timeout=420)
+    assert "MIXED_OK" in proc.stdout and "HEAD_OK" in proc.stdout, \
+        proc.stderr[-2000:]
