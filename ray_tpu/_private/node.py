@@ -337,6 +337,9 @@ class NodeState:
     # health checking (GcsHealthCheckManager analog)
     last_heartbeat: float = field(default_factory=time.time)
     last_ping: float = 0.0
+    # live host utilization from the agent's last pong (reporter_agent
+    # analog); head-local nodes compute theirs at query time
+    host_stats: Optional[Dict[str, float]] = None
 
     def agent_send(self, msg: dict) -> None:
         if self.agent_conn is None:
@@ -859,6 +862,8 @@ class Node:
                             ns = self.nodes.get(agent_node_id)
                             if ns is not None:
                                 ns.last_heartbeat = time.time()
+                                if msg.get("stats"):
+                                    ns.host_stats = msg["stats"]
                 elif mtype == "object_pulled":
                     holder = self._pull_acks.pop(msg.get("token"), None)
                     if holder is not None:

@@ -143,7 +143,13 @@ class NodeAgent:
         elif mtype == "shutdown":
             self._shutdown = True
         elif mtype == "ping":
-            self._send({"type": "pong", "ts": msg.get("ts")})
+            # heartbeat reply doubles as the per-node metrics report
+            # (reporter_agent analog): live host utilization rides every
+            # pong and lands on the head's NodeState for /api/nodes
+            from ray_tpu._private.resource_spec import host_stats
+
+            self._send({"type": "pong", "ts": msg.get("ts"),
+                        "stats": host_stats()})
         else:
             logger.warning("agent: unknown message %s", mtype)
 

@@ -336,9 +336,42 @@ class Dashboard:
             return self._detail(table, key)
         try:
             # the state-API backend takes the right locks and strips blobs
-            return _jsonable(node._list_state(what, limit))
+            rows = node._list_state(what, limit)
         except ValueError:
             return None
+        if what == "nodes":
+            self._merge_node_stats(rows)
+        return _jsonable(rows)
+
+    def _merge_node_stats(self, rows) -> None:
+        """Attach each node's live utilization (agent pongs carry remote
+        stats; head-local nodes read /proc here) plus resource load —
+        the reference dashboard-agent's per-node metrics surface."""
+        from ray_tpu._private.resource_spec import host_stats
+
+        node = self.node
+        with node.lock:
+            # only ALIVE nodes get stats: a dead remote's row must not
+            # inherit the head host's /proc numbers (agent_conn is
+            # cleared on death) or show stale pre-death stats as live
+            live = {
+                nid: (ns.host_stats, ns.utilization(),
+                      ns.agent_conn is not None)
+                for nid, ns in node.nodes.items() if ns.alive
+            }
+        local_stats = None
+        for r in rows:
+            nid = r.get("node_id")
+            if nid not in live:
+                continue
+            stats, util, remote = live[nid]
+            if stats is None and not remote:
+                # emulated/head-local nodes genuinely share this host
+                if local_stats is None:
+                    local_stats = host_stats()
+                stats = local_stats
+            r["host_stats"] = stats
+            r["resource_utilization"] = round(util, 3)
 
     # -- logs (reference dashboard/modules/log: per-worker files + job
     # driver logs under the session dir) -----------------------------------

@@ -301,3 +301,53 @@ def test_dashboard_drilldown_and_timeline(ray_start_regular):
     tl = _json.loads(get("/api/timeline"))
     assert any(e.get("cat") == "task" for e in tl)
     assert all("ts" in e and "name" in e for e in tl)
+
+
+def test_dashboard_per_node_stats(ray_start_regular):
+    """/api/nodes rows carry live host utilization — head-local nodes
+    read /proc at query time; remote nodes report via agent pongs
+    (reference dashboard-agent reporter metrics)."""
+    import json as _json
+
+    node = ray_tpu._private.worker.global_worker.node
+    host, port = node.dashboard.address
+    with urllib.request.urlopen(f"http://{host}:{port}/api/nodes",
+                                timeout=60) as r:
+        rows = _json.loads(r.read())
+    assert rows
+    head_row = next(r for r in rows if r.get("node_id") == "node-head")
+    hs = head_row["host_stats"]
+    assert hs["cpu_count"] >= 1
+    if os.path.exists("/proc/meminfo"):  # host_stats degrades off-Linux
+        assert hs["mem_total_mb"] > 0
+    assert "resource_utilization" in head_row
+
+
+def test_remote_node_stats_via_agent_pong(tmp_path):
+    """A REAL remote agent's pong carries host stats; they surface on
+    the head's /api/nodes row for that node."""
+    import json as _json
+
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 2, "num_tpus": 0},
+                      real_processes=True)
+    try:
+        node_b = cluster.add_node(num_cpus=1)
+        node = ray_tpu._private.worker.global_worker.node
+        host, port = node.dashboard.address
+        deadline = time.time() + 60  # ping period is 2s
+        row = None
+        while time.time() < deadline:
+            with urllib.request.urlopen(
+                    f"http://{host}:{port}/api/nodes", timeout=60) as r:
+                rows = _json.loads(r.read())
+            row = next((r_ for r_ in rows if r_.get("node_id") == node_b), None)
+            if row and row.get("host_stats"):
+                break
+            time.sleep(0.5)
+        assert row and row.get("host_stats"), row
+        assert row["host_stats"]["mem_total_mb"] > 0
+    finally:
+        cluster.shutdown()
