@@ -16,6 +16,7 @@ from ray_tpu.rllib.algorithm import (
     train_one_step,
 )
 from ray_tpu.rllib.dqn import DQN, DQNConfig
+from ray_tpu.rllib.appo import APPO, APPOConfig
 from ray_tpu.rllib.impala import Impala, ImpalaConfig, compute_vtrace
 from ray_tpu.rllib.multi_agent import (
     MultiAgentBatch,
@@ -44,6 +45,8 @@ __all__ = [
     "A2C",
     "A2CConfig",
     "Impala",
+    "APPO",
+    "APPOConfig",
     "ImpalaConfig",
     "compute_vtrace",
     "DQN",

@@ -1,0 +1,129 @@
+"""APPO: asynchronous PPO — IMPALA's architecture with PPO's objective.
+
+Analog of ``/root/reference/rllib/algorithms/appo/appo.py:1``
+(``appo.py`` composes the IMPALA execution plan with the clipped
+surrogate; ``appo_torch_policy.py`` applies the surrogate over V-trace
+advantages).  Composition here is literal:
+
+- loss: PPO's clipped surrogate (``ppo.make_ppo_loss`` — the ratio is
+  exp(current - BEHAVIOR logp), which is exactly what stale async
+  samples need) over V-trace-corrected advantages/targets.
+- correction: :meth:`Impala._vtrace_batch` (inherited) recomputes
+  advantages with the CURRENT learner policy, so off-policy staleness
+  from async sampling is handled by rho/c clipping, not ignored.
+- execution: rollout workers ALWAYS have a sample() call in flight —
+  the learner trains on whichever batch lands first and immediately
+  re-arms that worker with fresh weights (the async rollout/learner
+  overlap of ``execution/train_ops.py:82``'s async mode).  No global
+  sampling barrier: a slow worker never stalls the learner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import train_one_step
+from ray_tpu.rllib.impala import Impala, ImpalaConfig
+from ray_tpu.rllib.ppo import make_ppo_loss
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+def _appo_loss_factory(config: Dict[str, Any]):
+    """PPO's clipped surrogate; V-trace supplies ADVANTAGES and
+    VALUE_TARGETS, the behavior ACTION_LOGP anchors the ratio."""
+    return make_ppo_loss(
+        config["clip_param"], config["vf_clip_param"],
+        config["vf_loss_coeff"], config["entropy_coeff"],
+    )
+
+
+class APPOConfig(ImpalaConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self._config.update(
+            _loss_factory=_appo_loss_factory,
+            clip_param=0.3,
+            vf_clip_param=10.0,
+            num_sgd_iter=1,        # async batches go stale fast
+            minibatch_size=128,
+            # how many completed worker batches one training_step consumes
+            # (1 = train the moment anything lands; higher amortizes the
+            # device step over more data)
+            batches_per_step=1,
+        )
+
+
+class APPO(Impala):
+    def setup(self, config: Dict[str, Any]) -> None:
+        super().setup(config)
+        # ref -> remote worker with that sample() in flight
+        self._inflight: Dict[Any, Any] = {}
+        self._weights_ref = None
+
+    def _arm(self, worker) -> None:
+        """Push current weights to ``worker`` and start its next sample —
+        both fire-and-forget; the actor's FIFO runs them in order."""
+        if self._weights_ref is None:
+            self._weights_ref = ray_tpu.put(
+                self.workers.local_worker.get_weights())
+        worker.set_weights.remote(self._weights_ref)
+        self._inflight[worker.sample.remote()] = worker
+
+    def training_step(self) -> Dict[str, Any]:
+        cfg = self.config
+        workers = self.workers.remote_workers
+        if not workers:
+            # no async seats: degrade to the synchronous IMPALA step with
+            # the APPO loss (still V-trace-corrected)
+            return super().training_step()
+
+        self._weights_ref = None  # re-snapshot once per training step
+        for w in workers:
+            if w not in self._inflight.values():
+                self._arm(w)
+        batches = []
+        want = max(1, int(cfg.get("batches_per_step", 1)))
+        while len(batches) < want:
+            ready, _ = ray_tpu.wait(list(self._inflight), num_returns=1)
+            ref = ready[0]
+            worker = self._inflight.pop(ref)
+            try:
+                batches.append(ray_tpu.get(ref))
+            except Exception:
+                # worker died mid-sample: it restarts via max_restarts;
+                # re-arm and keep learning off the others
+                pass
+            self._arm(worker)  # overlap: next sample runs during our SGD
+        batch = SampleBatch.concat_samples(batches)
+        self._timesteps_total += batch.count
+        train_batch = self._vtrace_batch(batch)
+        learner_metrics = train_one_step(
+            self.workers.local_worker.policy,
+            train_batch,
+            num_sgd_iter=cfg["num_sgd_iter"],
+            sgd_minibatch_size=cfg["minibatch_size"],
+            rng=self._sgd_rng,
+            required_keys=(
+                SampleBatch.OBS, SampleBatch.ACTIONS,
+                SampleBatch.ACTION_LOGP, SampleBatch.ADVANTAGES,
+                SampleBatch.VALUE_TARGETS,
+            ),
+        )
+        return {"info": {"learner": learner_metrics}}
+
+    def cleanup(self) -> None:
+        # cancel in-flight samples so worker actors die promptly
+        for ref in list(self._inflight):
+            try:
+                ray_tpu.cancel(ref, force=True)
+            except Exception:
+                pass
+        self._inflight.clear()
+        super().cleanup()
+
+
+APPO._default_config = APPOConfig().to_dict()

@@ -152,6 +152,10 @@ class Impala(Algorithm):
             SampleBatch.ACTIONS: batch[SampleBatch.ACTIONS],
             SampleBatch.ADVANTAGES: pg_adv,
             SampleBatch.VALUE_TARGETS: vs,
+            # behavior logp rides along for losses with an importance
+            # ratio (APPO's clipped surrogate); IMPALA's required_keys
+            # filter simply drops it
+            SampleBatch.ACTION_LOGP: batch[SampleBatch.ACTION_LOGP],
         })
         return out
 

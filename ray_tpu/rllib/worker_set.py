@@ -70,8 +70,11 @@ class WorkerSet:
     def collect_metrics(self) -> List[Dict[str, Any]]:
         if not self.remote_workers:
             return [self.local_worker.get_metrics()]
+        # generous: on a 1-core host several workers cold-boot jax
+        # SERIALLY (~30s each), and metrics calls queue behind any
+        # in-flight async sample (APPO keeps one outstanding per worker)
         return ray_tpu.get(
-            [w.get_metrics.remote() for w in self.remote_workers], timeout=60
+            [w.get_metrics.remote() for w in self.remote_workers], timeout=300
         )
 
     def stop(self) -> None:
