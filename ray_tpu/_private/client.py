@@ -62,6 +62,10 @@ class CoreClient:
         # cancel can interrupt the main thread mid-task (the exec queue
         # would only deliver it after the task finished)
         self._cancel_handler = None
+        # worker-side pipeline-reclaim hook: runs ON the recv thread for
+        # the same reason — the main thread is blocked inside the current
+        # task, so only this thread can drain the local queue
+        self._reclaim_handler = None
         # worker-side profiling hook (dashboard on-demand profiling): runs
         # on its own thread — sampling blocks for the requested duration
         self._profile_handler = None
@@ -159,6 +163,12 @@ class CoreClient:
             elif msg.get("type") == "cancel" and self._cancel_handler is not None:
                 try:
                     self._cancel_handler(msg)
+                except Exception:
+                    pass
+            elif (msg.get("type") == "reclaim_pipeline"
+                    and self._reclaim_handler is not None):
+                try:
+                    self._reclaim_handler(msg)
                 except Exception:
                     pass
             elif msg.get("type") == "profile" and self._profile_handler is not None:

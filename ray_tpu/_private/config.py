@@ -30,8 +30,15 @@ class Config:
     # (analog of the reference's in-process memory store for small/direct
     # returns, src/ray/core_worker/store_provider/memory_store/).
     max_direct_call_object_size: int = 100 * 1024
-    # Default object store capacity (bytes); analog of plasma's arena size.
-    object_store_memory: int = 2 * 1024**3
+    # Object store capacity (bytes); analog of plasma's arena size.  0 =
+    # auto: a fraction of system RAM bounded by the shm mount (the
+    # reference's default_object_store_memory sizing) — checkpoint-sized
+    # multi-GiB values must fit the arena to take its single-pass write +
+    # page-recycling path instead of a fresh per-object file.
+    object_store_memory: int = 0
+    # Auto sizing: this fraction of total RAM (reference
+    # ray_constants.DEFAULT_OBJECT_STORE_MEMORY_PROPORTION).
+    object_store_memory_fraction: float = 0.3
     # Task specs retained for object reconstruction (lineage); analog of
     # the reference's max_lineage_bytes bound (task_manager.h:94).
     max_lineage_entries: int = 10_000
@@ -98,3 +105,33 @@ def get_config() -> Config:
     if _config is None:
         _config = Config()
     return _config
+
+
+def resolve_object_store_memory(cfg: Config | None = None) -> int:
+    """The effective object-store capacity: the configured value, or (at 0)
+    ``object_store_memory_fraction`` of system RAM clamped to [2 GiB, 80% of
+    the shm mount].  The shm bound matters because the arena file lives
+    there — a capacity past the mount would let puts fail with ENOSPC
+    mid-write instead of falling back cleanly at allocation time."""
+    cfg = cfg or get_config()
+    if cfg.object_store_memory:
+        return int(cfg.object_store_memory)
+    try:
+        total = os.sysconf("SC_PHYS_PAGES") * os.sysconf("SC_PAGE_SIZE")
+    except (ValueError, OSError, AttributeError):
+        return 2 * 1024**3
+    # floor BEFORE the shm clamp: the clamp is the ENOSPC protection and
+    # must win on small shm mounts (e.g. docker's 64 MB default), or the
+    # arena outgrows its tmpfs and puts die with SIGBUS mid-write
+    want = max(2 * 1024**3, int(total * cfg.object_store_memory_fraction))
+    try:
+        from ray_tpu._private.shm import shm_dir
+
+        st = os.statvfs(shm_dir())
+        # clamp to FREE space, not mount size: tmpfs pages are allocated
+        # lazily, so an arena sized past what's actually available dies
+        # with SIGBUS/ENOSPC mid-write once puts catch up with it
+        want = min(want, int(st.f_frsize * st.f_bavail * 0.8))
+    except OSError:
+        pass
+    return want

@@ -109,11 +109,15 @@ class NativeArena:
             raise OSError(f"could not create arena at {path}")
         import mmap as mmap_mod
 
-        fd = os.open(path, os.O_RDWR)
+        # the fd stays open for the session: big-object puts write through
+        # it (pwrite — the single-pass path that skips the mmap fault+zero
+        # loop on fresh pages) while small puts memcpy into the mapping
+        self.fd = os.open(path, os.O_RDWR)
         try:
-            self._mm = mmap_mod.mmap(fd, capacity)
-        finally:
-            os.close(fd)
+            self._mm = mmap_mod.mmap(self.fd, capacity)
+        except BaseException:
+            os.close(self.fd)
+            raise
         self.buf = memoryview(self._mm)
         self._closed = False
 
@@ -158,6 +162,10 @@ class NativeArena:
             self._mm.close()
         except (BufferError, ValueError):
             pass  # exported zero-copy views still alive
+        try:
+            os.close(self.fd)
+        except OSError:
+            pass
         self._lib.rtpu_store_close(self._h, 1 if unlink else 0)
 
 

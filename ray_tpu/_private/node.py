@@ -462,8 +462,11 @@ class Node:
 
         self.lock = threading.RLock()
         self.cond = threading.Condition(self.lock)
+        from ray_tpu._private.config import resolve_object_store_memory
+
+        store_capacity = resolve_object_store_memory(self.cfg)
         self.registry = ObjectRegistry(
-            capacity_bytes=self.cfg.object_store_memory or None,
+            capacity_bytes=store_capacity,
             spill_dir=os.path.join(self.session_dir, "spill"),
         )
         # lineage: return oid -> creating task spec, kept while the object
@@ -488,9 +491,10 @@ class Node:
                     shm_mod.shm_dir(),
                     f"{self.cfg.shm_prefix}-{self.session_id}-arena",
                 )
-                self.arena = native.NativeArena(
-                    arena_path, int(self.cfg.object_store_memory or 2 << 30)
-                )
+                # sized to the resolved capacity: the file is sparse
+                # (ftruncate), so a large arena costs nothing until used,
+                # and multi-GiB values fit its recycled-page write path
+                self.arena = native.NativeArena(arena_path, store_capacity)
                 ostore_mod.set_owned_arena(self.arena)
                 self.registry.arena_delete = self.arena.delete
                 logger.info("native arena store at %s (%d MiB)",
@@ -1094,6 +1098,8 @@ class Node:
             self._on_blocked(worker, True)
         elif mtype == "unblocked":
             self._on_blocked(worker, False)
+        elif mtype == "pipeline_returned":
+            self._on_pipeline_returned(worker, msg)
         elif mtype == "add_ref":
             for oid in msg["oids"]:
                 self.registry.add_ref(oid)
@@ -1405,6 +1411,14 @@ class Node:
                 h.block_depth += 1
                 if h.block_depth != 1:
                     return
+                if not h.is_actor_worker and h.pipeline:
+                    # this task's get may be waiting on the OUTPUT of a
+                    # task pipelined behind it in this worker's FIFO queue
+                    # — a scheduling deadlock.  Ask the worker to hand its
+                    # unstarted pipelined tasks back; _on_pipeline_returned
+                    # requeues whatever it actually returns.
+                    h.outbox.append({"type": "reclaim_pipeline"})
+                    self._outbox_pending.add(h)
             else:
                 if h.block_depth == 0:
                     return
@@ -1934,6 +1948,52 @@ class Node:
                 if len(self.pending_tasks) < before:
                     return True
         return False
+
+    def _on_pipeline_returned(self, w: Optional[WorkerHandle],
+                              msg: dict) -> None:
+        """A blocked worker handed back its unstarted pipelined tasks (see
+        the reclaim in _on_blocked).  Requeue exactly the specs the worker
+        reports — anything its main loop had already claimed runs there and
+        is absent from the report, so nothing double-executes.  Pipelined
+        specs never acquired resources (they swap at promotion), so the
+        requeue is accounting-neutral."""
+        if w is None:
+            return
+        ids = set(msg.get("task_ids", []))
+        if not ids:
+            return
+        with self.lock:
+            reclaimed = [s for s in w.pipeline if s["task_id"] in ids]
+            w.pipeline = deque(
+                s for s in w.pipeline if s["task_id"] not in ids)
+            # a spec PROMOTED to current_task between the reclaim send and
+            # this reply was already drained from the worker's local queue
+            # and will never run there: undo the promotion bookkeeping and
+            # requeue it ahead of the rest (it was FIFO-earlier)
+            cur = w.current_task
+            if (cur is not None and not w.is_actor_worker
+                    and cur["task_id"] in ids
+                    and cur["task_id"] in self.running):
+                rt = self.running.pop(cur["task_id"])
+                self._release_task_resources_locked(rt)
+                reclaimed.insert(0, cur)
+                w.current_task = None
+                w.state = "idle"
+                ns = self.nodes.get(w.node_id)
+                if ns is not None and ns.alive:
+                    w.idle_since = time.time()
+                    ns.idle.append(w)
+            if not reclaimed:
+                return
+            # front of the queue, original order: these were FIFO-earlier
+            # than anything still pending
+            for s in reversed(reclaimed):
+                self.pending_tasks.appendleft(s)
+                ti = self.gcs.tasks.get(s["task_id"])
+                if ti:
+                    ti.state = "PENDING"
+                    ti.node_id = None
+            self._wake_scheduler()  # cond wraps self.lock: notify under it
 
     def _on_object_deleted(self, oid: bytes) -> None:
         """Registry delete hook: drop the object's lineage entry and, when
@@ -2534,6 +2594,10 @@ class Node:
         negative.  The worker executes its queue FIFO, so ordering holds."""
         cur = w.current_task
         if cur is None or w.is_actor_worker:
+            return
+        if w.block_depth:
+            # a blocked worker just had its pipeline reclaimed; queueing
+            # more behind the blocked task would recreate the deadlock
             return
         req = cur.get("resources", {})
         if req.get(TPU, 0):

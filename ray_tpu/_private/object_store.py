@@ -512,6 +512,18 @@ _ATTACHED_LOCK = threading.Lock()
 # Owner-side native arena (plasma analog); the head process sets this at
 # Node init.  Worker processes keep the per-object-file path.
 _OWNED_ARENA = None
+
+# Above this size an arena put writes through the arena fd (os.pwrite,
+# one kernel pass per page) instead of memcpy into the mapping: on
+# never-faulted arena pages the mmap path pays fault+zero+copy per 4 KiB
+# page, which is the 45x cliff multi-GiB (checkpoint-sized) values hit.
+# Recycled (already-faulted) pages favor memcpy, and sub-64 MB objects
+# mostly land on recycled slots, so the threshold keeps them there.
+try:
+    _ARENA_FD_WRITE_MIN = int(os.environ.get(
+        "RAY_TPU_ARENA_FD_WRITE_MIN", str(64 << 20)))
+except ValueError:  # malformed override: keep the default, don't die at import
+    _ARENA_FD_WRITE_MIN = 64 << 20
 # reader-side cache: arena path -> memoryview over its mmap
 _ARENA_MAPS: Dict[str, memoryview] = {}
 _ARENA_MAPS_LOCK = threading.Lock()
@@ -543,18 +555,34 @@ class _ArenaPin:
             pass
 
 
-class _PinnedSlice:
-    """Buffer-protocol proxy (PEP 688): exporting views through this keeps
-    the pin — and therefore the head-side reference — alive."""
+class _PinnedArenaMap(__import__("mmap").mmap):
+    """mmap subclass that can carry attributes — see
+    :func:`_pinned_arena_slice`."""
 
-    __slots__ = ("_view", "_pin")
 
-    def __init__(self, view: memoryview, pin: _ArenaPin):
-        self._view = view
-        self._pin = pin
+def _pinned_arena_slice(path: str, off: int, size: int,
+                        pin: _ArenaPin) -> memoryview:
+    """A zero-copy view of ``[off, off+size)`` of the arena file whose
+    buffer chain owns ``pin``: a private mmap subclass instance carries the
+    pin as an attribute, every exported memoryview keeps its exporting
+    mmap alive, and the mmap's deallocation drops the pin — so the
+    head-side reference lives exactly as long as any deserialized view
+    (numpy array, bytes slice) over this object.  Works on every CPython
+    (no PEP 688 ``__buffer__`` needed; plain classes can't export buffers
+    before 3.12)."""
+    import mmap as mmap_mod
 
-    def __buffer__(self, flags):
-        return self._view
+    gran = mmap_mod.ALLOCATIONGRANULARITY
+    base = (off // gran) * gran
+    delta = off - base
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        mm = _PinnedArenaMap(fd, delta + size, prot=mmap_mod.PROT_READ,
+                             offset=base)
+    finally:
+        os.close(fd)  # the mapping outlives the fd
+    mm._pin = pin
+    return memoryview(mm)[delta:delta + size]
 
 
 def _arena_view(path: str) -> memoryview:
@@ -598,7 +626,15 @@ def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[Obj
             key = os.urandom(16)
             off = _OWNED_ARENA.put(key, total)
         if off is not None:
-            serialization.write_into(_OWNED_ARENA.buf[off:off + total], meta, buffers)
+            if total >= _ARENA_FD_WRITE_MIN:
+                # single-pass write for multi-GiB values (see threshold
+                # comment above); coherent with every reader's arena mmap
+                written = serialization.write_to_fd_at(
+                    _OWNED_ARENA.fd, off, meta, buffers)
+                assert written == total, (written, total)
+            else:
+                serialization.write_into(
+                    _OWNED_ARENA.buf[off:off + total], meta, buffers)
             _OWNED_ARENA.seal(key)
             return ObjectLocation(
                 shm_name=name, size=total, is_error=is_error,
@@ -729,9 +765,7 @@ def read_value(loc: ObjectLocation, oid: Optional[bytes] = None) -> Any:
             value = serialization.deserialize(memoryview(f.read()))
     elif loc.arena_path is not None:
         try:
-            view = _arena_view(loc.arena_path)
-            payload = view[loc.arena_off:loc.arena_off + loc.size]
-            wrap = None
+            payload = None
             if oid is not None:
                 from ray_tpu._private.worker import global_worker
 
@@ -740,11 +774,14 @@ def read_value(loc: ObjectLocation, oid: Optional[bytes] = None) -> Any:
                     # the caller's handle is live right now, so this
                     # add_ref cannot race the object's deletion
                     client.add_refs([oid])
-                    pin = _ArenaPin(oid)
-                    wrap = lambda v: _PinnedSlice(v, pin)  # noqa: E731
-            if wrap is None:
-                payload = memoryview(bytes(payload))  # safe copy
-            value = serialization.deserialize(payload, wrap_buffer=wrap)
+                    payload = _pinned_arena_slice(
+                        loc.arena_path, loc.arena_off, loc.size,
+                        _ArenaPin(oid))
+            if payload is None:
+                view = _arena_view(loc.arena_path)
+                payload = memoryview(
+                    bytes(view[loc.arena_off:loc.arena_off + loc.size]))
+            value = serialization.deserialize(payload)
         except FileNotFoundError:
             # remote node: pull a private copy named loc.shm_name
             if not loc.fetch_addr:

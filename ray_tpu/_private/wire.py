@@ -47,6 +47,17 @@ WIRE_VERSION = 1
 
 _PICKLE_PROTO = pickle.HIGHEST_PROTOCOL
 
+# Largest Envelope a peer is guaranteed to parse: protobuf's 2 GiB message
+# cap, with headroom for field tags/framing around the payload.  Frames at
+# or past this take the raw-pickle arm (no cap there).
+_PB_MAX_FRAME = (1 << 31) - (1 << 20)
+
+try:
+    from google.protobuf.message import EncodeError as _EncodeError
+except Exception:  # pragma: no cover — protobuf always present in-image
+    class _EncodeError(Exception):
+        pass
+
 
 class WireDecodeError(pickle.UnpicklingError):
     """Bad frame.  Subclasses UnpicklingError so every existing
@@ -249,6 +260,12 @@ def _enc_remove_ref(msg, env) -> bool:
 
 
 def _enc_kv_put(msg, env) -> bool:
+    if len(msg["value"]) >= _PB_MAX_FRAME:
+        # size-gate the one arm that carries unbounded bytes BEFORE
+        # copying them into the Envelope: a near-/over-2 GiB value would
+        # serialize (upb has no encode cap) into a frame no receiving
+        # backend can parse — the raw-pickle frame has no such cap
+        return False
     env.kv_put.ns = msg["ns"]
     env.kv_put.key = msg["key"]
     env.kv_put.value = msg["value"]
@@ -349,7 +366,24 @@ def encode(msg: Dict[str, Any]) -> bytes:
             # client put_blob/get_blob legitimately ship multi-GiB frames
             # over this connection.
             return pickle.dumps(msg, _PICKLE_PROTO)
-    return env.SerializeToString()
+    try:
+        out = env.SerializeToString()
+    except (ValueError, _EncodeError):
+        # A typed arm can build an Envelope that protobuf then refuses to
+        # serialize — the C++ backend raises only at SerializeToString
+        # time for a > 2 GiB message, never in the encoder itself.  The
+        # raw pickle frame has no size cap and decode() sniffs it by
+        # opcode, so falling back is always correct; leaking the raise
+        # would poison every send() call site.
+        return pickle.dumps(msg, _PICKLE_PROTO)
+    if len(out) >= _PB_MAX_FRAME:
+        # the upb backend SERIALIZES oversized messages happily, but no
+        # receiving backend can PARSE a > 2 GiB frame (DecodeError at the
+        # peer — a silent wire break).  Catches any typed arm that grew
+        # past the cap (big inline task args, batched seals), not just
+        # the kv_put arm gated above.
+        return pickle.dumps(msg, _PICKLE_PROTO)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -858,6 +858,31 @@ def main() -> None:
 
     client._cancel_handler = _on_cancel_message
 
+    def _on_reclaim_message(msg):
+        """The head reclaims this worker's UNSTARTED pipelined tasks while
+        the current task is blocked in a get: a pipelined task whose output
+        the blocked task is waiting on would otherwise deadlock behind it
+        in this FIFO queue.  Drain execute messages out of the local queue
+        and report their ids; the head requeues exactly those (any message
+        the main loop already claimed simply runs here, unreported)."""
+        returned = []
+        keep = []
+        while True:
+            try:
+                m = client._exec_queue.get_nowait()
+            except queue.Empty:
+                break
+            spec = m.get("spec") or {}
+            if m.get("type") == "execute" and spec.get("actor_id") is None:
+                returned.append(spec["task_id"])
+            else:
+                keep.append(m)
+        for m in keep:
+            client._exec_queue.put(m)
+        client.send({"type": "pipeline_returned", "task_ids": returned})
+
+    client._reclaim_handler = _on_reclaim_message
+
     def _on_profile_message(msg):
         # dashboard on-demand profiling (profile_manager.py analog): sample
         # this process for the requested window, report back to the head

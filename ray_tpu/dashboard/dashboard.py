@@ -96,9 +96,11 @@ TABS.forEach(t=>{const b=document.createElement("button");b.textContent=t;
  b.onclick=()=>{tab=t;hideDetail();render()};nav.appendChild(b);});
 function cell(v){if(v===null)return"";if(typeof v==="object")
  return JSON.stringify(v);return String(v);}
+function esc(v){return String(v).replace(/[&<>"']/g,c=>({"&":"&amp;","<":"&lt;",
+ ">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));}
 function hideDetail(){document.getElementById("detail").style.display="none";}
 async function showDetail(table,id){
- const r=await fetch(`/api/${table}/${id}`);
+ const r=await fetch(`/api/${table}/${encodeURIComponent(id)}`);
  if(!r.ok)return;
  const d=await r.json();
  document.getElementById("dtitle").textContent=`${table} ${id}`;
@@ -107,14 +109,15 @@ async function showDetail(table,id){
  const panel=document.getElementById("detail");
  panel.style.display="block";
  if(d.log_stream){
-  const a=document.createElement("a");a.href=`/api/logs/${d.log_stream}`;
+  const a=document.createElement("a");
+  a.href=`/api/logs/${encodeURIComponent(d.log_stream)}`;
   a.textContent="view log: "+d.log_stream;a.target="_blank";
   document.getElementById("dtitle").appendChild(document.createElement("br"));
   document.getElementById("dtitle").appendChild(a);
  }
 }
 async function showLog(stream){
- const r=await fetch(`/api/logs/${stream}?tail=500`);
+ const r=await fetch(`/api/logs/${encodeURIComponent(stream)}?tail=500`);
  document.getElementById("logview").textContent=
   r.ok?await r.text():"(stream unavailable)";
 }
@@ -122,10 +125,20 @@ async function renderLogs(){
  document.getElementById("tbl").style.display="none";
  const pane=document.getElementById("logpane");pane.style.display="block";
  const streams=await (await fetch("/api/logs")).json();
- document.getElementById("streams").innerHTML=streams.map(s=>
-  `<button onclick="showLog('${s.stream}')">${s.stream}
-   <small>(${s.kind}, ${Math.round(s.bytes/1024)}K)</small></button>`
- ).join(" ")||"(no log streams yet)";
+ // built via createElement/textContent: a stream name (derived from a
+ // user-chosen job_id) containing quotes/angle brackets must render as
+ // text, never as markup or an onclick payload
+ const box=document.getElementById("streams");box.textContent="";
+ streams.forEach(s=>{
+  const b=document.createElement("button");
+  b.textContent=s.stream+" ";
+  const sm=document.createElement("small");
+  sm.textContent=`(${s.kind}, ${Math.round(s.bytes/1024)}K)`;
+  b.appendChild(sm);
+  b.onclick=()=>showLog(s.stream);
+  box.appendChild(b);box.appendChild(document.createTextNode(" "));
+ });
+ if(!streams.length)box.textContent="(no log streams yet)";
 }
 async function render(){
  [...nav.children].forEach(b=>b.classList.toggle("on",b.textContent===tab));
@@ -152,13 +165,16 @@ async function render(){
   if(!rows.length){thead.innerHTML="";tbody.innerHTML=
    "<tr><td>(empty)</td></tr>";return;}
   const cols=Object.keys(rows[0]);
-  thead.innerHTML="<tr>"+cols.map(c=>`<th>${c}</th>`).join("")+"</tr>";
+  thead.innerHTML="<tr>"+cols.map(c=>`<th>${esc(c)}</th>`).join("")+"</tr>";
   const idf=ID_FIELD[tab];
+  // every interpolated value is esc()'d: row ids (e.g. a user-chosen
+  // job_id) and cell payloads must not be able to break out of the
+  // attribute or inject elements
   tbody.innerHTML=rows.map(r=>{
    const id=idf?r[idf]:null;
-   const attrs=id?` class=click data-id="${id}"`:"";
+   const attrs=id?` class=click data-id="${esc(id)}"`:"";
    return `<tr${attrs}>`+cols.map(c=>
-    `<td class="${cell(r[c])}">${cell(r[c])}</td>`).join("")+"</tr>";
+    `<td class="${esc(cell(r[c]))}">${esc(cell(r[c]))}</td>`).join("")+"</tr>";
   }).join("");
   if(idf)[...tbody.querySelectorAll("tr.click")].forEach(tr=>
    tr.onclick=()=>showDetail(tab,tr.dataset.id));

@@ -14,9 +14,6 @@ e.g. a jitted model on ``num_tpus=1`` actors for batch inference.
 from __future__ import annotations
 
 import math
-import queue as queue_mod
-import threading
-from collections import deque
 from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Union
 
 import numpy as np
@@ -123,78 +120,28 @@ class Dataset:
         return Dataset(self._plan.with_stage(stage))
 
     def _iter_block_refs(self):
-        """Block refs in order, streaming when possible (the
-        streaming_executor analog, ``_internal/streaming_executor.py``):
+        """Block refs in order, through the streaming executor
+        (``data/_streaming`` — the streaming_executor analog):
 
         - a stage-free plan over an ObjectRefGenerator yields refs AS THE
           PRODUCER TASK YIELDS THEM (never materializing the block list)
         - a plan whose trailing stages are all one-to-one streams them:
-          the fused map task for block N+W is submitted only as block N
-          is handed to the consumer (bounded in-flight window W), so
-          reads/transforms overlap training ingest with backpressure
-          instead of materializing stage-by-stage
-        - anything else (a trailing shuffle/actor-pool stage) executes
-          the plan first
+          the pump submits fused map tasks up to a bounded in-flight block
+          budget ahead of consumption (backpressure), so reads/transforms
+          overlap training ingest instead of materializing stage-by-stage
+        - a trailing shuffle/actor-pool stage executes eagerly first (and
+          is cached on the plan), then the remainder streams
+
+        Fully draining the iterator caches the refs as the plan's result,
+        so re-iteration and count()/take() reuse them.
         """
-        import time as _time
+        from ray_tpu.data._streaming import StreamingExecutor
 
-        from ray_tpu._private.object_ref import ObjectRefGenerator
-        from ray_tpu.data.plan import OneToOneStage, fuse_one_to_one
-
-        plan = self._plan
-        if plan._out is not None:
-            yield from plan._out[0]
-            return
-        if (isinstance(plan.input_refs, ObjectRefGenerator)
-                and not plan.stages):
-            yield from plan.input_refs
-            return
-        # split the plan at the last barrier stage; the one-to-one suffix
-        # streams over the prefix's output refs
-        barrier = -1
-        for i, s in enumerate(plan.stages):
-            if not isinstance(s, OneToOneStage):
-                barrier = i
-        suffix = plan.stages[barrier + 1:]
-        if not suffix:
-            yield from self._blocks
-            return
-        if barrier >= 0:
-            # run (once) and cache the barrier prefix on the main plan —
-            # a second epoch must not redo the shuffle
-            refs_in = getattr(plan, "_stream_prefix_out", None)
-            if refs_in is None:
-                prefix_plan = ExecutionPlan(
-                    plan.input_refs, plan.input_counts,
-                    plan.stages[:barrier + 1])
-                refs_in = prefix_plan.execute()[0]
-                plan._stream_prefix_out = refs_in
-                plan._stats.extend(prefix_plan.stats())
-        else:
-            refs_in = plan.input_refs
-            if isinstance(refs_in, list):
-                refs_in = list(refs_in)
-        task, fns, name = fuse_one_to_one(suffix)
-        t0 = _time.perf_counter()
-        window: deque = deque()
-        out_refs: List[Any] = []
-        W = 8  # in-flight fused tasks; balances overlap vs flood
-        for ref in refs_in:
-            window.append(task.remote(ref, fns))
-            if len(window) >= W:
-                r = window.popleft()
-                out_refs.append(r)
-                yield r
-        while window:
-            r = window.popleft()
-            out_refs.append(r)
-            yield r
-        # full exhaustion: cache as the plan's result so re-iteration and
-        # count()/take() reuse these refs instead of re-running the plan
-        plan._out = (out_refs, None)
-        plan._stats.append({"stage": f"{name} (streamed)",
-                            "wall_s": round(_time.perf_counter() - t0, 4),
-                            "blocks": len(out_refs)})
+        executor = StreamingExecutor(self._plan)
+        try:
+            yield from executor.iter_refs()
+        finally:
+            executor.shutdown()
 
     def stats(self) -> List[Dict[str, Any]]:
         """Per-stage execution stats (the _internal/stats.py analog)."""
@@ -429,79 +376,52 @@ class Dataset:
         self, *, batch_size: int = 256, batch_format: str = "numpy",
         drop_last: bool = False, prefetch_blocks: int = 2,
     ) -> Iterator[Any]:
-        """Stream batches (dataset.py:2624).  A background thread keeps up
-        to ``prefetch_blocks`` blocks materialized ahead of consumption, so
-        object fetch (incl. cross-node pulls) overlaps compute."""
-        refs = self._iter_block_refs()
-        q: "queue_mod.Queue" = queue_mod.Queue(maxsize=max(1, prefetch_blocks))
-        SENTINEL = object()
-        stop = threading.Event()
+        """Stream batches (dataset.py:2624) through the streaming executor:
+        trailing map stages run as a backpressured pipeline overlapping
+        consumption, a background thread keeps up to ``prefetch_blocks``
+        blocks materialized ahead, and batch slicing is zero-copy over the
+        fetched blocks' sealed store segments."""
+        from ray_tpu.data._streaming import (
+            StreamingExecutor,
+            batches_from_block_iter,
+        )
 
-        def put_or_stop(item) -> bool:
-            """Stop-aware put; True if delivered."""
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.2)
-                    return True
-                except queue_mod.Full:
-                    continue
-            return False
+        # the executor is created here (not inside a ref generator) so the
+        # batch iterator can shut it down on abandonment even while the
+        # prefetch thread is suspended inside the generator frame
+        executor = StreamingExecutor(self._plan)
+        return batches_from_block_iter(
+            executor.iter_refs(), batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last,
+            prefetch_blocks=prefetch_blocks, on_abandon=executor.shutdown,
+        )
 
-        def fetcher():
-            try:
-                for ref in refs:
-                    block = ray_tpu.get(ref)
-                    if not put_or_stop(block):
-                        return  # consumer abandoned the iterator
-            except BaseException as e:  # surfaced on the consumer side
-                put_or_stop(e)
-                return
-            put_or_stop(SENTINEL)
+    def streaming_split(
+        self, n: int, *, equal: bool = True,
+        locality_hints: Optional[List[Optional[str]]] = None,
+        max_in_flight_blocks: Optional[int] = None,
+    ) -> List[Any]:
+        """``n`` disjoint streaming shards over ONE shared pipeline
+        (``Dataset.streaming_split`` analog): each returned
+        ``StreamSplitDataIterator`` is picklable and is iterated from its
+        consumer's own process; the coordinator assigns blocks to shards
+        as they are produced (row-balanced with ``equal``), dispatches
+        each shard's map tasks toward ``locality_hints[i]`` (a node id —
+        blocks materialize on the consuming trainer's node), and bounds
+        in-flight blocks per shard (backpressure).  Contrast ``split()``:
+        no eager plan execution, no reducer tasks, no per-batch head
+        round trip."""
+        from ray_tpu.data._streaming import make_split_iterators
 
-        t = threading.Thread(target=fetcher, daemon=True, name="iter-batches-prefetch")
-        t.start()
-        try:
-            # the carry and all slicing stay columnar for table blocks —
-            # numpy views, no per-row python objects on the hot path
-            carry: Optional[Block] = None
-            while True:
-                item = q.get()
-                if item is SENTINEL:
-                    break
-                if isinstance(item, BaseException):
-                    raise item
-                block = item if carry is None else BlockAccessor.concat([carry, item])
-                carry = None
-                acc = BlockAccessor(block)
-                n, pos = acc.num_rows(), 0
-                while n - pos >= batch_size:
-                    yield self._format_batch(acc.slice(pos, pos + batch_size), batch_format)
-                    pos += batch_size
-                if pos < n:
-                    carry = acc.slice(pos, n)
-            if carry is not None and BlockAccessor(carry).num_rows() and not drop_last:
-                yield self._format_batch(carry, batch_format)
-        finally:
-            # unblocks (and ends) the fetcher if the consumer broke early
-            stop.set()
+        return make_split_iterators(
+            self, n, equal=equal, locality_hints=locality_hints,
+            max_in_flight_blocks=max_in_flight_blocks)
 
     @staticmethod
     def _format_batch(block: Block, batch_format: str):
-        acc = BlockAccessor(block)
-        if batch_format == "rows":
-            return acc.to_rows()
-        batch = acc.to_batch()
-        if batch_format == "numpy":
-            if set(batch) == {"value"}:
-                return batch["value"]
-            return batch
-        if batch_format == "pandas":
-            import pandas as pd
+        from ray_tpu.data._streaming.iterator import format_batch
 
-            return pd.DataFrame(acc.to_rows())
-        if batch_format in ("pyarrow", "arrow"):
-            return acc.to_arrow()
-        raise ValueError(f"unknown batch_format {batch_format!r}")
+        return format_batch(block, batch_format)
 
     def to_numpy(self, column: Optional[str] = None) -> np.ndarray:
         vals: List[np.ndarray] = []

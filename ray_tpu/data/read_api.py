@@ -26,6 +26,12 @@ from ray_tpu.data.datasource import (
 )
 
 DEFAULT_BLOCKS = 4
+# Size-aware splitting for in-memory arrays (the reference's
+# target_max_block_size): blocks near this size keep the streaming
+# pipeline's overlap granularity fine enough that the first batch is
+# ready after ONE block's transform, not the whole dataset's.
+TARGET_BLOCK_BYTES = 32 << 20
+_MAX_AUTO_BLOCKS = 512
 
 
 def read_datasource(datasource: Datasource, *, parallelism: int = DEFAULT_BLOCKS,
@@ -65,10 +71,16 @@ def range_tensor(n: int, *, shape: tuple = (1,), parallelism: int = DEFAULT_BLOC
 
 
 def from_numpy(arr: Union[np.ndarray, List[np.ndarray]], *,
-               parallelism: int = DEFAULT_BLOCKS) -> Dataset:
+               parallelism: Optional[int] = None) -> Dataset:
     if isinstance(arr, list):
         refs = [ray_tpu.put({"value": a}) for a in arr]
         return Dataset(refs, [len(a) for a in arr])
+    if parallelism is None:
+        # size-aware default: ~TARGET_BLOCK_BYTES blocks (floor
+        # DEFAULT_BLOCKS) so big arrays stream at fine granularity
+        parallelism = max(DEFAULT_BLOCKS,
+                          min(_MAX_AUTO_BLOCKS,
+                              int(arr.nbytes // TARGET_BLOCK_BYTES)))
     chunks = np.array_split(arr, min(parallelism, max(1, len(arr))))
     refs = [ray_tpu.put({"value": c}) for c in chunks if len(c)]
     return Dataset(refs, [len(c) for c in chunks if len(c)])

@@ -9,6 +9,7 @@ global SPMD program.
 
 from ray_tpu.air import Checkpoint, Result, RunConfig, ScalingConfig
 from ray_tpu.train.backend import Backend, BackendConfig, JaxConfig
+from ray_tpu.train.data_config import DataConfig
 from ray_tpu.train.predictor import BatchPredictor, JaxPredictor, Predictor
 from ray_tpu.train.sklearn_trainer import SklearnPredictor, SklearnTrainer
 from ray_tpu.train.trainer import BaseTrainer, DataParallelTrainer, JaxTrainer
@@ -23,6 +24,7 @@ __all__ = [
     "SklearnPredictor",
     "Backend",
     "BackendConfig",
+    "DataConfig",
     "JaxConfig",
     "BaseTrainer",
     "DataParallelTrainer",

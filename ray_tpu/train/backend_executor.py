@@ -42,14 +42,40 @@ class BackendExecutor:
         )
         self.backend.on_start(self.worker_group, self.backend_config)
 
+    def worker_node_ids(self) -> List[str]:
+        """Which node each rank's actor landed on (the locality input to
+        DataConfig: rank i's streaming shard materializes its blocks on
+        node ``worker_node_ids()[i]``)."""
+        if self.worker_group is None:
+            return []
+
+        def node_of_self():
+            import ray_tpu as _rt
+
+            return _rt.get_runtime_context().node_id
+
+        return self.worker_group.execute(node_of_self)
+
     def start_training(
         self,
         train_fn: Callable,
         config: Optional[Dict] = None,
         checkpoint: Optional[Checkpoint] = None,
         dataset_shards: Optional[List[Dict[str, Any]]] = None,
+        datasets: Optional[Dict[str, Any]] = None,
+        data_config=None,
         trial_info: Optional[Dict[str, str]] = None,
     ) -> None:
+        if dataset_shards is None and datasets:
+            # shard wiring happens HERE, not in the trainer: only the
+            # executor knows which node each rank landed on, and the
+            # streaming split needs those node ids as locality hints
+            from ray_tpu.train.data_config import DataConfig
+
+            data_config = data_config or DataConfig()
+            dataset_shards = data_config.configure(
+                datasets, self.worker_group.num_workers,
+                self.worker_node_ids())
         self.backend.on_training_start(self.worker_group, self.backend_config)
         blob = cloudpickle.dumps(train_fn)
         futures = []

@@ -39,11 +39,15 @@ class BaseTrainer:
         run_config: Optional[RunConfig] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
         datasets: Optional[Dict[str, Any]] = None,
+        dataset_config: Optional["DataConfig"] = None,
     ):
+        from ray_tpu.train.data_config import DataConfig
+
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
         self.resume_from_checkpoint = resume_from_checkpoint
         self.datasets = datasets or {}
+        self.dataset_config = dataset_config or DataConfig()
 
     def fit(self) -> Result:
         raise NotImplementedError
@@ -66,35 +70,16 @@ class DataParallelTrainer(BaseTrainer):
         run_config: Optional[RunConfig] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
         datasets: Optional[Dict[str, Any]] = None,
+        dataset_config=None,
     ):
         super().__init__(
             scaling_config=scaling_config, run_config=run_config,
             resume_from_checkpoint=resume_from_checkpoint, datasets=datasets,
+            dataset_config=dataset_config,
         )
         self.train_loop_per_worker = train_loop_per_worker
         self.train_loop_config = train_loop_config
         self.backend_config = backend_config or BackendConfig()
-
-    # ------------------------------------------------------------------
-    def _dataset_shards(self) -> Optional[List[Dict[str, Any]]]:
-        if not self.datasets:
-            return None
-        n = self.scaling_config.num_workers
-        shards: List[Dict[str, Any]] = [dict() for _ in range(n)]
-        for name, ds in self.datasets.items():
-            if n == 1 and hasattr(ds, "iter_batches"):
-                # single worker: hand over the dataset WITH its lazy plan —
-                # splitting would execute it eagerly and the worker's
-                # iter_batches could no longer stream read+transform
-                parts = [ds]
-            elif hasattr(ds, "split"):
-                parts = ds.split(n)
-            else:  # plain sequence: even slices
-                per = len(ds) // n
-                parts = [ds[i * per:(i + 1) * per] for i in range(n)]
-            for i in range(n):
-                shards[i][name] = parts[i]
-        return shards
 
     def _storage_dir(self) -> str:
         base = self.run_config.storage_path or os.path.join(
@@ -123,7 +108,8 @@ class DataParallelTrainer(BaseTrainer):
                     self.train_loop_per_worker,
                     config=self.train_loop_config,
                     checkpoint=latest_ckpt,
-                    dataset_shards=self._dataset_shards(),
+                    datasets=self.datasets or None,
+                    data_config=self.dataset_config,
                     trial_info={"name": self.run_config.name or "train", "id": "0"},
                 )
                 manager = _CheckpointBook(storage, ckpt_cfg)

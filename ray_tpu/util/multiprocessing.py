@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import warnings
 import weakref
 from multiprocessing import TimeoutError  # the Pool-API timeout type
 from typing import Any, Callable, Iterable, List, Optional
@@ -103,6 +104,14 @@ class Pool:
                 ray_tpu.cluster_resources().get("CPU", 1)))
         if processes < 1:
             raise ValueError(f"processes must be >= 1, got {processes}")
+        if maxtasksperchild is not None:
+            # actor seats are long-lived by design (no per-N-tasks worker
+            # recycling); a silent no-op would hide that from code that
+            # relies on recycling to bound leaks
+            warnings.warn(
+                "ray_tpu.util.multiprocessing.Pool ignores maxtasksperchild:"
+                " pool workers are long-lived actors and are not recycled",
+                UserWarning, stacklevel=2)
         self._size = processes
         cls = _PoolWorker
         if ray_remote_args:
@@ -175,19 +184,33 @@ class Pool:
     def imap(self, func, iterable, chunksize: Optional[int] = None):
         """Ordered lazy iteration; chunks resolve as they complete.
         chunksize defaults to 1 (the stdlib's), so the first item yields
-        after ONE call — not after a map()-sized chunk."""
+        after ONE call — not after a map()-sized chunk.
+
+        Submission is EAGER, like the stdlib: every chunk is in flight
+        when ``imap`` returns — workers compute while the caller is not
+        yet (or slowly) iterating.  Only result consumption is lazy."""
         self._check_running()
         refs = self._map_refs(func, iterable, chunksize or 1, star=False)
-        for ref in refs:
-            yield from ray_tpu.get(ref)
+
+        def drain_ordered():
+            for ref in refs:
+                yield from ray_tpu.get(ref)
+
+        return drain_ordered()
 
     def imap_unordered(self, func, iterable, chunksize: Optional[int] = None):
         self._check_running()
+        # eager submission at call time (see imap)
         refs = self._map_refs(func, iterable, chunksize or 1, star=False)
-        pending = list(refs)
-        while pending:
-            ready, pending = ray_tpu.wait(pending, num_returns=1)
-            yield from ray_tpu.get(ready[0])
+
+        def drain_completed():
+            pending = list(refs)
+            while pending:
+                ready, rest = ray_tpu.wait(pending, num_returns=1)
+                pending = rest
+                yield from ray_tpu.get(ready[0])
+
+        return drain_completed()
 
     # -- lifecycle -----------------------------------------------------
     def close(self) -> None:

@@ -210,12 +210,64 @@ def _check_task_dag(dag: DAGNode) -> None:
         raise TypeError("workflows support task DAGs only (no actor nodes)")
 
 
+# last cross-process cancel poll per workflow (monotonic seconds): meta.json
+# is disk + JSON parse, so the per-step-boundary check is throttled — a
+# foreign cancel() lands within the poll interval, not instantly
+_meta_cancel_poll: dict = {}
+_META_CANCEL_POLL_S = 1.0
+
+
 def _check_cancel(workflow_id: str) -> None:
     with _running_lock:
         st = _running.get(workflow_id)
         if st is not None and st["cancel"]:
             raise WorkflowCancellationError(
                 f"workflow {workflow_id} was cancelled")
+    if workflow_id:
+        # cross-PROCESS cancel lands as a flag in meta.json (the owning
+        # process's status must not be overwritten under it); honor it at
+        # a step boundary within the poll interval
+        now = time.monotonic()
+        if now - _meta_cancel_poll.get(workflow_id, 0.0) \
+                < _META_CANCEL_POLL_S:
+            return
+        _meta_cancel_poll[workflow_id] = now
+        meta = WorkflowStorage(workflow_id).read_meta()
+        if meta and meta.get("cancel_requested") \
+                and meta.get("status") == "RUNNING":
+            raise WorkflowCancellationError(
+                f"workflow {workflow_id} was cancelled (cross-process)")
+
+
+def _pid_alive(pid) -> bool:
+    try:
+        os.kill(int(pid), 0)
+    except (ProcessLookupError, ValueError, TypeError, OverflowError):
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    return True
+
+
+def _live_foreign_run(meta: Optional[dict]) -> bool:
+    """Does ``meta`` record a RUNNING workflow owned by a DIFFERENT
+    process that is verifiably alive?  The pid + host stamped into
+    meta.json at RUNNING time make a cross-process ``cancel()`` /
+    ``resume_all()`` distinguish a live run (must not double-run or have
+    its status overwritten) from a crashed one (safe to take over).
+    Liveness is only probeable on the recording host; a RUNNING meta from
+    another host is treated as dead — the storage root is host-local by
+    default, so a foreign-host meta means the dir was copied."""
+    if not meta or meta.get("status") != "RUNNING":
+        return False
+    pid = meta.get("pid")
+    if not pid or int(pid) == os.getpid():
+        return False
+    import socket
+
+    if meta.get("host") not in (None, socket.gethostname()):
+        return False
+    return _pid_alive(pid)
 
 
 def _track_ref(workflow_id: str, ref) -> None:
@@ -359,20 +411,28 @@ def _run_sync(dag: DAGNode, storage: WorkflowStorage,
         # lands on this entry instead of being lost
         _running.setdefault(wid, {"cancel": False, "refs": set()})
     try:
+        import socket
+
+        # pid + host let another process probe liveness (cancel /
+        # resume_all); cancel_requested resets so a resumed run doesn't
+        # inherit a stale cross-process cancel aimed at its predecessor
+        storage.write_meta(status="RUNNING", started=time.time(),
+                           pid=os.getpid(), host=socket.gethostname(),
+                           cancel_requested=False)
         _check_cancel(wid)  # cancelled before the first step ran
-        storage.write_meta(status="RUNNING", started=time.time())
         out = _execute_durably(dag, storage, args, kwargs, workflow_id=wid)
     except WorkflowCancellationError:
-        storage.write_meta(status="CANCELED", ended=time.time())
+        storage.write_meta(status="CANCELED", ended=time.time(), pid=None)
         raise
     except BaseException as e:
-        storage.write_meta(status="FAILED", error=str(e), ended=time.time())
+        storage.write_meta(status="FAILED", error=str(e), ended=time.time(),
+                           pid=None)
         raise
     finally:
         with _running_lock:
             _running.pop(wid, None)
     storage.save_output(out)
-    storage.write_meta(status="SUCCEEDED", ended=time.time())
+    storage.write_meta(status="SUCCEEDED", ended=time.time(), pid=None)
     return out
 
 
@@ -444,6 +504,10 @@ def resume(workflow_id: str) -> Any:
     storage = WorkflowStorage(workflow_id)
     if storage.has_output():
         return storage.load_output()
+    if _live_foreign_run(storage.read_meta()):
+        raise ValueError(
+            f"workflow {workflow_id!r} is running in another live process; "
+            f"resuming would double-run it")
     dag = storage.load_dag()
     args, kwargs = storage.load_inputs()  # the original run's inputs
     return _run_sync(dag, storage, args, kwargs)
@@ -500,6 +564,15 @@ def cancel(workflow_id: str) -> None:
                 raise ValueError(f"no workflow {workflow_id!r}")
             if meta.get("status") in ("SUCCEEDED", "FAILED", "CANCELED"):
                 return
+            if _live_foreign_run(meta):
+                # the owning process is ALIVE: overwriting its status
+                # would let it keep running under a CANCELED label.
+                # Request cancellation instead — the owner honors the
+                # flag at its next step boundary and writes CANCELED
+                # itself.
+                WorkflowStorage(workflow_id).write_meta(
+                    cancel_requested=True)
+                return
             WorkflowStorage(workflow_id).write_meta(status="CANCELED",
                                                     ended=time.time())
             return
@@ -524,6 +597,8 @@ def resume_all(include_failed: bool = False) -> List[tuple]:
         with _running_lock:
             if wid in _running:
                 continue  # actually live in this process
+        if _live_foreign_run(meta):
+            continue  # live in ANOTHER process: resuming would double-run
         if status in ("RUNNING", "CANCELED") or (
                 include_failed and status == "FAILED"):
             storage = WorkflowStorage(wid)

@@ -216,7 +216,10 @@ uint64_t rtpu_store_bytes_used(void* h) {
 }
 
 uint64_t rtpu_store_capacity(void* h) {
-  return static_cast<Arena*>(h)->capacity;
+  auto* a = static_cast<Arena*>(h);
+  // close() zeroes capacity under mu; an unlocked read here would race it
+  std::lock_guard<std::mutex> g(a->mu);
+  return a->capacity;
 }
 
 uint64_t rtpu_store_num_objects(void* h) {

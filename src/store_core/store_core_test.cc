@@ -282,6 +282,58 @@ void test_concurrent_churn() {
               (unsigned long long)deletes_ok.load(), (unsigned long long)n);
 }
 
+// Close racing readers: one thread calls close() while others spin on
+// capacity/bytes_used/get/put.  Under TSan this proves the metric reads
+// take the arena mutex (capacity is zeroed BY close under mu — an
+// unlocked read would be a data race), and that a put blocked on mu
+// during close fails instead of publishing into a closed arena.
+void test_close_vs_capacity() {
+  std::string path = "/tmp/rtpu_store_test_close_" + std::to_string(::getpid());
+  ::unlink(path.c_str());
+  void* h = rtpu_store_create(path.c_str(), 8ull << 20);
+  assert(h);
+  const uint64_t cap0 = rtpu_store_capacity(h);
+  assert(cap0 == 8ull << 20);
+  std::atomic<bool> closed{false};
+  constexpr int kReaders = 3;
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kReaders; ++t) {
+    ts.emplace_back([&, t]() {
+      for (int i = 0; i < 20000; ++i) {
+        uint64_t cap = rtpu_store_capacity(h);
+        // capacity is bimodal: the initial value before close, 0 after
+        assert(cap == cap0 || cap == 0);
+        (void)rtpu_store_bytes_used(h);
+        Oid o(i % 64 + t * 64);
+        uint64_t off = 0, sz = 0;
+        int sealed = 0;
+        (void)rtpu_store_get(h, o.b, &off, &sz, &sealed);
+        if (closed.load(std::memory_order_acquire)) {
+          // post-close: every put must be rejected (-2, arena full/closed)
+          uint64_t poff = 0;
+          assert(rtpu_store_put(h, o.b, 128, &poff) == -2);
+        } else {
+          uint64_t poff = 0;
+          (void)rtpu_store_put(h, o.b, 128, &poff);
+        }
+      }
+    });
+  }
+  std::thread closer([&]() {
+    // let the readers get going, then slam the arena shut under them
+    for (int i = 0; i < 1000; ++i) (void)rtpu_store_capacity(h);
+    rtpu_store_close(h, 1);
+    closed.store(true, std::memory_order_release);
+  });
+  for (auto& th : ts) th.join();
+  closer.join();
+  assert(rtpu_store_capacity(h) == 0);
+  assert(rtpu_store_bytes_used(h) == 0);
+  // close is idempotent
+  rtpu_store_close(h, 1);
+  std::puts("  close vs capacity OK");
+}
+
 }  // namespace
 
 int main() {
@@ -291,6 +343,7 @@ int main() {
   test_capacity_exhaustion();
   test_churn_invariants();
   test_concurrent_churn();
+  test_close_vs_capacity();
   std::puts("store_core_test: ALL OK");
   return 0;
 }

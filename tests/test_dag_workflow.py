@@ -326,6 +326,89 @@ def test_workflow_cancel_unknown_and_terminal(ray_start_regular, tmp_path,
     assert workflow.get_status("done-flow") == "SUCCEEDED"
 
 
+def test_workflow_liveness_cross_process(ray_start_regular, tmp_path,
+                                         monkeypatch):
+    """meta.json records pid+host at RUNNING time; another process's
+    cancel()/resume_all() probe that liveness: a LIVE foreign run gets a
+    cancel_requested flag (never a status overwrite) and is never
+    double-run by resume_all; a DEAD one is safe to cancel/resume."""
+    import socket
+    import subprocess
+    import sys
+    import time as _time
+
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path / "wf"))
+    from ray_tpu.workflow.api import WorkflowStorage
+
+    gate = tmp_path / "gate"
+
+    @ray_tpu.remote
+    def needs_gate():
+        if not gate.exists():
+            raise RuntimeError("gate closed")
+        return "opened"
+
+    with pytest.raises(Exception):
+        workflow.run(needs_gate.bind(), workflow_id="live-flow")
+    meta = WorkflowStorage("live-flow").read_meta()
+    assert meta["status"] == "FAILED" and meta["pid"] is None
+
+    # forge a LIVE foreign owner: a real subprocess whose pid we stamp in
+    proc = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(600)"])
+    try:
+        WorkflowStorage("live-flow").write_meta(
+            status="RUNNING", pid=proc.pid, host=socket.gethostname(),
+            cancel_requested=False)
+        # resume_all must SKIP the live run, not double-run it
+        assert workflow.resume_all(include_failed=True) == []
+        with pytest.raises(ValueError, match="another live process"):
+            workflow.resume("live-flow")
+        # cancel must request, not overwrite, a live owner's status
+        workflow.cancel("live-flow")
+        meta = WorkflowStorage("live-flow").read_meta()
+        assert meta["status"] == "RUNNING"
+        assert meta["cancel_requested"] is True
+    finally:
+        proc.kill()
+        proc.wait()
+    # owner is DEAD now: cancel takes over and marks CANCELED
+    workflow.cancel("live-flow")
+    assert workflow.get_status("live-flow") == "CANCELED"
+    # ...and a CANCELED workflow resumes cleanly (the stale
+    # cancel_requested flag must not insta-cancel the new run)
+    gate.write_text("x")
+    results = workflow.resume_all()
+    assert [wid for wid, _ in results] == ["live-flow"]
+    assert results[0][1].result(timeout=120) == "opened"
+    assert workflow.get_status("live-flow") == "SUCCEEDED"
+    assert WorkflowStorage("live-flow").read_meta()["pid"] is None
+
+
+def test_workflow_meta_records_pid_while_running(ray_start_regular, tmp_path,
+                                                 monkeypatch):
+    import time as _time
+
+    monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path))
+
+    @ray_tpu.remote
+    def forever():
+        _time.sleep(600)
+        return 1
+
+    h = workflow.run_async(forever.bind(), workflow_id="pid-flow")
+    from ray_tpu.workflow.api import WorkflowStorage
+
+    deadline = _time.time() + 60
+    while workflow.get_status("pid-flow") != "RUNNING" \
+            and _time.time() < deadline:
+        _time.sleep(0.05)
+    assert WorkflowStorage("pid-flow").read_meta()["pid"] == os.getpid()
+    workflow.cancel("pid-flow")
+    with pytest.raises(Exception):
+        h.result(timeout=120)
+
+
 def test_workflow_cancel_immediately_after_run_async(ray_start_regular,
                                                      tmp_path, monkeypatch):
     """cancel() in the window before the runner thread is scheduled must

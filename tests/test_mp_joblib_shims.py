@@ -54,6 +54,52 @@ def test_pool_imap_ordering(ray_start_regular):
             == sorted(x * x for x in range(20))
 
 
+def _touch_marker(path):
+    import os
+
+    with open(os.path.join(path, f"{os.getpid()}-{os.urandom(4).hex()}"),
+              "w"):
+        pass
+    return 1
+
+
+def test_pool_imap_submits_eagerly(ray_start_regular, tmp_path):
+    """imap/imap_unordered dispatch every chunk AT CALL TIME (the stdlib
+    contract): work proceeds even if the caller never touches the
+    returned iterator."""
+    import time
+
+    marker = str(tmp_path)
+    with Pool(2) as p:
+        it = p.imap(_touch_marker, [marker] * 6, chunksize=2)
+        it2 = p.imap_unordered(_touch_marker, [marker] * 6, chunksize=2)
+        # no iteration at all — the tasks must still run
+        deadline = time.time() + 120
+        import os
+
+        while time.time() < deadline:
+            if len(os.listdir(marker)) >= 12:
+                break
+            time.sleep(0.1)
+        assert len(os.listdir(marker)) >= 12
+        # draining afterwards still yields every result
+        assert list(it) == [1] * 6
+        assert list(it2) == [1] * 6
+        # a closed pool refuses NEW imap calls at call time, matching the
+        # eager-submission contract (the stdlib raises there too)
+    with pytest.raises(ValueError):
+        p.imap(_square, [1])
+
+
+def test_pool_maxtasksperchild_warns(ray_start_regular):
+    with pytest.warns(UserWarning, match="maxtasksperchild"):
+        p = Pool(1, maxtasksperchild=5)
+    try:
+        assert p.map(_square, [3]) == [9]
+    finally:
+        p.terminate()
+
+
 def test_pool_initializer_and_errors(ray_start_regular):
     with Pool(2, initializer=_init_env, initargs=("pool-7",)) as p:
         assert set(p.map(_read_env, range(4))) == {"pool-7"}

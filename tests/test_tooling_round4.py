@@ -232,6 +232,27 @@ def test_dashboard_web_ui_serves(ray_start_regular):
         assert f'"{tab}"' in html  # tab registry present
 
 
+def test_dashboard_ui_escapes_interpolations(ray_start_regular):
+    """Server-fed strings (log stream names, row ids, cell payloads) must
+    never reach innerHTML/onclick unescaped: a job_id containing a quote
+    or angle bracket would otherwise inject markup into the UI."""
+    node = ray_tpu._private.worker.global_worker.node
+    host, port = node.dashboard.address
+    with urllib.request.urlopen(f"http://{host}:{port}/", timeout=60) as r:
+        html = r.read().decode()
+    # the escaping helper exists and guards the row-id attribute and cells
+    assert "function esc(" in html
+    assert 'data-id="${esc(id)}"' in html
+    assert "${esc(cell(r[c]))}" in html
+    # log stream buttons are built via createElement/textContent, not an
+    # onclick string a stream name could break out of
+    assert "showLog('${s.stream}')" not in html
+    assert "b.onclick=()=>showLog(s.stream)" in html
+    # path segments are URI-encoded before interpolation into fetch URLs
+    assert "encodeURIComponent(stream)" in html
+    assert "encodeURIComponent(id)" in html
+
+
 # -----------------------------------------------------------------------
 # round 5 dashboard depth: log viewer, drill-down details, timeline
 # (reference dashboard/modules/log + client detail pages + ray timeline)

@@ -118,6 +118,58 @@ def test_execute_with_none_dep_loc_falls_back():
     assert wire.decode(frame) == msg
 
 
+def test_oversized_kv_put_takes_pickle_arm(monkeypatch):
+    # a near-/over-2 GiB kv_put value must NEVER ride the typed arm: upb
+    # would serialize it but no receiver can parse the frame (DecodeError
+    # at the peer = silent wire break), and the C++ backend raises at
+    # SerializeToString.  Exercise the real gate with the cap lowered so
+    # the test doesn't allocate 2 GiB.
+    monkeypatch.setattr(wire, "_PB_MAX_FRAME", 1 << 10)
+    msg = {"type": "kv_put", "ns": "n", "key": b"k", "value": b"v" * (1 << 10)}
+    frame = wire.encode(msg)
+    assert frame[:1] == b"\x80"  # raw pickle, no cap
+    assert wire.decode(frame) == msg
+    # under the gate the typed arm still wins
+    small = {"type": "kv_put", "ns": "n", "key": b"k", "value": b"v"}
+    assert wire.encode(small)[:1] == b"\x08"
+    assert wire.decode(wire.encode(small)) == small
+
+
+def test_oversized_typed_frame_falls_back(monkeypatch):
+    # any OTHER typed arm that grows past the parse cap (big inline task
+    # args, batched seals) is caught after serialization by the frame-
+    # length check — encode() must return a pickle frame, not leak an
+    # unparseable envelope or an exception
+    monkeypatch.setattr(wire, "_PB_MAX_FRAME", 16)
+    msg = {"type": "kv_get", "ns": "n", "key": b"k" * 64, "req_id": 9}
+    frame = wire.encode(msg)
+    assert frame[:1] == b"\x80"
+    assert wire.decode(frame) == msg
+
+
+def test_serialize_raise_falls_back(monkeypatch):
+    # a backend that refuses at SerializeToString time (C++ 2 GiB cap)
+    # must also land on the pickle arm instead of raising out of encode()
+    class Boom:
+        def __getattr__(self, name):
+            import types
+
+            return types.SimpleNamespace()  # absorbs any typed-arm field
+
+        def SerializeToString(self):
+            raise ValueError("message too large")
+
+    real_envelope = wire.pb.Envelope
+    monkeypatch.setattr(wire.pb, "Envelope", lambda **kw: Boom())
+    try:
+        msg = {"type": "kv_get", "ns": "n", "key": b"k", "req_id": 1}
+        frame = wire.encode(msg)
+    finally:
+        monkeypatch.setattr(wire.pb, "Envelope", real_envelope)
+    assert frame[:1] == b"\x80"
+    assert wire.decode(frame) == msg
+
+
 def test_legacy_pickle_frame_sniffing():
     # a RAY_TPU_WIRE=pickle peer's frame (raw pickle starts 0x80) decodes
     frame = pickle.dumps({"type": "pong"})
