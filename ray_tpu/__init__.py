@@ -115,6 +115,16 @@ def init(
         global_worker.node = node
         global_worker.client = client
         global_worker.node_id = node._head_node_id if node else "node-head"
+        if node is None:
+            # external driver: its flight-recorder events (streaming pump,
+            # serve router) ship to the head like a worker's do.  The
+            # in-process head path needs no pusher — driver emits land in
+            # the head's own ring.
+            from ray_tpu._private import events as _events
+
+            global_worker._events_pusher = _events.EventsPusher(
+                client.send, origin=f"driver-{_os.getpid()}",
+                closed_fn=lambda: client.closed).start()
         atexit.register(shutdown)
 
 
@@ -126,6 +136,13 @@ def shutdown() -> None:
     with _init_lock:
         if not global_worker.connected:
             return
+        pusher = getattr(global_worker, "_events_pusher", None)
+        if pusher is not None:
+            try:
+                pusher.stop()  # final event ship while the socket is live
+            except Exception:
+                pass
+            global_worker._events_pusher = None
         try:
             global_worker.client.close()
         except Exception:

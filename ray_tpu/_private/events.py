@@ -1,0 +1,324 @@
+"""Cluster flight recorder: bounded structured-event ring per process.
+
+Analog of the reference's event framework (``dashboard/modules/event/`` +
+``src/ray/util/event.h``): every process keeps a small ring buffer of
+structured events (ts, severity, source, entity id, message, data) that
+subsystems emit on their hot paths — dispatch decisions, spills, OOM
+kills, backpressure stalls, slot admissions.  Dapper's rules apply:
+always-on, bounded memory (O(capacity), never O(events)), and cheap
+enough to leave enabled (<3% of task throughput, gated by the
+``observability_overhead`` bench row).
+
+Transport: workers batch-ship new events to the head over the control
+connection (the ``metrics_report`` path) via :class:`EventsPusher`; the
+head folds them into a capped per-source :class:`EventTable` served by
+``ray_tpu events`` / ``experimental.state.api.list_events`` / the
+dashboard's ``/api/events``.  The pusher also rewrites a crash-dump file
+under the session log dir each cycle, so even a SIGKILL'd process leaves
+its last-flushed ring on disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
+
+# Kill switch for the whole observability layer (events + hot-path metric
+# observations).  Read once at import in each process — the bench compares
+# enabled vs disabled runs in fresh subprocesses.
+ENABLED = os.environ.get("RAY_TPU_EVENTS", "1") not in ("0", "false", "no")
+
+
+def _int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+DEFAULT_CAPACITY = _int_env("RAY_TPU_EVENTS_CAPACITY", 4096)
+# per-source cap at the head (one cluster-wide table, bounded per source)
+DEFAULT_TABLE_CAPACITY = _int_env("RAY_TPU_EVENTS_TABLE_CAPACITY", 10_000)
+DEFAULT_FLUSH_S = float(os.environ.get("RAY_TPU_EVENTS_FLUSH_S", "2.0"))
+
+
+class EventBuffer:
+    """Bounded ring of event records; memory stays O(capacity) forever
+    (deque maxlen eviction).
+
+    The hot half is :meth:`emit`: it appends one TUPLE (no dict build, no
+    string formatting) so the per-event cost on instrumented paths like
+    task dispatch stays ~1-2us; records materialize as dicts only when
+    read (snapshot/ship), which happens at the pusher's cadence, not the
+    workload's."""
+
+    # tuple layout: (seq, ts, severity, source, message, entity_id,
+    #               span_dur, data)
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = max(1, int(capacity))
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0  # monotone id; lets the pusher ship only new events
+
+    def emit(self, source: str, message: str, severity: str = "INFO",
+             entity_id: Optional[str] = None, span_dur: Optional[float] = None,
+             **data) -> None:
+        ts = time.time()
+        with self._lock:
+            self._seq += 1
+            self._ring.append((self._seq, ts, severity, source, message,
+                               entity_id, span_dur, data or None))
+
+    @staticmethod
+    def _to_dict(rec) -> dict:
+        seq, ts, severity, source, message, entity_id, span_dur, data = rec
+        out = {"ts": ts, "severity": severity, "source": source,
+               "message": message, "pid": os.getpid(), "seq": seq}
+        if entity_id is not None:
+            out["entity_id"] = entity_id
+        if span_dur is not None:
+            # span events: [ts - span_dur, ts] renders as a timeline slice
+            out["span_dur"] = span_dur
+        if data:
+            out["data"] = data
+        return out
+
+    def snapshot(self, limit: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            rows = list(self._ring)
+        if limit:
+            rows = rows[-limit:]
+        return [self._to_dict(r) for r in rows]
+
+    def since(self, seq: int) -> List[dict]:
+        """Events with seq > ``seq`` (the pusher's incremental cursor)."""
+        with self._lock:
+            rows = [r for r in self._ring if r[0] > seq]
+        return [self._to_dict(r) for r in rows]
+
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+
+_BUFFER = EventBuffer()
+
+
+def buffer() -> EventBuffer:
+    return _BUFFER
+
+
+def emit(source: str, message: str, severity: str = "INFO",
+         entity_id: Optional[str] = None, span_dur: Optional[float] = None,
+         **data) -> None:
+    """Record one structured event in this process's ring (no-op when the
+    observability layer is disabled)."""
+    if not ENABLED:
+        return
+    _BUFFER.emit(source, message, severity, entity_id, span_dur, **data)
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def local_events(limit: Optional[int] = None) -> List[dict]:
+    return _BUFFER.snapshot(limit)
+
+
+# Crash-dump files rotate (path -> path.1) past this size so a long-lived
+# process's trail stays bounded on disk.
+_DUMP_ROTATE_BYTES = 4 << 20
+
+
+def append_dump(path: str, rows: List[dict]) -> Optional[str]:
+    """Append events to the JSONL crash-dump file (one event per line).
+
+    Incremental by design: rewriting the whole ring as one JSON blob every
+    flush cycle held the GIL for tens of ms per rewrite and cost ~4% of
+    task throughput on the head — appending only the NEW events is
+    O(new), which is what makes the always-on crash dump affordable.
+
+    Never raises: emit(**data) accepts arbitrary app payloads (numpy
+    scalars included — hence ``default=repr``), and a dump failure must
+    not kill the calling thread (the head's gcs-flush loop, a worker's
+    pusher)."""
+    if not rows:
+        return None
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        try:
+            if os.path.getsize(path) > _DUMP_ROTATE_BYTES:
+                os.replace(path, path + ".1")
+        except OSError:
+            pass  # no file yet
+        with open(path, "a") as f:
+            f.write("\n".join(json.dumps(r, default=repr) for r in rows)
+                    + "\n")
+        return path
+    except Exception:
+        return None
+
+
+def load_dump(path: str) -> List[dict]:
+    """Read a JSONL crash-dump file back (skipping any torn final line a
+    SIGKILL mid-write may have left)."""
+    rows: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                continue
+    return rows
+
+
+def dump_now(path: str) -> Optional[str]:
+    """One-shot append of the WHOLE current ring (debug path — the
+    periodic pushers use the incremental cursor instead)."""
+    return append_dump(path, _BUFFER.snapshot())
+
+
+class EventTable:
+    """Head-side capped event directory: one ring per source so a chatty
+    subsystem can never evict another's history."""
+
+    def __init__(self, capacity_per_source: int = DEFAULT_TABLE_CAPACITY):
+        self._cap = max(1, int(capacity_per_source))
+        self._by_source: Dict[str, deque] = {}
+        self._lock = threading.Lock()
+
+    def add(self, origin: str, rows: List[dict]) -> None:
+        with self._lock:
+            for r in rows:
+                if not isinstance(r, dict) or "source" not in r:
+                    continue
+                r = dict(r)
+                r["origin"] = origin
+                q = self._by_source.get(r["source"])
+                if q is None:
+                    q = self._by_source[r["source"]] = deque(maxlen=self._cap)
+                q.append(r)
+
+    def list(self, limit: int = 1000, source: Optional[str] = None,
+             severity: Optional[str] = None) -> List[dict]:
+        with self._lock:
+            if source is not None:
+                rows = list(self._by_source.get(source, ()))
+            else:
+                rows = [r for q in self._by_source.values() for r in q]
+        if severity is not None:
+            rows = [r for r in rows if r.get("severity") == severity]
+        rows.sort(key=lambda r: r.get("ts", 0.0))
+        return rows[-limit:]
+
+    def sources(self) -> List[str]:
+        with self._lock:
+            return sorted(self._by_source)
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {s: len(q) for s, q in self._by_source.items()}
+
+
+class EventsPusher:
+    """Background thread shipping this process's new events to the head
+    (same control-connection path as ``metrics_report``) and rewriting the
+    crash-dump file each cycle.  Send failures back off and retry; the
+    loop only exits when stopped or the client is closed for good."""
+
+    def __init__(self, send_fn, origin: str, interval_s: float = DEFAULT_FLUSH_S,
+                 dump_path: Optional[str] = None, closed_fn=None):
+        self._send = send_fn
+        self._origin = origin
+        self._interval = interval_s
+        self._dump_path = dump_path
+        self._closed = closed_fn
+        self._stop = threading.Event()
+        self._cursor = 0  # last seq shipped to the head
+        self._dump_cursor = 0  # last seq appended to the crash dump
+        # serializes flush() (exit path) against an in-flight loop cycle:
+        # both read-modify-write the cursors, and an unsynchronized race
+        # would ship/append the same batch twice
+        self._flush_lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="events-pusher")
+
+    def start(self) -> "EventsPusher":
+        if ENABLED:
+            self._thread.start()
+        return self
+
+    def _dump_new(self) -> None:
+        """Append events since the dump cursor to the crash-dump file.
+        Independent cursor from shipping: a head outage must not stop the
+        on-disk trail (and a dump failure must not re-ship)."""
+        if not self._dump_path:
+            return
+        rows = _BUFFER.since(self._dump_cursor)
+        if rows and append_dump(self._dump_path, rows):
+            self._dump_cursor = rows[-1]["seq"]
+
+    def flush(self) -> bool:
+        """Synchronous ship+dump of anything new (used at exit and by
+        tests; safe to call concurrently with the loop — the flush lock
+        keeps the cursors single-writer).  Returns send success."""
+        with self._flush_lock:
+            self._dump_new()
+            return self._ship_locked()
+
+    def _ship_locked(self) -> bool:
+        rows = _BUFFER.since(self._cursor)
+        if not rows:
+            return True
+        try:
+            self._send({"type": "events_report", "origin": self._origin,
+                        "events": rows})
+            self._cursor = max(self._cursor, rows[-1]["seq"])
+            return True
+        except Exception:
+            return False  # cursor kept; retried next cycle
+
+    def _loop(self) -> None:
+        # the crash dump writes at EVERY interval regardless of head
+        # health — only the send backs off.  A head outage is exactly
+        # when the on-disk trail matters most.
+        send_backoff = 0.0
+        next_send = 0.0
+        while not self._stop.wait(self._interval):
+            if self._closed is not None and self._closed():
+                return
+            with self._flush_lock:
+                self._dump_new()
+                if time.monotonic() < next_send:
+                    continue
+                ok = self._ship_locked()
+            if ok:
+                send_backoff = 0.0
+                next_send = 0.0
+            else:
+                # transient head hiccup: keep the cursor, retry with
+                # bounded exponential backoff instead of dying silently
+                send_backoff = min(
+                    30.0, max(self._interval, send_backoff * 2))
+                next_send = time.monotonic() + send_backoff
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self.flush()
+        except Exception:
+            pass

@@ -43,6 +43,7 @@ from dataclasses import dataclass, field
 from multiprocessing.connection import Connection, Listener
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import events as events_mod
 from ray_tpu._private import logging_utils, wire
 from ray_tpu._private.config import get_config
 from ray_tpu._private.gcs import (
@@ -60,6 +61,35 @@ logger = logging_utils.get_logger(__name__)
 CPU = "CPU"
 TPU = "TPU"
 MEMORY = "memory"
+
+# Lazy scheduler metric singletons (registered on first dispatch so a head
+# that never runs a task registers nothing).
+_SCHED_METRICS = None
+# Dispatch EVENTS are sampled 1:N (Dapper-style bounded overhead: the
+# latency histogram records every task; the event trail records the 1st,
+# N+1th, ... dispatch plus every TPU dispatch).  The emit rides the head's
+# reader thread — the task hot path — so it must stay amortized-cheap.
+_DISPATCH_EVENT_SAMPLE = max(1, int(os.environ.get(
+    "RAY_TPU_EVENTS_DISPATCH_SAMPLE", "8")))
+
+
+def _sched_metrics():
+    global _SCHED_METRICS
+    if _SCHED_METRICS is None:
+        from ray_tpu.util.metrics import Gauge, Histogram
+
+        _SCHED_METRICS = {
+            "dispatch_latency": Histogram(
+                "ray_tpu_sched_dispatch_latency_s",
+                "task submit -> worker dispatch latency (s)",
+                boundaries=[0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5],
+            ),
+            "queue_depth": Gauge(
+                "ray_tpu_sched_queue_depth",
+                "tasks pending cluster-wide (not yet staged on a node)",
+            ),
+        }
+    return _SCHED_METRICS
 
 
 def _worker_pythonpath(existing: str) -> str:
@@ -637,6 +667,11 @@ class Node:
 
         self.job_manager = JobManager(self)
         self.worker_metrics_registry = metrics_mod._Registry()
+        # flight recorder: worker-shipped events fold in here; the head's
+        # own emits live in the process-local ring and merge at query time
+        self.events = events_mod.EventTable()
+        self._events_dumped_seq = 0
+        self._dispatch_n = 0  # dispatch-event sampling counter
         self.dashboard = None
         dash_port = int(os.environ.get("RAY_TPU_DASHBOARD_PORT", "0"))
         if dash_port >= 0:
@@ -704,6 +739,8 @@ class Node:
             self.nodes[node_id] = ns
             self.gcs.nodes[node_id] = NodeInfo(node_id=node_id, resources=dict(total))
             self._wake_scheduler()
+        events_mod.emit("node", "node joined", entity_id=node_id,
+                        resources=dict(total))
 
     def remove_node_state(self, node_id: str) -> None:
         """Simulate node death (Cluster.remove_node / chaos NodeKiller analog)."""
@@ -735,6 +772,8 @@ class Node:
                 pass
             self._on_worker_death(w, reason=f"node {node_id} removed")
         self.publish("node_change", {"node_id": node_id, "alive": False})
+        events_mod.emit("node", "node removed", severity="WARNING",
+                        entity_id=node_id, staged_tasks=len(staged))
         self._reconstruct_lost_objects(node_id)
         with self.lock:
             self._wake_scheduler()
@@ -1163,7 +1202,8 @@ class Node:
                                "value": self.job_manager.stop(msg["job_id"])})
         elif mtype == "list_state":
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
-                               "value": self._list_state(msg["what"], msg.get("limit", 1000))})
+                               "value": self._list_state(msg["what"], msg.get("limit", 1000),
+                                                         msg.get("filters"))})
         elif mtype == "replica_added":
             self._on_replica_added(worker, msg)
         elif mtype == "dynamic_yield":
@@ -1188,6 +1228,8 @@ class Node:
                 holder["event"].set()
         elif mtype == "metrics_report":
             self.worker_metrics_registry.merge(msg["origin"], msg["metrics"])
+        elif mtype == "events_report":
+            self.events.add(msg["origin"], msg["events"])
         elif mtype == "log":
             logging_utils.emit_worker_log(msg)
         else:
@@ -1305,6 +1347,9 @@ class Node:
         self.workers[worker_id] = h
         ns.starting += 1
         ns.starting_by_key[key] = ns.starting_by_key.get(key, 0) + 1
+        events_mod.emit("worker_pool", "worker spawning", severity="DEBUG",
+                        entity_id=worker_id.hex(), node=ns.node_id,
+                        runtime_env=bool(key))
 
     def _on_register_worker(self, conn: Connection, msg: dict) -> WorkerHandle:
         worker_id = bytes.fromhex(msg["worker_id"])
@@ -1328,6 +1373,9 @@ class Node:
                     h.idle_since = time.time()
                     ns.idle.append(h)
             self._wake_scheduler()
+        events_mod.emit("worker_pool", "worker registered", severity="DEBUG",
+                        entity_id=worker_id.hex(), node=h.node_id,
+                        actor=h.is_actor_worker)
         return h
 
     def _on_worker_death(self, h: WorkerHandle, reason: str) -> None:
@@ -1357,6 +1405,11 @@ class Node:
             h.pipeline.clear()
         if self._shutdown:
             return
+        events_mod.emit(
+            "worker_pool", f"worker died: {reason}",
+            severity="WARNING" if (spec is not None or h.actor_id) else "INFO",
+            entity_id=h.worker_id.hex(), node=h.node_id,
+            running_task=(spec or {}).get("name"))
         if h.actor_id is not None:
             self._on_actor_worker_death(h, reason)
         elif spec is not None or pipelined:
@@ -1419,6 +1472,10 @@ class Node:
                     # requeues whatever it actually returns.
                     h.outbox.append({"type": "reclaim_pipeline"})
                     self._outbox_pending.add(h)
+                    events_mod.emit(
+                        "scheduler", "pipeline reclaim requested",
+                        severity="DEBUG", entity_id=h.worker_id.hex(),
+                        queued=len(h.pipeline))
             else:
                 if h.block_depth == 0:
                     return
@@ -1835,12 +1892,27 @@ class Node:
         while not self._shutdown:
             time.sleep(2.0)
             self._prune_task_history()
+            self._dump_head_events()
             if self.gcs_store is None:
                 continue
             try:
                 self.gcs.flush(self.gcs_store)
             except Exception:
                 logger.warning("gcs flush failed:\n%s", traceback.format_exc())
+
+    def _dump_head_events(self) -> None:
+        """Append the head's new events to its crash-dump trail — a
+        SIGKILL'd head still leaves its last-flushed events on disk.
+        Incremental (O(new events) per cycle): rewriting the whole ring
+        held the GIL long enough to cost ~4% of task throughput."""
+        if not events_mod.ENABLED:
+            return
+        rows = events_mod.buffer().since(self._events_dumped_seq)
+        if not rows:
+            return
+        path = os.path.join(self.session_dir, "logs", "events-head.jsonl")
+        if events_mod.append_dump(path, rows):
+            self._events_dumped_seq = rows[-1]["seq"]
 
     _MAX_TASK_HISTORY = 10_000
 
@@ -1985,6 +2057,9 @@ class Node:
                     ns.idle.append(w)
             if not reclaimed:
                 return
+            events_mod.emit(
+                "scheduler", "pipeline reclaimed", severity="DEBUG",
+                entity_id=w.worker_id.hex(), n_tasks=len(reclaimed))
             # front of the queue, original order: these were FIFO-earlier
             # than anything still pending
             for s in reversed(reclaimed):
@@ -2232,6 +2307,11 @@ class Node:
             "worker_id": victim.worker_id.hex(),
             "memory_fraction": frac,
         })
+        events_mod.emit(
+            "scheduler", "OOM kill", severity="WARNING",
+            entity_id=victim.worker_id.hex(),
+            memory_fraction=round(frac, 3),
+            task=(victim.current_task or {}).get("name"))
         self._kill_worker(victim, reason=f"OOM killer (host memory {frac:.0%})")
         return True
 
@@ -2304,6 +2384,8 @@ class Node:
                 pass
 
     def _schedule_once(self) -> None:
+        if events_mod.ENABLED:
+            _sched_metrics()["queue_depth"].set(len(self.pending_tasks))
         self._schedule_pgs()
         self._schedule_actor_creations_and_tasks()
         # phase 1: move pending tasks to a node's ready queue (resources held)
@@ -2453,6 +2535,18 @@ class Node:
         if ti:
             ti.state = "RUNNING"
             ti.node_id = ns.node_id
+        if events_mod.ENABLED:
+            if ti:
+                _sched_metrics()["dispatch_latency"].observe(
+                    max(0.0, time.time() - ti.start_time))
+            self._dispatch_n += 1
+            if self._dispatch_n % _DISPATCH_EVENT_SAMPLE == 1 \
+                    or _DISPATCH_EVENT_SAMPLE == 1 or tpu_ids:
+                events_mod.emit(
+                    "scheduler", f"dispatch {spec.get('name', 'task')}",
+                    severity="DEBUG", entity_id=spec["task_id"].hex(),
+                    node=ns.node_id, worker=w.worker_id.hex(),
+                    tpus=len(tpu_ids), sample=_DISPATCH_EVENT_SAMPLE)
         self._queue_execute(w, spec, tpu_ids)
 
     def _release_task_resources(self, rt: dict) -> None:
@@ -2647,6 +2741,8 @@ class Node:
             for oid in spec["return_ids"]:
                 self.registry.create_pending(oid)
             self._wake_scheduler()
+        events_mod.emit("actor", f"{info.class_name} -> PENDING_CREATION",
+                        severity="DEBUG", entity_id=spec["actor_id"].hex())
 
     def _schedule_actor_creations_and_tasks(self) -> None:
         spawn_failed: List[Tuple[ActorRuntime, List[dict], Exception]] = []
@@ -2788,6 +2884,11 @@ class Node:
                 # methods queued while the actor was starting dispatch now
                 self._dispatch_actor_next_locked(art)
             self._wake_scheduler()
+        events_mod.emit(
+            "actor",
+            f"{art.info.class_name} -> {'DEAD (creation failed)' if failed else 'ALIVE'}",
+            severity="ERROR" if failed else "INFO",
+            entity_id=spec["actor_id"].hex(), node=art.node_id)
         if failed:
             self._release_spec_pins(art.info.creation_spec)
 
@@ -2876,6 +2977,10 @@ class Node:
                 failed_specs.extend(art.queue)
                 art.queue.clear()
             self._wake_scheduler()
+        events_mod.emit(
+            "actor", f"{info.class_name} -> {info.state} ({reason})",
+            severity="WARNING", entity_id=w.actor_id.hex(),
+            restarts=info.num_restarts)
         if info.state == "DEAD":
             # permanently gone: creation-spec arg pins drop now
             self._release_spec_pins(info.creation_spec)
@@ -3157,8 +3262,12 @@ class Node:
     # ------------------------------------------------------------------
     # introspection
     # ------------------------------------------------------------------
-    def _list_state(self, what: str, limit: int = 1000) -> List[dict]:
-        """State API backend (experimental/state/api.py:729-1333 analog)."""
+    def _list_state(self, what: str, limit: int = 1000,
+                    filters: Optional[dict] = None) -> List[dict]:
+        """State API backend (experimental/state/api.py:729-1333 analog).
+        ``filters`` (events only: source/severity) apply BEFORE the limit
+        truncation — filtering the newest N cluster-wide rows client-side
+        would hide a rare WARNING behind thousands of sampled DEBUGs."""
 
         def rows(items):
             out = []
@@ -3195,6 +3304,17 @@ class Node:
         if what == "jobs":
             mgr = getattr(self, "job_manager", None)
             return mgr.list_jobs() if mgr else []
+        if what == "events":
+            # worker-shipped table + the head's own ring, one timeline
+            src = (filters or {}).get("source")
+            sev = (filters or {}).get("severity")
+            rows = self.events.list(limit, source=src, severity=sev)
+            rows.extend(
+                dict(r, origin="head") for r in events_mod.local_events()
+                if (src is None or r.get("source") == src)
+                and (sev is None or r.get("severity") == sev))
+            rows.sort(key=lambda r: r.get("ts", 0.0))
+            return rows[-limit:]
         raise ValueError(f"unknown state table {what!r}")
 
     def _state_snapshot(self) -> dict:
@@ -3216,6 +3336,10 @@ class Node:
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
         self._shutdown = True
+        try:
+            self._dump_head_events()  # final increment of the crash trail
+        except Exception:
+            pass
         if self._forkserver is not None:
             self._forkserver.close()
         try:

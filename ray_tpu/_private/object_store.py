@@ -31,10 +31,44 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple, Union
 
+from ray_tpu._private import events as _events
 from ray_tpu._private import serialization
 from ray_tpu._private.config import get_config
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.shm import ShmSegment, session_shm_name
+
+# Lazy put/get latency histograms (registered on first use; observation is
+# skipped entirely when the observability layer is disabled).
+_STORE_METRICS = None
+# shm puts at least this big get a flight-recorder event (arena/ingest
+# pressure visibility without an event per small put)
+_PUT_EVENT_MIN_BYTES = 1 << 20
+# Payloads below this observe their latency 1:_SMALL_SAMPLE (a histogram
+# lock on EVERY inline return/get rides the task hot path; big payloads —
+# the interesting tail — always record).  Unlocked counters: a lost race
+# just shifts which call samples.
+_SMALL_SAMPLE_MAX_BYTES = 64 << 10
+_SMALL_SAMPLE = 8
+_put_n = 0
+_get_n = 0
+
+
+def _store_metrics():
+    global _STORE_METRICS
+    if _STORE_METRICS is None:
+        from ray_tpu.util.metrics import Histogram
+
+        bounds = [0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5]
+        note = " (payloads <64KiB sampled 1:8)"
+        _STORE_METRICS = {
+            "put": Histogram("ray_tpu_object_put_latency_s",
+                             "serialize+store latency per object (s)" + note,
+                             boundaries=bounds),
+            "get": Histogram("ray_tpu_object_get_latency_s",
+                             "attach+deserialize latency per object (s)" + note,
+                             boundaries=bounds),
+        }
+    return _STORE_METRICS
 
 
 @dataclass
@@ -442,6 +476,11 @@ class ObjectRegistry:
                 e2.replicas.clear()
                 self._bytes_used -= size
                 self._num_spilled += 1
+                bytes_used = self._bytes_used
+            _events.emit("object_store", "spilled object to disk",
+                         severity="WARNING", entity_id=oid.hex(),
+                         size_mb=round(size / (1 << 20), 2),
+                         bytes_used=bytes_used, capacity=self._capacity)
             ShmSegment.unlink(shm_name)
             if had_replicas and self.broadcast_unlink is not None:
                 # replica copies share the segment name on other nodes;
@@ -607,6 +646,22 @@ def _arena_view(path: str) -> memoryview:
 
 def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[ObjectLocation, list]:
     """Serialize ``value``; write big payloads to shm. Returns (location, contained_refs)."""
+    if not _events.ENABLED:
+        return _store_value(ref, value, is_error)
+    global _put_n
+    t0 = time.perf_counter()
+    out = _store_value(ref, value, is_error)
+    size = out[0].size
+    _put_n += 1
+    if size > _SMALL_SAMPLE_MAX_BYTES or _put_n % _SMALL_SAMPLE == 1:
+        _store_metrics()["put"].observe(time.perf_counter() - t0)
+    if size >= _PUT_EVENT_MIN_BYTES:
+        _events.emit("object_store", "large shm put", severity="DEBUG",
+                     entity_id=ref.hex(), size_mb=round(size / (1 << 20), 2))
+    return out
+
+
+def _store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[ObjectLocation, list]:
     cfg = get_config()
     meta, buffers, refs = serialization.serialize(value)
     total = serialization.total_size(meta, buffers)
@@ -641,6 +696,8 @@ def store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[Obj
                 arena_path=_OWNED_ARENA.path, arena_off=off, arena_key=key,
             ), refs
         # arena full: fall through to the per-object-file path
+        _events.emit("object_store", "arena full; per-object segment fallback",
+                     severity="WARNING", entity_id=ref.hex(), size=total)
     # producer side writes through the fd (page-allocation path, ~2.4x the
     # mmap-memcpy bandwidth on tmpfs); consumers still mmap zero-copy
     name = _write_segment(
@@ -758,6 +815,18 @@ def read_value(loc: ObjectLocation, oid: Optional[bytes] = None) -> Any:
     ``oid`` enables zero-copy reads of arena-backed objects: the views are
     pinned with a head-side reference so the slot can't be recycled under
     them.  Without an oid, arena payloads are copied out for safety."""
+    if not _events.ENABLED:
+        return _read_value(loc, oid)
+    global _get_n
+    t0 = time.perf_counter()
+    value = _read_value(loc, oid)
+    _get_n += 1
+    if loc.size > _SMALL_SAMPLE_MAX_BYTES or _get_n % _SMALL_SAMPLE == 1:
+        _store_metrics()["get"].observe(time.perf_counter() - t0)
+    return value
+
+
+def _read_value(loc: ObjectLocation, oid: Optional[bytes] = None) -> Any:
     if loc.inline is not None:
         value = serialization.deserialize(memoryview(loc.inline))
     elif loc.spilled_path is not None:

@@ -839,7 +839,23 @@ def main() -> None:
     # app metrics recorded in this worker flow to the head's /metrics
     from ray_tpu.util.metrics import MetricsPusher
 
-    _metrics_pusher = MetricsPusher(client.send, origin=worker_id.hex()).start()
+    _metrics_pusher = MetricsPusher(
+        client.send, origin=worker_id.hex(),
+        closed_fn=lambda: client.closed).start()
+
+    # flight-recorder events ship to the head's event table; the pusher
+    # also rewrites this worker's crash-dump file each cycle, so a
+    # SIGKILL'd worker leaves its last-flushed ring in the log dir
+    from ray_tpu._private import events as events_mod
+
+    _events_dump = None
+    _session_dir = os.environ.get("RAY_TPU_SESSION_DIR")
+    if _session_dir:
+        _events_dump = os.path.join(
+            _session_dir, "logs", f"events-worker-{worker_id.hex()}.jsonl")
+    _events_pusher = events_mod.EventsPusher(
+        client.send, origin=worker_id.hex(), dump_path=_events_dump,
+        closed_fn=lambda: client.closed).start()
 
     # Threaded/async actor support: with max_concurrency > 1 the head
     # pipelines up to N methods at us; a BoundedExecutor-analog pool runs
@@ -933,6 +949,7 @@ def main() -> None:
         pool.shutdown(wait=False)
     if _profiler is not None:
         _dump_profile()  # os._exit skips atexit
+    _events_pusher.stop()  # final ship + crash-dump before the hard exit
     client.close()
     os._exit(0)
 

@@ -23,6 +23,21 @@ _tls = threading.local()
 _global_lock = threading.Lock()
 _global_session: Optional["_Session"] = None
 
+_STEP_TIME_HIST = None
+
+
+def _step_time_hist():
+    global _STEP_TIME_HIST
+    if _STEP_TIME_HIST is None:
+        from ray_tpu.util.metrics import Histogram
+
+        _STEP_TIME_HIST = Histogram(
+            "ray_tpu_train_step_time_s",
+            "wall time between consecutive session.report calls (s)",
+            boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30, 120],
+            tag_keys=("rank",))
+    return _STEP_TIME_HIST
+
 
 class _Session:
     def __init__(
@@ -40,8 +55,23 @@ class _Session:
         self.dataset_shards = dataset_shards or {}
         self._report_fn = report_fn  # callable(metrics, checkpoint)
         self.stop_event = stop_event
+        self._last_report_t: Optional[float] = None
 
     def report(self, metrics: Dict[str, Any], checkpoint: Optional[Checkpoint] = None):
+        from ray_tpu._private import events as _events
+
+        if _events.ENABLED:
+            # report() runs once per step in the canonical train loop, so
+            # the inter-report gap IS the step time (ingest wait included;
+            # the ingest-wait counter isolates that share)
+            import time as _time
+
+            now = _time.perf_counter()
+            if self._last_report_t is not None:
+                _step_time_hist().observe(
+                    now - self._last_report_t,
+                    tags={"rank": str(self.world_rank)})
+            self._last_report_t = now
         if self._report_fn is not None:
             self._report_fn(metrics, checkpoint)
 

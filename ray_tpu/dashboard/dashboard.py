@@ -84,10 +84,11 @@ _INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
 <div id="foot">auto-refresh 2s &middot; JSON API: /api/&lt;table&gt;[/&lt;id&gt;],
  /api/cluster_status, /api/serve/applications, /api/logs[/&lt;stream&gt;],
  <a href="/api/timeline">/api/timeline</a> (chrome://tracing),
- /api/profile?duration=3[&amp;worker_id=], /metrics</div>
+ <a href="/api/events">/api/events</a> (flight recorder),
+ /api/grafana_dashboard, /api/profile?duration=3[&amp;worker_id=], /metrics</div>
 <script>
 const TABS=["nodes","actors","tasks","workers","objects","placement_groups",
-            "jobs","serve","logs"];
+            "jobs","serve","events","logs"];
 const ID_FIELD={nodes:"node_id",actors:"actor_id",tasks:"task_id",
  workers:"worker_id",placement_groups:"pg_id",jobs:"job_id"};
 let tab="nodes",timer=null;
@@ -325,12 +326,24 @@ class Dashboard:
         if what == "serve/applications":
             return self._serve_status()
         if what == "timeline":
-            # chrome-trace of task events (``ray_tpu timeline`` over HTTP;
-            # open in chrome://tracing / perfetto)
-            from ray_tpu.util.timeline import events_from_task_rows
+            # chrome-trace of task events merged with streaming/collective/
+            # serve spans from the flight recorder (``ray_tpu timeline``
+            # over HTTP; open in chrome://tracing / perfetto)
+            from ray_tpu.util.timeline import merged_timeline
 
-            return events_from_task_rows(
-                node._list_state("tasks", 100_000))
+            # _jsonable: recorder-event args may carry arbitrary app
+            # payloads (numpy scalars) that plain json.dumps rejects
+            return _jsonable(merged_timeline(
+                node._list_state("tasks", 100_000),
+                node._list_state("events", 100_000)))
+        if what == "grafana_dashboard":
+            # dashboard-as-code from the live registry (the reference's
+            # metrics/grafana_dashboard_factory.py analog)
+            from ray_tpu.dashboard.grafana_dashboard_factory import (
+                generate_grafana_dashboard,
+            )
+
+            return generate_grafana_dashboard(self._merged_snapshot())
         if what == "logs":
             return self._log_streams()
         if what == "serve/config":
@@ -555,29 +568,51 @@ class Dashboard:
         except Exception as e:  # noqa: BLE001
             return {"error": f"serve controller unavailable: {type(e).__name__}: {e}"}
 
-    def _metrics_text(self) -> str:
+    def _merged_snapshot(self) -> dict:
+        """Head registry + worker-reported metrics, with runtime gauges
+        refreshed at scrape time (metric_defs.cc analog)."""
         node = self.node
         from ray_tpu.util.metrics import Gauge
 
-        # refresh runtime gauges at scrape time (metric_defs.cc analog)
         g = Gauge("ray_tpu_objects_in_store", "objects tracked by the registry")
         stats = node.registry.stats()
         g.set(stats["num_objects"])
         Gauge("ray_tpu_object_store_bytes", "head-local shm bytes").set(stats["bytes_used"])
+        Gauge("ray_tpu_objects_spilled", "objects spilled to disk").set(
+            stats.get("num_spilled", 0))
+        arena = getattr(node, "arena", None)
+        if arena is not None:
+            try:
+                astats = arena.stats()
+                Gauge("ray_tpu_arena_bytes_used",
+                      "native arena bytes allocated").set(astats["bytes_used"])
+                Gauge("ray_tpu_arena_capacity_bytes",
+                      "native arena capacity").set(astats["capacity"])
+            except Exception:
+                pass
         with node.lock:
             n_workers = len([w for w in node.workers.values() if w.state != "dead"])
             n_nodes = len([ns for ns in node.nodes.values() if ns.alive])
+            n_pending = len(node.pending_tasks)
         Gauge("ray_tpu_num_workers", "live workers").set(n_workers)
         Gauge("ray_tpu_num_nodes", "alive nodes").set(n_nodes)
+        Gauge("ray_tpu_sched_queue_depth",
+              "tasks pending cluster-wide (not yet staged on a node)").set(n_pending)
+        for src, n in node.events.counts().items():
+            Gauge("ray_tpu_events_recorded",
+                  "flight-recorder events held per source").set(
+                n, tags={"source": src})
         with node.gcs.lock:
             for state in ("PENDING", "RUNNING", "FINISHED", "FAILED"):
                 n = sum(1 for t in node.gcs.tasks.values() if t.state == state)
                 Gauge("ray_tpu_tasks", "tasks by state").set(n, tags={"state": state})
-        merged = metrics_mod.merge_snapshots(
+        return metrics_mod.merge_snapshots(
             metrics_mod.registry().snapshot(),
             node.worker_metrics_registry.snapshot(),
         )
-        return metrics_mod.prometheus_text(merged)
+
+    def _metrics_text(self) -> str:
+        return metrics_mod.prometheus_text(self._merged_snapshot())
 
     def close(self) -> None:
         try:

@@ -23,10 +23,36 @@ import threading
 import time
 from typing import Any, Iterator, List, Optional
 
+from ray_tpu._private import events as _events
 from ray_tpu.data._streaming.operators import (
     build_streaming_topology,
     pick_split,
 )
+
+# Lazy streaming metric singletons (Counter tags: op).
+_STREAM_METRICS = None
+# flight-recorder stall events are throttled to one per this window per
+# executor — the stall-time counter carries the exact accounting
+_STALL_EVENT_MIN_INTERVAL_S = 1.0
+
+
+def _stream_metrics():
+    global _STREAM_METRICS
+    if _STREAM_METRICS is None:
+        from ray_tpu.util.metrics import Counter
+
+        _STREAM_METRICS = {
+            "blocks": Counter("ray_tpu_streaming_blocks_total",
+                              "blocks submitted per operator",
+                              tag_keys=("op",)),
+            "stall": Counter("ray_tpu_streaming_stall_s_total",
+                             "pump seconds stalled on backpressure",
+                             tag_keys=("op",)),
+            "starved": Counter("ray_tpu_streaming_consumer_wait_s_total",
+                               "consumer seconds blocked on an empty split",
+                               tag_keys=("op",)),
+        }
+    return _STREAM_METRICS
 
 # Per-split in-flight block budget.  8 blocks of a typical 32 MB block is
 # a 256 MB window per consumer: deep enough to hide task latency, bounded
@@ -94,6 +120,12 @@ class StreamingExecutor:
         # observability: the largest in-flight total ever observed, so the
         # backpressure contract is assertable from the outside
         self.max_in_flight_observed = 0
+        # flight recorder: per-ref submit times (operator span = submit ->
+        # consumer delivery), bounded by the in-flight budget; plus stall
+        # accounting and event throttling
+        self._span_t0: dict = {}
+        self._stall_s = 0.0
+        self._last_stall_event = 0.0
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "StreamingExecutor":
@@ -120,10 +152,27 @@ class StreamingExecutor:
             q.put(_EOF)
 
     # -- consumer side -------------------------------------------------
+    def _op_name(self) -> str:
+        return self._map_op.name if self._map_op is not None else "source"
+
     def get_next(self, split: int = 0, timeout: Optional[float] = None):
         """Next output ref for ``split``; ``None`` at end of stream."""
         self.start()
+        # captured ONCE: ENABLED is mutable module state (the overhead
+        # bench flips it at runtime) and an off->on flip mid-get must not
+        # turn t0==0.0 into hours of bogus recorded wait
+        enabled = _events.ENABLED
+        t0 = time.perf_counter() if enabled else 0.0
         item = self._queues[split].get(timeout=timeout)
+        if enabled:
+            waited = time.perf_counter() - t0
+            if waited > 0.05:
+                # split starvation: the consumer outran the pipeline
+                _stream_metrics()["starved"].inc(
+                    waited, tags={"op": self._op_name()})
+                _events.emit("streaming", "split starved",
+                             severity="DEBUG", entity_id=str(split),
+                             wait_s=round(waited, 4), op=self._op_name())
         if item is _EOF:
             self._queues[split].put(_EOF)  # repeated polls stay terminal
             self._maybe_finalize()
@@ -135,6 +184,13 @@ class StreamingExecutor:
             self._in_flight[split] -= 1
             self._delivered[split] += 1
             self._cond.notify_all()
+        if enabled:
+            sub_t = self._span_t0.pop(id(item), None)
+            if sub_t is not None:
+                # operator span: submit -> delivery, a timeline slice
+                _events.emit("streaming", self._op_name(), severity="DEBUG",
+                             entity_id=str(split),
+                             span_dur=time.perf_counter() - sub_t)
         return item
 
     def iter_refs(self, split: int = 0) -> Iterator[Any]:
@@ -153,6 +209,7 @@ class StreamingExecutor:
     def _acquire_split(self, block_rows: Optional[int]) -> Optional[int]:
         """Block until some split has budget room; returns it (or None on
         stop).  A stalled split never blocks the others."""
+        t0 = time.perf_counter()
         with self._cond:
             while not self._stop.is_set():
                 room = [i for i in range(self._n)
@@ -168,9 +225,27 @@ class StreamingExecutor:
                     total = sum(self._in_flight)
                     if total > self.max_in_flight_observed:
                         self.max_in_flight_observed = total
+                    if _events.ENABLED:
+                        waited = time.perf_counter() - t0
+                        if waited > 0.001:
+                            self._record_stall(waited)
                     return split
                 self._cond.wait(timeout=0.2)
         return None
+
+    def _record_stall(self, waited: float) -> None:
+        """Backpressure accounting: the pump sat blocked on every split's
+        budget for ``waited`` seconds (cond lock held)."""
+        self._stall_s += waited
+        _stream_metrics()["stall"].inc(waited, tags={"op": self._op_name()})
+        now = time.perf_counter()
+        if now - self._last_stall_event >= _STALL_EVENT_MIN_INTERVAL_S:
+            self._last_stall_event = now
+            _events.emit(
+                "streaming", "backpressure stall", severity="DEBUG",
+                op=self._op_name(), stalled_s=round(waited, 4),
+                total_stalled_s=round(self._stall_s, 3),
+                in_flight=list(self._in_flight), budget=self._budget)
 
     def _pump(self) -> None:
         try:
@@ -192,6 +267,9 @@ class StreamingExecutor:
         hint = self._hints[split] if self._hints else None
         out = (self._map_op.submit(ref, hint)
                if self._map_op is not None else ref)
+        if _events.ENABLED:
+            _stream_metrics()["blocks"].inc(tags={"op": self._op_name()})
+            self._span_t0[id(out)] = time.perf_counter()
         self._out_refs[split].append(out)
         self._queues[split].put(out)
 
@@ -302,4 +380,5 @@ class StreamingExecutor:
             "max_in_flight_observed": self.max_in_flight_observed,
             "produced_blocks": sum(len(r) for r in self._out_refs),
             "delivered_blocks": sum(self._delivered),
+            "stalled_s": round(self._stall_s, 4),
         }

@@ -98,12 +98,29 @@ def batches_from_block_iter(
     t = threading.Thread(target=fetcher, daemon=True,
                          name="iter-batches-prefetch")
     t.start()
+    from ray_tpu._private import events as _events
+
+    ingest_wait_counter = None
+    if _events.ENABLED:
+        from ray_tpu.util.metrics import Counter
+
+        ingest_wait_counter = Counter(
+            "ray_tpu_data_ingest_wait_s_total",
+            "consumer seconds blocked waiting for the next block "
+            "(train ingest-wait)")
     try:
         # the carry and all slicing stay columnar for table blocks —
         # numpy views, no per-row python objects on the hot path
         carry: Optional[Block] = None
+        import time as _time
+
         while True:
+            t0 = _time.perf_counter() if ingest_wait_counter else 0.0
             item = q.get()
+            if ingest_wait_counter is not None:
+                waited = _time.perf_counter() - t0
+                if waited > 1e-4:
+                    ingest_wait_counter.inc(waited)
             if item is SENTINEL:
                 break
             if isinstance(item, BaseException):

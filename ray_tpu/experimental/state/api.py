@@ -21,8 +21,11 @@ def _client():
     return global_worker.client
 
 
-def _list(what: str, limit: int) -> List[dict]:
-    reply = _client().request({"type": "list_state", "what": what, "limit": limit})
+def _list(what: str, limit: int, filters: Optional[dict] = None) -> List[dict]:
+    msg = {"type": "list_state", "what": what, "limit": limit}
+    if filters:
+        msg["filters"] = filters
+    reply = _client().request(msg)
     return reply["value"]
 
 
@@ -52,6 +55,29 @@ def list_workers(limit: int = 1000) -> List[dict]:
 
 def list_jobs(limit: int = 1000) -> List[dict]:
     return _list("jobs", limit)
+
+
+def list_events(limit: int = 1000, source: Optional[str] = None,
+                severity: Optional[str] = None) -> List[dict]:
+    """Flight-recorder events from the head's cluster-wide event table
+    (scheduler dispatches, spills, OOM kills, backpressure stalls, slot
+    admissions...), oldest-first.  ``source``/``severity`` filter
+    HEAD-SIDE, before the limit — a rare WARNING stays findable behind
+    thousands of newer sampled DEBUG rows."""
+    filters = {}
+    if source is not None:
+        filters["source"] = source
+    if severity is not None:
+        filters["severity"] = severity
+    return _list("events", limit, filters or None)
+
+
+def summarize_events() -> Dict[str, Dict[str, int]]:
+    """Event counts grouped by source and severity."""
+    by_source: Dict[str, Counter] = {}
+    for e in list_events(limit=100_000):
+        by_source.setdefault(e["source"], Counter())[e["severity"]] += 1
+    return {src: dict(sev) for src, sev in by_source.items()}
 
 
 def summarize_tasks() -> Dict[str, Dict[str, int]]:

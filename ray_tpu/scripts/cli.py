@@ -12,6 +12,7 @@ Reference: ``python/ray/scripts/scripts.py`` (cluster lifecycle) and
     submit -- <entrypoint...>                  submit a job
     job-logs <job_id> / job-stop <job_id>
     timeline [--out FILE]                      chrome-trace of task events
+    events [--source S --severity L --limit N] flight-recorder event table
     serve-status                               serve deployments + autoscaling
 """
 
@@ -173,6 +174,22 @@ def cmd_timeline(args) -> None:
     print(f"wrote chrome trace to {path} (open in chrome://tracing)")
 
 
+def cmd_events(args) -> None:
+    """Flight-recorder events (``ray list cluster-events`` analog): the
+    head's merged per-source event table — dispatch decisions, spills,
+    OOM kills, stalls, admissions — as JSON lines or a summary."""
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    if args.summary:
+        print(json.dumps(state.summarize_events(), indent=2))
+        return
+    rows = state.list_events(limit=args.limit, source=args.source,
+                             severity=args.severity)
+    for r in rows:
+        print(json.dumps(r, default=repr))
+
+
 def cmd_serve_status(_args) -> None:
     """``serve status`` analog over the running cluster."""
     rt = _connect()
@@ -291,6 +308,18 @@ def main(argv=None) -> None:
     s = sub.add_parser("timeline", help="dump chrome-trace task timeline")
     s.add_argument("--out", default=None)
     s.set_defaults(fn=cmd_timeline)
+
+    s = sub.add_parser(
+        "events", help="flight-recorder events (cluster event table)")
+    s.add_argument("--source", default=None,
+                   help="filter: scheduler|object_store|streaming|serve|"
+                        "train|actor|worker_pool|node|collective")
+    s.add_argument("--severity", default=None,
+                   help="filter: DEBUG|INFO|WARNING|ERROR")
+    s.add_argument("--limit", type=int, default=200)
+    s.add_argument("--summary", action="store_true",
+                   help="counts by source/severity instead of rows")
+    s.set_defaults(fn=cmd_events)
 
     sub.add_parser(
         "serve-status", help="serve deployments + autoscaling state"

@@ -16,7 +16,32 @@ import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from ray_tpu._private import events as _events
 from ray_tpu.serve.config import ROUTE_TABLE_TTL_S
+
+# Lazy router metric singletons (tags: deployment).
+_ROUTER_METRICS = None
+# long-stall flight-recorder events are throttled per router
+_STALL_EVENT_MIN_INTERVAL_S = 1.0
+
+
+def _router_metrics():
+    global _ROUTER_METRICS
+    if _ROUTER_METRICS is None:
+        from ray_tpu.util.metrics import Gauge, Histogram
+
+        _ROUTER_METRICS = {
+            "admission": Histogram(
+                "ray_tpu_serve_admission_latency_s",
+                "request arrival -> replica assignment latency (s)",
+                boundaries=[0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5],
+                tag_keys=("deployment",)),
+            "queue_len": Gauge(
+                "ray_tpu_serve_router_queue_len",
+                "requests waiting for a replica in this router",
+                tag_keys=("deployment",)),
+        }
+    return _ROUTER_METRICS
 
 
 class Router:
@@ -87,6 +112,14 @@ class Router:
             self._ref_tags = {
                 oid: tag for oid, tag in self._ref_tags.items() if tag in live
             }
+
+    def _set_queue_gauge(self) -> None:
+        """Mirror ``_pending`` into the router queue-length gauge (lock
+        held).  Set on every transition — a gauge updated only on arrival
+        would freeze at the last burst's peak forever."""
+        if _events.ENABLED:
+            _router_metrics()["queue_len"].set(
+                self._pending, tags={"deployment": self._name})
 
     def _push_metrics(self) -> None:
         """Throttled fire-and-forget ongoing-request report feeding the
@@ -186,10 +219,13 @@ class Router:
         from ray_tpu.exceptions import GetTimeoutError
 
         deadline = time.monotonic() + timeout if timeout is not None else None
+        t_arrival = time.perf_counter()
+        stall_reported = False
         self._ensure_listener()
         force = False
         with self._lock:
             self._pending += 1  # queued demand, visible to the autoscaler
+            self._set_queue_gauge()
         assigned = False
         try:
             pruned = False
@@ -208,15 +244,33 @@ class Router:
                     if picked is not None:
                         tag, handle = picked
                         self._pending -= 1
+                        self._set_queue_gauge()
                         assigned = True
                         ref = handle.handle_request.remote(method_name, args, kwargs)
                         self._inflight.setdefault(tag, {})[ref.binary()] = ref
                         self._ref_tags[ref.binary()] = tag
                         self._push_metrics()
+                        if _events.ENABLED:
+                            waited = time.perf_counter() - t_arrival
+                            _router_metrics()["admission"].observe(
+                                waited, tags={"deployment": self._name})
+                            # serve-admission span: arrival -> assignment
+                            _events.emit(
+                                "serve", f"admission {self._name}",
+                                severity="DEBUG", entity_id=tag,
+                                span_dur=waited)
                         return (ref, handle) if return_replica else ref
                     self._push_metrics()
                     waitable = [r for refs in self._inflight.values()
                                 for r in refs.values()]
+                if _events.ENABLED and not stall_reported \
+                        and time.perf_counter() - t_arrival > _STALL_EVENT_MIN_INTERVAL_S:
+                    stall_reported = True
+                    _events.emit(
+                        "serve", "router stalled: no replica available",
+                        severity="WARNING", entity_id=self._name,
+                        pending=self._pending,
+                        replicas=len(self._replicas))
                 if deadline is not None and time.monotonic() >= deadline:
                     raise GetTimeoutError(
                         f"no replica of {self._name!r} available within {timeout}s"
@@ -232,6 +286,7 @@ class Router:
             if not assigned:
                 with self._lock:
                     self._pending -= 1
+                    self._set_queue_gauge()
 
     def on_replica_error(self, ref) -> None:
         """Caller observed a RayActorError from ``ref``: evict that replica

@@ -14,6 +14,7 @@ from typing import Any, Callable, Dict, List, Optional
 import cloudpickle
 
 import ray_tpu
+from ray_tpu._private import events as _events
 from ray_tpu.air import Checkpoint, ScalingConfig
 from ray_tpu.train.backend import Backend, BackendConfig
 from ray_tpu.train.worker_group import WorkerGroup
@@ -37,10 +38,16 @@ class BackendExecutor:
 
     def start(self) -> None:
         sc = self.scaling_config
+        self._gang_starts = getattr(self, "_gang_starts", 0) + 1
         self.worker_group = WorkerGroup(
             sc.num_workers, sc.worker_resources, sc.placement_strategy
         )
         self.backend.on_start(self.worker_group, self.backend_config)
+        _events.emit(
+            "train",
+            "gang restarted" if self._gang_starts > 1 else "gang started",
+            severity="WARNING" if self._gang_starts > 1 else "INFO",
+            world_size=sc.num_workers, start_no=self._gang_starts)
 
     def worker_node_ids(self) -> List[str]:
         """Which node each rank's actor landed on (the locality input to
@@ -131,12 +138,18 @@ class BackendExecutor:
                     # in-loop exception — fit()'s whole-gang restart must
                     # see one error type.  Other RayErrors (get timeouts,
                     # cancellations) are NOT deaths and propagate as-is.
+                    _events.emit("train", f"gang failure: rank {i} died",
+                                 severity="ERROR", rank=i,
+                                 error=f"{type(e).__name__}: {e}"[:200])
                     raise TrainingFailedError(
                         f"worker {i} died: {type(e).__name__}: {e}"
                     ) from e
                 if kind == "pending":
                     continue
                 if kind == "error":
+                    _events.emit("train", f"gang failure: rank {i} errored",
+                                 severity="ERROR", rank=i,
+                                 error=str(payload)[:200])
                     raise TrainingFailedError(
                         f"worker {i} failed:\n{payload}"
                     )

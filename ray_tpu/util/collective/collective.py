@@ -20,6 +20,7 @@ broadcast / send / recv``) with two backends:
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -29,6 +30,30 @@ import ray_tpu
 # Process-global: a worker joins a group once and may drive it from any
 # thread (train loops run on their own thread inside the hosting actor).
 _GROUPS: Dict[str, object] = {}
+
+_COLLECTIVE_HIST = None
+
+
+def _record_collective(group: str, op: str, rank: int, round_id: int,
+                       dur_s: float) -> None:
+    """Flight-recorder span + latency histogram for one collective round
+    (the timeline merges these next to task slices)."""
+    from ray_tpu._private import events as _events
+
+    if not _events.ENABLED:
+        return
+    global _COLLECTIVE_HIST
+    if _COLLECTIVE_HIST is None:
+        from ray_tpu.util.metrics import Histogram
+
+        _COLLECTIVE_HIST = Histogram(
+            "ray_tpu_collective_latency_s",
+            "host-collective round latency (s)",
+            boundaries=[0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 30],
+            tag_keys=("op",))
+    _COLLECTIVE_HIST.observe(dur_s, tags={"op": op})
+    _events.emit("collective", f"{op} ({group})", severity="DEBUG",
+                 entity_id=f"rank-{rank}", span_dur=dur_s, round=round_id)
 
 
 def _groups() -> Dict[str, object]:
@@ -143,10 +168,14 @@ class _GroupHandle:
         with self._round_lock:
             rid = self.round_id
             self.round_id += 1
-        return ray_tpu.get(
+        t0 = time.perf_counter()
+        out = ray_tpu.get(
             self.coordinator.collect.remote(rid, self.rank, value, op),
             timeout=timeout,
         )
+        _record_collective(self.name, op, self.rank, rid,
+                           time.perf_counter() - t0)
+        return out
 
     def send(self, tensor, dst_rank: int) -> None:
         ray_tpu.get(self.coordinator.p2p_put.remote(self.rank, dst_rank, tensor))
@@ -195,6 +224,14 @@ class _XlaGroup:
         )
 
     def _run(self, value, op: str, timeout: float = 120.0):
+        t0 = time.perf_counter()
+        try:
+            return self._run_inner(value, op)
+        finally:
+            _record_collective(self.name, op, self.rank, -1,
+                               time.perf_counter() - t0)
+
+    def _run_inner(self, value, op: str):
         from jax.experimental import multihost_utils
 
         if op == "barrier":

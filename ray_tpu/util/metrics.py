@@ -10,6 +10,7 @@ merged registry in Prometheus text exposition format at ``/metrics``.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -80,6 +81,10 @@ class Counter(Metric):
     _TYPE = "counter"
 
     def inc(self, value: float = 1.0, tags: Optional[Dict[str, str]] = None) -> None:
+        if value < 0:
+            raise ValueError(
+                f"Counter.inc() takes a non-negative value, got {value} "
+                "(counters are monotone; use a Gauge for values that fall)")
         key = self._key(tags)
         with _global.lock:
             vals = self._m["values"]
@@ -115,8 +120,6 @@ class Histogram(Metric):
                 h = {"buckets": [0] * (len(self.boundaries) + 1),
                      "bounds": self.boundaries, "sum": 0.0, "count": 0}
                 vals[key] = h
-            import bisect
-
             h["buckets"][bisect.bisect_left(self.boundaries, value)] += 1
             h["sum"] += value
             h["count"] += 1
@@ -138,6 +141,14 @@ def merge_snapshots(*snaps: Dict[str, dict]) -> Dict[str, dict]:
     return out
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus exposition escaping for label values: backslash, double
+    quote, and newline must be escaped or a crafted value (e.g. a
+    user-chosen deployment name) corrupts the whole scrape."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
 def prometheus_text(snap: Optional[Dict[str, dict]] = None) -> str:
     """Render a registry snapshot in Prometheus exposition format (the
     ``prometheus_exporter.py`` analog)."""
@@ -148,7 +159,7 @@ def prometheus_text(snap: Optional[Dict[str, dict]] = None) -> str:
             out.append(f"# HELP {name} {m['help']}")
         out.append(f"# TYPE {name} {m['type']}")
         for key, value in sorted(m["values"].items()):
-            labels = ",".join(f'{k}="{v}"' for k, v in key)
+            labels = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
             suffix = f"{{{labels}}}" if labels else ""
             if m["type"] == "histogram" and isinstance(value, dict):
                 acc = 0
@@ -165,12 +176,19 @@ def prometheus_text(snap: Optional[Dict[str, dict]] = None) -> str:
 
 class MetricsPusher:
     """Background thread shipping this process's registry to the head
-    (the per-node metrics-agent push path)."""
+    (the per-node metrics-agent push path).
 
-    def __init__(self, send_fn, origin: str, interval_s: float = 5.0):
+    Send failures are retried with bounded exponential backoff — a
+    transient head hiccup (GC pause, reconnect) must not permanently
+    silence this process's metrics.  The loop only exits when
+    :meth:`stop` is called or ``closed_fn`` reports the client closed."""
+
+    def __init__(self, send_fn, origin: str, interval_s: float = 5.0,
+                 closed_fn=None):
         self._send = send_fn
         self._origin = origin
         self._interval = interval_s
+        self._closed = closed_fn
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="metrics-pusher")
@@ -180,15 +198,20 @@ class MetricsPusher:
         return self
 
     def _loop(self) -> None:
-        while not self._stop.wait(self._interval):
+        backoff = self._interval
+        while not self._stop.wait(backoff):
+            if self._closed is not None and self._closed():
+                return
             snap = _global.snapshot()
             if not snap:
+                backoff = self._interval
                 continue
             try:
                 self._send({"type": "metrics_report", "origin": self._origin,
                             "metrics": snap})
+                backoff = self._interval
             except Exception:
-                return
+                backoff = min(30.0, backoff * 2)
 
     def stop(self) -> None:
         self._stop.set()

@@ -21,7 +21,83 @@ def timeline_events() -> List[dict]:
     tasks = global_worker.client.request(
         {"type": "list_state", "what": "tasks", "limit": 100_000}
     )["value"]
-    return events_from_task_rows(tasks)
+    try:
+        recorder = global_worker.client.request(
+            {"type": "list_state", "what": "events", "limit": 100_000}
+        )["value"]
+    except Exception:
+        recorder = []  # older head without an event table
+    return merged_timeline(tasks, recorder)
+
+
+def merged_timeline(tasks: List[dict], recorder_rows: List[dict]) -> List[dict]:
+    """One trace: task/queue slices (+ flow arrows) interleaved with
+    flight-recorder spans — streaming-operator, collective, and
+    serve-admission slices land on per-source rows next to the tasks
+    that caused them.  Perfetto/chrome load the merged list directly."""
+    events = events_from_task_rows(tasks)
+    events.extend(events_from_recorder_rows(recorder_rows))
+    events.extend(_metadata_events(events))
+    return events
+
+
+def events_from_recorder_rows(rows: List[dict]) -> List[dict]:
+    """Flight-recorder events as chrome-trace events: span events
+    (``span_dur`` covers [ts - dur, ts]) become "X" slices; point events
+    become instants."""
+    out: List[dict] = []
+    for r in rows:
+        ts = r.get("ts")
+        source = r.get("source")
+        if ts is None or source is None:
+            continue
+        pid = f"recorder:{source}"
+        tid = str(r.get("origin") or r.get("entity_id") or "events")
+        args = {"severity": r.get("severity")}
+        if r.get("entity_id"):
+            args["entity_id"] = r["entity_id"]
+        if r.get("data"):
+            args.update(r["data"])
+        dur = r.get("span_dur")
+        if dur:
+            out.append({
+                "name": r.get("message", source), "cat": source, "ph": "X",
+                "ts": (ts - dur) * 1e6, "dur": dur * 1e6,
+                "pid": pid, "tid": tid, "args": args,
+            })
+        else:
+            out.append({
+                "name": r.get("message", source), "cat": source, "ph": "i",
+                "s": "t", "ts": ts * 1e6, "pid": pid, "tid": tid,
+                "args": args,
+            })
+    return out
+
+
+def _metadata_events(events: List[dict]) -> List[dict]:
+    """Chrome-trace ``M`` metadata so Perfetto labels rows with node ids
+    and worker pids instead of raw hex/ints."""
+    by_pid: dict = {}
+    for e in events:
+        pid = e.get("pid")
+        if pid is None:
+            continue
+        tids = by_pid.setdefault(pid, set())
+        if e.get("tid") is not None:
+            tids.add(e["tid"])
+    out: List[dict] = []
+    for pid, tids in by_pid.items():
+        if isinstance(pid, str) and pid.startswith("recorder:"):
+            pname = f"flight recorder · {pid[len('recorder:'):]}"
+        else:
+            pname = f"node {pid}"
+        out.append({"name": "process_name", "ph": "M", "ts": 0, "pid": pid,
+                    "args": {"name": pname}})
+        for tid in tids:
+            tname = f"worker pid {tid}" if isinstance(tid, int) else str(tid)
+            out.append({"name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
+                        "tid": tid, "args": {"name": tname}})
+    return out
 
 
 def events_from_task_rows(tasks: List[dict]) -> List[dict]:
@@ -102,5 +178,7 @@ def timeline_dump(path: Optional[str] = None) -> str:
     if parent:
         os.makedirs(parent, exist_ok=True)
     with open(path, "w") as f:
-        json.dump(timeline_events(), f)
+        # default=repr: recorder-event args can carry arbitrary app
+        # payloads (numpy scalars) and the dump must still be valid JSON
+        json.dump(timeline_events(), f, default=repr)
     return path
