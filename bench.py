@@ -551,6 +551,90 @@ print("OBSRESULT " + json.dumps(
 """
 
 
+def run_compiled_dag_bench() -> dict:
+    """compiled_dag_roundtrip row: per-call latency of a 4-actor chain
+    three ways — compiled execution graph (pre-allocated channels, zero
+    scheduler involvement per call), dynamic ``dag.execute()`` (every node
+    re-submitted through the head per call), and raw chained actor calls
+    (refs passed between actors).  The compiled p50 must stay >= 5x below
+    the dynamic p50 — that gap IS the subsystem's reason to exist."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.dag import InputNode
+
+    def pcts(lats):
+        lats = sorted(lats)
+        return (lats[len(lats) // 2],
+                lats[min(len(lats) - 1, int(len(lats) * 0.99))])
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    try:
+        @ray_tpu.remote
+        class _Stage:
+            def fwd(self, x):
+                return x
+
+        chain = 4
+
+        def build_dag():
+            with InputNode() as inp:
+                h = inp
+                for _ in range(chain):
+                    h = _Stage.bind().fwd.bind(h)
+            return h
+
+        # raw chained actor calls (refs flow actor-to-actor via the head)
+        actors = [_Stage.remote() for _ in range(chain)]
+        ray_tpu.get([a.fwd.remote(0) for a in actors], timeout=120)
+        raw_lats = []
+        for i in range(100):
+            t0 = time.perf_counter()
+            r = i
+            for a in actors:
+                r = a.fwd.remote(r)
+            ray_tpu.get(r, timeout=60)
+            raw_lats.append(time.perf_counter() - t0)
+
+        # dynamic DAG: full re-submit per execute()
+        dyn = build_dag()
+        ray_tpu.get(dyn.execute(0), timeout=120)  # create actors + warm
+        dyn_lats = []
+        for i in range(100):
+            t0 = time.perf_counter()
+            ray_tpu.get(dyn.execute(i), timeout=60)
+            dyn_lats.append(time.perf_counter() - t0)
+
+        # compiled graph: loops + channels, compiled once
+        cg = build_dag().experimental_compile(max_inflight=4)
+        try:
+            cg.execute(0).get(timeout=120)  # warm the loops
+            cmp_lats = []
+            for i in range(300):
+                t0 = time.perf_counter()
+                cg.execute(i).get(timeout=60)
+                cmp_lats.append(time.perf_counter() - t0)
+        finally:
+            cg.teardown()
+
+        cp50, cp99 = pcts(cmp_lats)
+        dp50, dp99 = pcts(dyn_lats)
+        rp50, rp99 = pcts(raw_lats)
+        return {"compiled_dag_roundtrip": {
+            "chain_actors": chain,
+            "compiled_p50_ms": round(cp50 * 1e3, 3),
+            "compiled_p99_ms": round(cp99 * 1e3, 3),
+            "dynamic_p50_ms": round(dp50 * 1e3, 3),
+            "dynamic_p99_ms": round(dp99 * 1e3, 3),
+            "raw_actor_p50_ms": round(rp50 * 1e3, 3),
+            "raw_actor_p99_ms": round(rp99 * 1e3, 3),
+            "speedup_vs_dynamic": round(dp50 / cp50, 1),
+            "speedup_vs_raw": round(rp50 / cp50, 1),
+        }}
+    finally:
+        ray_tpu.shutdown()
+
+
 def run_observability_overhead() -> dict:
     """observability_overhead row: task throughput with events+metrics
     enabled vs disabled (median of 10 order-alternating paired windows).
@@ -603,6 +687,10 @@ def main() -> None:
         decode_out.update(run_observability_overhead())
     except Exception as e:
         decode_out["observability_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        decode_out.update(run_compiled_dag_bench())
+    except Exception as e:
+        decode_out["compiled_dag_error"] = f"{type(e).__name__}: {e}"[:200]
 
     tps = trainer_out["tokens_per_sec"]
     raw_tps = raw_out["tokens_per_sec"]

@@ -29,9 +29,21 @@ from typing import Dict, List, Optional
 
 SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
 
+# Well-known event sources (informational — the table accepts any source).
+# Kept current so `ray_tpu events --source X` is discoverable; matches the
+# emit sites and the cli.py --source help.  compiled_dag (dag/compiled.py)
+# carries per-node exec spans + channel-wait spans of compiled execution
+# graphs.
+KNOWN_SOURCES = (
+    "scheduler", "node", "actor", "worker_pool", "object_store",
+    "streaming", "serve", "serve_llm", "train", "collective",
+    "compiled_dag",
+)
+
 # Kill switch for the whole observability layer (events + hot-path metric
-# observations).  Read once at import in each process — the bench compares
-# enabled vs disabled runs in fresh subprocesses.
+# observations).  Initialized from the env, but MUTABLE module state read
+# per-emit: the observability_overhead bench flips it at runtime in a live
+# cluster (head + workers), so new instrumentation must not cache it.
 ENABLED = os.environ.get("RAY_TPU_EVENTS", "1") not in ("0", "false", "no")
 
 

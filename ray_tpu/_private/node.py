@@ -984,6 +984,7 @@ class Node:
         "task_id", "name", "fn_id", "args_blob", "args_oid",
         "is_actor_creation", "actor_id", "method_name",
         "num_returns", "return_ids", "trace_ctx", "dynamic_returns",
+        "compiled_graph",
     )
 
     def _agent_node_or_head(self, node_id: str) -> str:

@@ -590,6 +590,18 @@ def _execute_task(msg: dict) -> None:
                 w.task_depth -= 1
             w.current_actor_id = spec["actor_id"]
             results = [None]
+        elif spec.get("compiled_graph"):
+            # compiled-graph control op (dag/compiled.py): a shipped
+            # function run with the actor instance, outside the
+            # method-name lane.  The op returns quickly; any execution
+            # loop it installs runs on its own thread.
+            fn = w.fetch_function(spec["fn_id"])
+            w.task_depth += 1
+            try:
+                out = fn(w.actor_instance, *args, **kwargs)
+            finally:
+                w.task_depth -= 1
+            results = _split_returns(out, spec["num_returns"])
         elif spec.get("actor_id") is not None:
             method = getattr(w.actor_instance, spec["method_name"])
             w.task_depth += 1

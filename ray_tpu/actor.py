@@ -74,6 +74,29 @@ class ActorHandle:
         w.client.submit_actor_task(spec)
         return return_refs[0] if num_returns == 1 else return_refs
 
+    def _submit_compiled_task(self, fn, args: tuple, name: str) -> ObjectRef:
+        """Submit a compiled-graph control task: a module-level ``fn`` that
+        the worker runs with the actor INSTANCE as first argument (spec flag
+        ``compiled_graph``; see ``_private/worker.py``).  Rides the normal
+        per-actor FIFO lane but returns fast — the graph's execution loop
+        itself runs on a dedicated thread the installed op spawns, so
+        repeated ``execute()`` calls never touch this lane again."""
+        w = global_worker
+        blob = cloudpickle.dumps(fn)
+        fn_id = w.register_function(blob)
+        spec, return_refs = w.build_task_spec(
+            name=f"{self._class_name}.{name}",
+            fn_id=fn_id,
+            args=args,
+            kwargs={},
+            num_returns=1,
+            resources={},
+            actor_id=self._actor_id,
+        )
+        spec["compiled_graph"] = True
+        w.client.submit_actor_task(spec)
+        return return_refs[0]
+
     def __reduce__(self):
         return (_rebuild_handle, (self._actor_id, self._class_name, self._method_num_returns))
 

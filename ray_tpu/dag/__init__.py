@@ -14,4 +14,17 @@ from ray_tpu.dag.dag_node import (
     InputNode,
 )
 
-__all__ = ["DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode", "InputNode"]
+__all__ = [
+    "DAGNode", "FunctionNode", "ClassNode", "ClassMethodNode", "InputNode",
+    "CompiledDAG", "CompiledDAGRef", "CompiledGraphError",
+]
+
+
+def __getattr__(name):
+    # compiled-graph types load lazily: the channel/compile machinery is
+    # only paid for by processes that actually compile a graph
+    if name in ("CompiledDAG", "CompiledDAGRef", "CompiledGraphError"):
+        from ray_tpu.dag import compiled
+
+        return getattr(compiled, name)
+    raise AttributeError(name)

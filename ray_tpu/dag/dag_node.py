@@ -41,19 +41,30 @@ class DAGNode:
         return out
 
     def topological(self) -> List["DAGNode"]:
-        """Dependencies-first ordering of the reachable graph."""
-        seen: Dict[int, DAGNode] = {}
+        """Dependencies-first ordering of the reachable graph.
+
+        Iterative post-order DFS: a recursive visit overflows Python's
+        recursion limit around 1k-node chains, and compiled pipeline
+        graphs legitimately get that deep."""
+        seen: Dict[int, DAGNode] = {}  # keeps nodes alive so ids stay unique
         order: List[DAGNode] = []
-
-        def visit(n: "DAGNode"):
+        stack: List[Tuple[DAGNode, bool]] = [(self, False)]
+        while stack:
+            n, emit = stack.pop()
+            if emit:
+                order.append(n)
+                continue
             if id(n) in seen:
-                return
+                continue
             seen[id(n)] = n
-            for c in n._children():
-                visit(c)
-            order.append(n)
-
-        visit(self)
+            stack.append((n, True))
+            # reversed: the stack pops right-to-left, so this preserves the
+            # recursive left-to-right sibling order — workflow checkpoint
+            # step ids are keyed on the topological index and must not
+            # shift across this rewrite
+            for c in reversed(n._children()):
+                if id(c) not in seen:
+                    stack.append((c, False))
         return order
 
     # -- execution -----------------------------------------------------
@@ -70,6 +81,15 @@ class DAGNode:
 
     def _execute_impl(self, args: tuple, kwargs: dict):
         raise NotImplementedError
+
+    def experimental_compile(self, **kwargs) -> "CompiledDAG":
+        """Compile this static DAG once: actors get persistent execution
+        loops, edges become pre-allocated channels, and repeated
+        ``execute()`` calls bypass the scheduler entirely.  See
+        :mod:`ray_tpu.dag.compiled` for semantics and limitations."""
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, **kwargs)
 
     def execute(self, *input_args, **input_kwargs):
         """Run the DAG; returns whatever the root node produces (an
@@ -155,6 +175,15 @@ class ClassNode(DAGNode):
                        if self._options else self._actor_cls)
                 self._handle = cls.remote(*args, **kwargs)
             return self._handle
+
+    def options(self, **opts) -> "ClassNode":
+        """Override actor options on the bound constructor (parity with
+        ``FunctionNode.options``): returns a NEW ClassNode, so methods
+        bound from this one keep targeting the original node/actor."""
+        merged = dict(self._options)
+        merged.update(opts)
+        return ClassNode(self._actor_cls, self._bound_args,
+                         self._bound_kwargs, merged)
 
     def __getattr__(self, name: str) -> "_ClassMethodBinder":
         if name.startswith("_"):

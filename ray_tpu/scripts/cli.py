@@ -313,7 +313,8 @@ def main(argv=None) -> None:
         "events", help="flight-recorder events (cluster event table)")
     s.add_argument("--source", default=None,
                    help="filter: scheduler|object_store|streaming|serve|"
-                        "train|actor|worker_pool|node|collective")
+                        "train|actor|worker_pool|node|collective|"
+                        "serve_llm|compiled_dag")
     s.add_argument("--severity", default=None,
                    help="filter: DEBUG|INFO|WARNING|ERROR")
     s.add_argument("--limit", type=int, default=200)

@@ -35,10 +35,12 @@ class _MethodCaller:
 
 
 class DeploymentHandle:
-    def __init__(self, deployment_name: str, controller_handle=None):
+    def __init__(self, deployment_name: str, controller_handle=None,
+                 method_name: Optional[str] = None):
         self.deployment_name = deployment_name
         self._controller = controller_handle
         self._router = None
+        self._method_name = method_name  # options(method_name=...) override
 
     # -- plumbing ------------------------------------------------------
     def _get_router(self):
@@ -67,34 +69,52 @@ class DeploymentHandle:
 
     # -- public --------------------------------------------------------
     def remote(self, *args, **kwargs):
-        """Route one ``__call__`` request; returns an ObjectRef."""
-        return self._remote("__call__", args, kwargs)
+        """Route one request to ``__call__`` (or the ``options``-selected
+        method); returns an ObjectRef."""
+        return self._remote(self._method_name or "__call__", args, kwargs)
 
     def __getattr__(self, item: str) -> _MethodCaller:
         if item.startswith("_") or item in ("deployment_name",):
             raise AttributeError(item)
         return _MethodCaller(self, item)
 
-    def options(self, **_kwargs) -> "DeploymentHandle":
-        """Accepted for API parity (method_name= etc. are expressed via
-        attribute access here)."""
-        return self
+    def options(self, *, method_name: Optional[str] = None,
+                **kwargs) -> "DeploymentHandle":
+        """A copy of this handle with options applied.  ``method_name``
+        retargets ``.remote()`` at a named replica method (equivalent to
+        attribute access, but composable — the reference's
+        ``handle.options(method_name=...)``).  Unknown options raise
+        instead of being silently dropped."""
+        if kwargs:
+            raise ValueError(
+                f"unknown DeploymentHandle options: {sorted(kwargs)} "
+                f"(supported: method_name)")
+        h = DeploymentHandle(self.deployment_name, self._controller,
+                             method_name=method_name)
+        h._router = self._router  # share the cached per-process router
+        return h
 
     def __reduce__(self):
         # Router state is per-process; rebuild lazily on the other side.
-        return (DeploymentHandle, (self.deployment_name, self._controller))
+        return (DeploymentHandle,
+                (self.deployment_name, self._controller, self._method_name))
 
-    # Handles to the same deployment are interchangeable; the controller's
-    # code-change diff relies on this (fresh handle instances are created on
-    # every deploy of a composed app).
+    # Handles to the same deployment (with the same options) are
+    # interchangeable; the controller's code-change diff relies on this
+    # (fresh handle instances are created on every deploy of a composed
+    # app — those carry no method override, so its comparisons are
+    # unchanged).  A method-retargeted handle is behaviorally different
+    # and must not dedup against the plain one.
     def __eq__(self, other):
         return (
             isinstance(other, DeploymentHandle)
             and other.deployment_name == self.deployment_name
+            and other._method_name == self._method_name
         )
 
     def __hash__(self):
-        return hash(("DeploymentHandle", self.deployment_name))
+        return hash(("DeploymentHandle", self.deployment_name,
+                     self._method_name))
 
     def __repr__(self) -> str:
         return f"DeploymentHandle({self.deployment_name!r})"

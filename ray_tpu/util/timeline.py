@@ -44,7 +44,13 @@ def merged_timeline(tasks: List[dict], recorder_rows: List[dict]) -> List[dict]:
 def events_from_recorder_rows(rows: List[dict]) -> List[dict]:
     """Flight-recorder events as chrome-trace events: span events
     (``span_dur`` covers [ts - dur, ts]) become "X" slices; point events
-    become instants."""
+    become instants.
+
+    The ``compiled_dag`` source (``dag/compiled.py``) is keyed by
+    entity_id (``<graph>:<node>``) rather than origin, so each graph node
+    gets its own timeline row — the pipeline bubble structure (exec spans
+    interleaved with channel-wait spans) reads directly off the trace,
+    next to the task slices."""
     out: List[dict] = []
     for r in rows:
         ts = r.get("ts")
@@ -52,7 +58,10 @@ def events_from_recorder_rows(rows: List[dict]) -> List[dict]:
         if ts is None or source is None:
             continue
         pid = f"recorder:{source}"
-        tid = str(r.get("origin") or r.get("entity_id") or "events")
+        if source == "compiled_dag":
+            tid = str(r.get("entity_id") or r.get("origin") or "events")
+        else:
+            tid = str(r.get("origin") or r.get("entity_id") or "events")
         args = {"severity": r.get("severity")}
         if r.get("entity_id"):
             args["entity_id"] = r["entity_id"]

@@ -18,9 +18,13 @@ def _wf_storage(tmp_path, monkeypatch):
     monkeypatch.setenv("RAY_TPU_WORKFLOW_STORAGE", str(tmp_path / "wf"))
 
 
-def test_function_dag(ray_start_regular):
+def test_function_dag(ray_start_regular, tmp_path):
+    log = tmp_path / "a-runs"
+
     @ray_tpu.remote
     def a():
+        with open(str(log), "a") as f:
+            f.write("x")
         return 2
 
     @ray_tpu.remote
@@ -31,10 +35,12 @@ def test_function_dag(ray_start_regular):
     def c(x, y):
         return x + y
 
-    # diamond: a feeds both b and c; a must run once
+    # diamond: a feeds both b and c; a must run once (diamond dedup —
+    # both consumers receive the same ObjectRef, one submit per node)
     an = a.bind()
     dag = c.bind(b.bind(an), an)
     assert ray_tpu.get(dag.execute(), timeout=60) == 8
+    assert log.read_text() == "x", "shared node ran more than once"
 
 
 def test_dag_with_input(ray_start_regular):
@@ -50,6 +56,40 @@ def test_dag_with_input(ray_start_regular):
         dag = add1.bind(double.bind(inp))
     assert ray_tpu.get(dag.execute(5), timeout=60) == 11
     assert ray_tpu.get(dag.execute(10), timeout=60) == 21
+
+
+def test_topological_deep_chain_is_iterative():
+    """A ~5k-node chain must not hit Python's recursion limit (the
+    recursive visit overflowed around 1k nodes)."""
+    from ray_tpu.dag.dag_node import FunctionNode
+
+    node = InputNode()
+    for _ in range(5000):
+        node = FunctionNode(None, (node,), {})
+    order = node.topological()
+    assert len(order) == 5001
+    assert order[0] is not node and order[-1] is node
+
+
+def test_class_node_options_parity(ray_start_regular):
+    """ClassNode.options() (FunctionNode.options parity): actor options
+    apply at creation; the original node is untouched."""
+
+    @ray_tpu.remote
+    class Named:
+        def who(self):
+            return ray_tpu.get_runtime_context().actor_id.hex()
+
+    base = Named.bind()
+    named = base.options(name="dag-named-actor")
+    assert named._options.get("name") == "dag-named-actor"
+    assert not base._options  # original node untouched
+    aid = ray_tpu.get(named.who.bind().execute(), timeout=60)
+    handle = ray_tpu.get_actor("dag-named-actor")
+    assert handle._actor_id.hex() == aid
+    # unknown options still fail fast at creation time
+    with pytest.raises(ValueError):
+        base.options(bogus_option=1).who.bind().execute()
 
 
 def test_actor_dag(ray_start_regular):

@@ -45,6 +45,18 @@ def test_deploy_and_handle_call(serve_instance):
     handle = serve.run(Echo.bind(), port=0)
     assert ray_tpu.get(handle.remote("hi"), timeout=60) == {"echo": "hi"}
     assert ray_tpu.get(handle.double.remote(21), timeout=60) == 42
+    # handle.options(method_name=...) retargets .remote() (equivalent to
+    # attribute access, but composable); options survive pickling
+    doubler = handle.options(method_name="double")
+    assert ray_tpu.get(doubler.remote(5), timeout=60) == 10
+    assert ray_tpu.get(handle.remote("x"), timeout=60) == {"echo": "x"}
+    import cloudpickle
+
+    revived = cloudpickle.loads(cloudpickle.dumps(doubler))
+    assert ray_tpu.get(revived.remote(7), timeout=60) == 14
+    # unknown options raise instead of being silently dropped
+    with pytest.raises(ValueError, match="unknown DeploymentHandle options"):
+        handle.options(stream=True)
 
 
 def test_function_deployment(serve_instance):
