@@ -551,6 +551,123 @@ print("OBSRESULT " + json.dumps(
 """
 
 
+# Task-throughput probe for the tracing-overhead row.  Same paired
+# order-alternating window method as _OBS_BENCH_CODE: "on" windows submit
+# every task inside a tracing.trace() block (specs carry contexts, workers
+# adopt them, span events flow), "off" windows submit bare; the A/A
+# off/off pairs record the window-level noise floor for context.  The
+# <1% DISABLED gate is measured directly: the disabled submit path is
+# exactly one child_context_for_task() call returning None (plus one
+# current_context() read per get), so timing those calls against the
+# measured per-task budget bounds the disabled cost without fighting
+# multi-percent window noise.  The probe ends by running the doctor over
+# the cluster it just exercised: a healthy run must produce ZERO findings
+# (the false-positive gate the doctor's thresholds are tuned against).
+_TRACE_BENCH_CODE = """
+import json, statistics, time
+import ray_tpu
+from ray_tpu.util import tracing
+
+ray_tpu.init(num_cpus=4, num_tpus=0)
+
+@ray_tpu.remote
+def _noop():
+    return 0
+
+ray_tpu.get([_noop.remote() for _ in range(200)])  # warm pool + fn cache
+
+def _window(traced):
+    # 1000-task windows: at 300 the per-window variance on a busy host
+    # swamps a percent-level effect even under pairing
+    n = 1000
+    t0 = time.perf_counter()
+    if traced:
+        with tracing.trace("tracing-overhead-window"):
+            ray_tpu.get([_noop.remote() for _ in range(n)])
+    else:
+        ray_tpu.get([_noop.remote() for _ in range(n)])
+    return n / (time.perf_counter() - t0)
+
+pairs, ons, offs = [], [], []
+for i in range(8):
+    order = [True, False] if i % 2 == 0 else [False, True]
+    res = {}
+    for v in order:
+        res[v] = _window(v)
+    ons.append(res[True])
+    offs.append(res[False])
+    pairs.append(1.0 - res[True] / res[False])
+aa = []
+for i in range(6):  # A/A control: the window-level noise floor
+    a = _window(False)
+    b = _window(False)
+    # alternate orientation so monotone drift (task-table growth, pool
+    # ramp) cancels across the median exactly like the paired windows
+    aa.append(1.0 - a / b if i % 2 == 0 else 1.0 - b / a)
+
+# direct disabled-path cost: what every untraced submission pays
+assert tracing.current_context() is None
+N = 200_000
+t0 = time.perf_counter()
+for _ in range(N):
+    tracing.child_context_for_task("x")
+    tracing.current_context()
+disabled_s_per_task = (time.perf_counter() - t0) / N
+budget_s_per_task = 1.0 / statistics.median(offs)
+
+from ray_tpu.experimental.state import api as state
+from ray_tpu.util.doctor import diagnose
+
+findings = diagnose(state.list_events(limit=100_000),
+                    state.list_tasks(limit=100_000))
+n_traces = len(state.list_traces(limit=1000))
+ray_tpu.shutdown()
+print("TRACERESULT " + json.dumps(
+    {"on": statistics.median(ons), "off": statistics.median(offs),
+     "overhead_enabled_pct": statistics.median(pairs) * 100.0,
+     "overhead_disabled_pct":
+         100.0 * disabled_s_per_task / budget_s_per_task,
+     "disabled_ns_per_task": disabled_s_per_task * 1e9,
+     "aa_noise_pct": abs(statistics.median(aa)) * 100.0,
+     "traces_recorded": n_traces,
+     "doctor_findings": len(findings),
+     "doctor_rules": sorted(f["rule"] for f in findings)}))
+"""
+
+
+def run_tracing_overhead() -> dict:
+    """tracing_overhead row: task throughput with every submission inside
+    a trace() block vs bare (median of 8 order-alternating paired
+    windows), the directly-measured DISABLED submit-path cost gated at
+    <1% of the per-task budget, and a doctor run that must come back
+    clean.  Records the enabled cost each round so a propagation-path
+    regression is caught when it lands."""
+    env = dict(os.environ)
+    env["RAY_TPU_DASHBOARD_PORT"] = "-1"  # probe the runtime, not HTTP
+    proc = subprocess.run(
+        [sys.executable, "-c", _TRACE_BENCH_CODE], capture_output=True,
+        text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in proc.stdout.splitlines():
+        if line.startswith("TRACERESULT "):
+            r = json.loads(line[len("TRACERESULT "):])
+            return {"tracing_overhead": {
+                "tasks_per_sec_traced": round(r["on"], 1),
+                "tasks_per_sec_untraced": round(r["off"], 1),
+                "overhead_enabled_pct": round(r["overhead_enabled_pct"], 2),
+                "overhead_disabled_pct": round(r["overhead_disabled_pct"], 4),
+                "disabled_ns_per_task": round(r["disabled_ns_per_task"], 1),
+                "disabled_ok": r["overhead_disabled_pct"] < 1.0,
+                "aa_noise_pct": round(r["aa_noise_pct"], 2),
+                "traces_recorded": r["traces_recorded"],
+                "doctor_findings": r["doctor_findings"],
+                "doctor_clean": r["doctor_findings"] == 0,
+                "doctor_rules": r["doctor_rules"],
+            }}
+    raise RuntimeError(f"tracing probe failed: {proc.stderr[-2000:]}")
+
+
 def run_compiled_dag_bench() -> dict:
     """compiled_dag_roundtrip row: per-call latency of a 4-actor chain
     three ways — compiled execution graph (pre-allocated channels, zero
@@ -687,6 +804,10 @@ def main() -> None:
         decode_out.update(run_observability_overhead())
     except Exception as e:
         decode_out["observability_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        decode_out.update(run_tracing_overhead())
+    except Exception as e:
+        decode_out["tracing_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         decode_out.update(run_compiled_dag_bench())
     except Exception as e:

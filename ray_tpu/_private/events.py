@@ -37,7 +37,7 @@ SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
 KNOWN_SOURCES = (
     "scheduler", "node", "actor", "worker_pool", "object_store",
     "streaming", "serve", "serve_llm", "train", "collective",
-    "compiled_dag",
+    "compiled_dag", "trace",
 )
 
 # Kill switch for the whole observability layer (events + hot-path metric
@@ -80,8 +80,12 @@ class EventBuffer:
 
     def emit(self, source: str, message: str, severity: str = "INFO",
              entity_id: Optional[str] = None, span_dur: Optional[float] = None,
-             **data) -> None:
-        ts = time.time()
+             ts: Optional[float] = None, **data) -> None:
+        # ts override: for span events recorded AFTER the fact (e.g. a
+        # node loop emitting several input-edge waits once their trace
+        # lineage is known), the caller passes the span's true end time
+        if ts is None:
+            ts = time.time()
         with self._lock:
             self._seq += 1
             self._ring.append((self._seq, ts, severity, source, message,
@@ -132,12 +136,12 @@ def buffer() -> EventBuffer:
 
 def emit(source: str, message: str, severity: str = "INFO",
          entity_id: Optional[str] = None, span_dur: Optional[float] = None,
-         **data) -> None:
+         ts: Optional[float] = None, **data) -> None:
     """Record one structured event in this process's ring (no-op when the
     observability layer is disabled)."""
     if not ENABLED:
         return
-    _BUFFER.emit(source, message, severity, entity_id, span_dur, **data)
+    _BUFFER.emit(source, message, severity, entity_id, span_dur, ts, **data)
 
 
 def enabled() -> bool:
@@ -244,6 +248,145 @@ class EventTable:
     def counts(self) -> Dict[str, int]:
         with self._lock:
             return {s: len(q) for s, q in self._by_source.items()}
+
+
+DEFAULT_TRACE_CAPACITY = _int_env("RAY_TPU_TRACE_CAPACITY", 512)
+DEFAULT_TRACE_SPANS = _int_env("RAY_TPU_TRACE_SPANS", 2048)
+
+# event-data keys that are span LINEAGE (hoisted onto the span record);
+# everything else in data stays as span attributes
+_SPAN_KEYS = ("trace_id", "span_id", "parent_span_id", "phase")
+
+
+class TraceTable:
+    """Head-side per-trace span directory (``dashboard/state_aggregator``
+    + OpenTelemetry-collector analog): any shipped event whose data
+    carries a ``trace_id`` — ``trace``-source spans, traced compiled-graph
+    node/channel spans — is folded into its trace's span list.
+
+    Bounded both ways: at most ``max_traces`` traces (least-recently
+    UPDATED evicted first, so a long-running trace stays resident while
+    one-shot traces age out) and ``max_spans`` spans per trace, keeping
+    the LAST N: spans are emitted when they CLOSE, so parents always
+    arrive after their children and the root/ingress span arrives last
+    of all — keep-last preserves the root and upper tree (what the span
+    tree and wall-time attribution hang off), shedding the oldest leaf
+    spans first.  ``dropped`` counts what was shed."""
+
+    def __init__(self, max_traces: int = DEFAULT_TRACE_CAPACITY,
+                 max_spans: int = DEFAULT_TRACE_SPANS):
+        from collections import OrderedDict
+
+        self._max_traces = max(1, int(max_traces))
+        self._max_spans = max(1, int(max_spans))
+        self._traces: "OrderedDict[str, dict]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def span_from_event(row: dict, origin: str) -> Optional[dict]:
+        """Normalize one event row into a span record (None if the row
+        carries no trace lineage)."""
+        data = row.get("data") or {}
+        tid = data.get("trace_id")
+        ts = row.get("ts")
+        if not tid or ts is None:
+            return None
+        dur = row.get("span_dur") or 0.0
+        attrs = {k: v for k, v in data.items() if k not in _SPAN_KEYS}
+        span = {
+            "name": row.get("message", ""),
+            "trace_id": tid,
+            "span_id": data.get("span_id", ""),
+            "parent_span_id": data.get("parent_span_id", ""),
+            "phase": data.get("phase") or row.get("source", "span"),
+            "source": row.get("source"),
+            "origin": origin,
+            "start": ts - dur,
+            "end": ts,
+        }
+        if attrs:
+            span["data"] = attrs
+        return span
+
+    def add(self, origin: str, rows: List[dict]) -> None:
+        spans = []
+        for r in rows:
+            if isinstance(r, dict):
+                span = self.span_from_event(r, origin)
+                if span is not None:
+                    spans.append(span)
+        if not spans:
+            return
+        with self._lock:  # once per shipped batch, not per row
+            for span in spans:
+                tid = span["trace_id"]
+                t = self._traces.get(tid)
+                if t is None:
+                    from collections import deque
+
+                    t = self._traces[tid] = {
+                        "spans": deque(maxlen=self._max_spans),
+                        "dropped": 0,
+                        "first_ts": span["start"], "last_ts": span["end"],
+                    }
+                    while len(self._traces) > self._max_traces:
+                        self._traces.popitem(last=False)
+                else:
+                    self._traces.move_to_end(tid)
+                t["first_ts"] = min(t["first_ts"], span["start"])
+                t["last_ts"] = max(t["last_ts"], span["end"])
+                if len(t["spans"]) == self._max_spans:
+                    t["dropped"] += 1  # maxlen evicts the oldest
+                t["spans"].append(span)
+
+    def get(self, trace_id: str) -> Optional[dict]:
+        with self._lock:
+            t = self._traces.get(trace_id)
+            if t is None:
+                return None
+            spans = sorted(t["spans"], key=lambda s: s["start"])
+            return {"trace_id": trace_id, "spans": spans,
+                    "dropped_spans": t["dropped"],
+                    "first_ts": t["first_ts"], "last_ts": t["last_ts"]}
+
+    def list(self, limit: int = 100) -> List[dict]:
+        """Trace summaries, most recently updated last (the CLI shows the
+        tail)."""
+        with self._lock:
+            items = list(self._traces.items())[-limit:]
+            out = []
+            for tid, t in items:
+                roots = [s for s in t["spans"] if not s.get("parent_span_id")]
+                root_name = roots[0]["name"] if roots else (
+                    t["spans"][0]["name"] if t["spans"] else "")
+                out.append({
+                    "trace_id": tid, "name": root_name,
+                    "num_spans": len(t["spans"]) + t["dropped"],
+                    "start": t["first_ts"],
+                    "duration_s": round(t["last_ts"] - t["first_ts"], 6),
+                })
+            return out
+
+    def summarize(self) -> dict:
+        with self._lock:
+            durs = sorted(t["last_ts"] - t["first_ts"]
+                          for t in self._traces.values())
+            n = len(durs)
+            if not n:
+                return {"num_traces": 0}
+            return {
+                "num_traces": n,
+                "num_spans": sum(len(t["spans"]) + t["dropped"]
+                                 for t in self._traces.values()),
+                "duration_p50_s": round(durs[n // 2], 6),
+                "duration_p99_s": round(
+                    durs[min(n - 1, int(n * 0.99))], 6),
+                "duration_max_s": round(durs[-1], 6),
+            }
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._traces)
 
 
 class EventsPusher:

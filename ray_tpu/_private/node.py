@@ -671,6 +671,12 @@ class Node:
         # own emits live in the process-local ring and merge at query time
         self.events = events_mod.EventTable()
         self._events_dumped_seq = 0
+        # request traces: span-carrying events (trace source + traced
+        # compiled-graph spans) assemble into per-trace span trees here;
+        # the head process's own ring is folded lazily at query time
+        self.traces = events_mod.TraceTable()
+        self._traces_local_seq = 0
+        self._traces_fold_lock = threading.Lock()
         self._dispatch_n = 0  # dispatch-event sampling counter
         self.dashboard = None
         dash_port = int(os.environ.get("RAY_TPU_DASHBOARD_PORT", "0"))
@@ -1231,6 +1237,19 @@ class Node:
             self.worker_metrics_registry.merge(msg["origin"], msg["metrics"])
         elif mtype == "events_report":
             self.events.add(msg["origin"], msg["events"])
+            self.traces.add(msg["origin"], msg["events"])
+        elif mtype == "get_trace":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self._get_trace(msg["trace_id"])})
+        elif mtype == "summarize_state":
+            try:
+                value = self._summarize_state(msg["what"])
+            except ValueError as e:
+                # in-band error marker: a top-level "error" key means a
+                # transport failure to the client, not a bad argument
+                value = {"__state_error__": str(e)}
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": value})
         elif mtype == "log":
             logging_utils.emit_worker_log(msg)
         else:
@@ -1894,6 +1913,12 @@ class Node:
             time.sleep(2.0)
             self._prune_task_history()
             self._dump_head_events()
+            try:
+                # periodic fold so head-local span events reach the trace
+                # table before the ring evicts them (queries also fold)
+                self._fold_local_traces()
+            except Exception:
+                pass
             if self.gcs_store is None:
                 continue
             try:
@@ -3316,7 +3341,122 @@ class Node:
                 and (sev is None or r.get("severity") == sev))
             rows.sort(key=lambda r: r.get("ts", 0.0))
             return rows[-limit:]
+        if what == "traces":
+            self._fold_local_traces()
+            return self.traces.list(limit)
         raise ValueError(f"unknown state table {what!r}")
+
+    # ------------------------------------------------------------------
+    # request traces (state_aggregator + tracing backend analog)
+    # ------------------------------------------------------------------
+    def _fold_local_traces(self) -> None:
+        """Fold span events the HEAD process itself emitted (in-process
+        drivers, serve routers living here) into the trace table.  Lazy —
+        run at query time, cursored so each ring row folds once (the lock
+        keeps the cursor single-writer across query + flush threads)."""
+        with self._traces_fold_lock:
+            rows = events_mod.buffer().since(self._traces_local_seq)
+            if rows:
+                self._traces_local_seq = rows[-1]["seq"]
+                self.traces.add("head", rows)
+
+    def _task_spans(self, trace_id: str) -> Tuple[List[dict], int]:
+        """Task-table rows of this trace rendered as spans: the task span
+        itself plus scheduler-queue and execution child spans — queue-time
+        attribution comes straight from the control plane, no extra
+        instrumentation on the dispatch path.
+
+        Bounded like the TraceTable: the join keeps the FIRST N matching
+        tasks by submission time (root/ingress work lands early; a traced
+        50k-task streaming job must not produce a 150k-span payload) and
+        the match runs on a snapshot taken under gcs.lock, not with the
+        lock held across rendering."""
+        with self.gcs.lock:
+            snapshot = list(self.gcs.tasks.values())
+        tasks = [t for t in snapshot
+                 if t.trace_ctx and t.trace_ctx.get("trace_id") == trace_id]
+        dropped = 0
+        cap = max(1, events_mod.DEFAULT_TRACE_SPANS // 3)
+        if len(tasks) > cap:
+            tasks.sort(key=lambda t: t.start_time)
+            dropped = len(tasks) - cap
+            tasks = tasks[:cap]
+        out: List[dict] = []
+        now = time.time()
+        for t in tasks:
+            tc = t.trace_ctx
+            sid = tc.get("span_id") or t.task_id.hex()[:16]
+            end = t.end_time or now
+            out.append({
+                "name": t.name, "trace_id": trace_id, "span_id": sid,
+                "parent_span_id": tc.get("parent_span_id", ""),
+                "phase": "task", "source": "task",
+                "origin": t.node_id or "pending",
+                "start": t.start_time, "end": end,
+                "data": {"task_id": t.task_id.hex(), "state": t.state},
+            })
+            if t.exec_start:
+                out.append({
+                    "name": f"{t.name} (queued)", "trace_id": trace_id,
+                    "span_id": f"{sid}.q", "parent_span_id": sid,
+                    "phase": "scheduler_queue", "source": "task",
+                    "origin": t.node_id or "pending",
+                    "start": t.start_time, "end": t.exec_start,
+                })
+                out.append({
+                    "name": f"{t.name} (exec)", "trace_id": trace_id,
+                    "span_id": f"{sid}.x", "parent_span_id": sid,
+                    "phase": "execution", "source": "task",
+                    "origin": t.node_id or "pending",
+                    "start": t.exec_start, "end": t.exec_end or end,
+                })
+        return out, dropped
+
+    def _get_trace(self, trace_id: str) -> Optional[dict]:
+        """One assembled trace: shipped/local recorder spans + task-table
+        spans, sorted by start time.  None for an unknown id."""
+        self._fold_local_traces()
+        base = self.traces.get(trace_id)
+        task_spans, task_dropped = self._task_spans(trace_id)
+        if base is None and not task_spans:
+            return None
+        spans = (base["spans"] if base else []) + task_spans
+        spans.sort(key=lambda s: s["start"])
+        return {
+            "trace_id": trace_id,
+            "spans": spans,
+            "dropped_spans": (base["dropped_spans"] if base else 0)
+            + task_dropped,
+        }
+
+    def _summarize_state(self, what: str) -> dict:
+        """Head-side aggregation for ``summarize_*`` (state_aggregator
+        analog): counting happens HERE over the full tables instead of
+        shipping up to 100k rows to the client to be counted locally."""
+        from collections import Counter
+
+        if what == "events":
+            by_source: Dict[str, Counter] = {}
+            for e in self._list_state("events", 100_000):
+                by_source.setdefault(
+                    e["source"], Counter())[e["severity"]] += 1
+            return {src: dict(sev) for src, sev in by_source.items()}
+        if what == "tasks":
+            by_name: Dict[str, Counter] = {}
+            with self.gcs.lock:
+                for t in self.gcs.tasks.values():
+                    by_name.setdefault(t.name, Counter())[t.state] += 1
+            return {name: dict(states) for name, states in by_name.items()}
+        if what == "actors":
+            by_cls: Dict[str, Counter] = {}
+            with self.gcs.lock:
+                for a in self.gcs.actors.values():
+                    by_cls.setdefault(a.class_name, Counter())[a.state] += 1
+            return {cls: dict(states) for cls, states in by_cls.items()}
+        if what == "traces":
+            self._fold_local_traces()
+            return self.traces.summarize()
+        raise ValueError(f"unknown summary table {what!r}")
 
     def _state_snapshot(self) -> dict:
         snap = self.gcs.snapshot()

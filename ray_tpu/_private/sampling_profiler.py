@@ -71,6 +71,22 @@ class SamplingProfiler:
                  for r in self.report(top)]
         return "\n".join(lines)
 
+    def report_collapsed(self) -> str:
+        """Folded-stack lines (``frame;frame;frame N``) — the format
+        speedscope and Brendan Gregg's flamegraph.pl consume directly."""
+        return collapsed_from_report(
+            [{"stack": stack, "samples": n}
+             for stack, n in self.samples.most_common()])
+
+
+def collapsed_from_report(report: List[Dict]) -> str:
+    """Convert ``report()``-shaped rows (``{stack, samples, ...}`` —
+    what workers ship back over the control connection) into
+    folded-stack lines.  The single formatting site for the collapsed
+    format."""
+    return "\n".join(
+        f"{r['stack'].replace('|', ';')} {r['samples']}" for r in report)
+
 
 def profile_for(duration_s: float, period_s: float = 0.002,
                 top: int = 40) -> List[Dict]:

@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu._private import events as _events
 from ray_tpu._private import serialization
 from ray_tpu._private.client import CoreClient
 from ray_tpu._private.config import get_config
@@ -228,6 +229,30 @@ class Worker:
         return [values[oid] for oid in oids]
 
     def get(self, refs: List[ObjectRef], timeout: Optional[float] = None) -> List[Any]:
+        # traced callers get a get_wait span (object availability + transfer
+        # is a first-class phase of a request's critical path); untraced or
+        # events-off callers pay one flag check
+        trace_ctx = None
+        if _events.ENABLED:
+            from ray_tpu.util import tracing
+
+            trace_ctx = tracing.current_context()
+        if trace_ctx is None:
+            return self._get(refs, timeout)
+        t0 = time.perf_counter()
+        try:
+            return self._get(refs, timeout)
+        finally:
+            waited = time.perf_counter() - t0
+            if waited >= 0.001:
+                from ray_tpu.util import tracing
+
+                tracing.emit_span(
+                    f"get x{len(refs)}", waited,
+                    tracing.child_context("get"), phase="get_wait",
+                    num_objects=len(refs))
+
+    def _get(self, refs: List[ObjectRef], timeout: Optional[float]) -> List[Any]:
         from ray_tpu.exceptions import GetTimeoutError
 
         self.flush_removals()
@@ -916,7 +941,8 @@ def main() -> None:
         # this process for the requested window, report back to the head
         from ray_tpu._private.sampling_profiler import profile_for
 
-        report = profile_for(float(msg.get("duration", 3.0)))
+        report = profile_for(float(msg.get("duration", 3.0)),
+                             top=int(msg.get("top", 40)))
         client.send({"type": "profile_result", "token": msg.get("token"),
                      "report": report})
 

@@ -44,7 +44,14 @@ Execution semantics:
 Observability: per-node execution spans and channel-wait spans are emitted
 on the ``compiled_dag`` flight-recorder source (``_private/events.py``),
 so ``ray_tpu timeline`` renders the pipeline bubble structure next to the
-task slices (``util/timeline.py``).
+task slices (``util/timeline.py``).  When ``execute()`` runs inside a
+``tracing.trace()`` block, the caller's context rides the channel
+payloads (:class:`_Traced`): every node's exec/channel-wait span joins
+the request's trace, stages chain parent→child, recv waits are clamped
+to the request's entry time (loop idle never bills to a trace), and
+``ray_tpu trace <id>`` attributes the request's wall time across
+node execution vs channel wait vs result wait.  Untraced executions
+serialize bare values — nothing changes off-trace.
 
 Limitations vs the reference aDAG: DAG nodes must be actor method calls
 (no bare task nodes), node arguments may reference other nodes only at
@@ -174,6 +181,27 @@ class _ErrVal:
         self.err = err
 
 
+class _Traced:
+    """A channel payload carrying its trace context alongside the value.
+
+    When ``execute()`` runs inside a ``tracing.trace()`` block, the input
+    payload is wrapped so the context rides the channel with the data —
+    each node loop unwraps it, emits its exec/channel-wait spans as
+    children of the caller's trace, and re-wraps its output with its own
+    span as the parent (so a pipeline's spans chain stage to stage).
+    Untraced executions serialize the bare value: zero overhead and
+    byte-identical payloads when tracing is unused."""
+
+    __slots__ = ("ctx", "value")
+
+    def __init__(self, ctx: Dict[str, str], value: Any):
+        self.ctx = ctx
+        self.value = value
+
+    def __reduce__(self):
+        return (_Traced, (self.ctx, self.value))
+
+
 # ---------------------------------------------------------------------------
 # Actor-side execution (runs inside the actor's worker process)
 # ---------------------------------------------------------------------------
@@ -218,8 +246,15 @@ class _ActorGraph:
                 except Exception:
                     pass
 
-    def _read_inputs(self, task: _TaskPlan, seq: int) -> Dict[int, Any]:
+    def _read_inputs(self, task: _TaskPlan, seq: int):
+        """Read every in-edge; returns (vals, waits) where waits carries
+        each edge's blocked time AND its wall-clock completion — emitted
+        as channel-wait spans by the caller AFTER trace-context
+        extraction (the lineage rides the payloads), each stamped at its
+        own end time so sequential waits on a multi-input node render as
+        sequential, not stacked at emission time."""
         vals: Dict[int, Any] = {}
+        waits: List[Tuple[int, float, float]] = []  # (eid, waited, t_end)
         for eid in task.in_edges:
             t0 = time.perf_counter()
             while True:
@@ -230,21 +265,58 @@ class _ActorGraph:
                     break
                 except ChannelTimeoutError:
                     continue
-            waited = time.perf_counter() - t0
-            if waited >= _WAIT_SPAN_MIN_S:
-                _events.emit(_SOURCE, "channel wait", severity="DEBUG",
-                             entity_id=f"{self.gid}:{task.label}",
-                             span_dur=waited, edge=eid, seq=seq, op="recv")
+            waits.append((eid, time.perf_counter() - t0, time.time()))
             if flags & FLAG_ERROR:
                 vals[eid] = _ErrVal(_deser_error(payload))
             else:
                 vals[eid] = _deser(payload)
-        return vals
+        return vals, waits
 
     def _run_one(self, seq: int) -> None:
+        from ray_tpu.util.tracing import new_span_id, span_fields
+
         instance = self.instance
         for task in self.tasks:
-            vals = self._read_inputs(task, seq)
+            vals, waits = self._read_inputs(task, seq)
+            # a traced execution's context rides the payload: unwrap, and
+            # chain this node's spans under it
+            ctx = None
+            for eid, v in vals.items():
+                if isinstance(v, _Traced):
+                    ctx = ctx or v.ctx
+                    vals[eid] = v.value
+            node_ctx = None
+            if ctx is not None:
+                node_ctx = {"trace_id": ctx["trace_id"],
+                            "span_id": new_span_id(),
+                            "parent_span_id": ctx["span_id"],
+                            "name": task.label}
+                if "t0" in ctx:
+                    node_ctx["t0"] = ctx["t0"]  # downstream clamps too
+            # traced recv waits are clamped to the request's entry time: a
+            # loop that sat idle for minutes BEFORE this request was
+            # submitted must not charge that idle to the request's trace.
+            # t0 is the DRIVER's wall clock; a skewed consumer clock could
+            # push (t_end - t0) negative and wrongly suppress genuine
+            # waits, so the clamp only applies while it has positive
+            # headroom — beyond NTP-level skew the full wait is kept
+            # (idle billing is a smaller lie than erasing the wait).
+            req_t0 = None
+            if node_ctx is not None and "t0" in node_ctx:
+                req_t0 = float(node_ctx["t0"])
+            for eid, waited, t_end in waits:
+                if req_t0 is not None:
+                    headroom = t_end - req_t0 + 0.25
+                    if headroom > 0:
+                        waited = min(waited, headroom)
+                if waited >= _WAIT_SPAN_MIN_S:
+                    # ts=t_end: each edge's span sits at ITS completion,
+                    # so sequential waits render sequentially
+                    _events.emit(_SOURCE, "channel wait", severity="DEBUG",
+                                 entity_id=f"{self.gid}:{task.label}",
+                                 span_dur=waited, ts=t_end, edge=eid,
+                                 seq=seq, op="recv",
+                                 **span_fields(node_ctx, "channel_wait"))
             err = next((v for v in vals.values() if isinstance(v, _ErrVal)),
                        None)
             if err is not None:
@@ -257,15 +329,24 @@ class _ActorGraph:
                     kwargs = {k: (vals[s[1]] if s[0] == "edge" else s[1])
                               for k, s in task.kwargs.items()}
                     result = getattr(instance, task.method)(*args, **kwargs)
+                    if node_ctx is not None:
+                        # downstream nodes (and the driver's output) chain
+                        # under THIS node's span
+                        result = _Traced(node_ctx, result)
                     out_payload, out_flags = _ser(result), 0
                 except BaseException as e:  # noqa: BLE001 — user node error
                     tb = traceback.format_exc()
                     wrapped = e if isinstance(e, RayTaskError) else RayTaskError(
                         f"Compiled DAG node {task.label} failed:\n{tb}", cause=e)
                     out_payload, out_flags = _ser_error(wrapped), FLAG_ERROR
+                # the exec span IS the node's own span (node_ctx), parented
+                # to the incoming context
                 _events.emit(_SOURCE, task.label, severity="DEBUG",
                              entity_id=f"{self.gid}:{task.label}",
-                             span_dur=time.perf_counter() - t0, seq=seq)
+                             span_dur=time.perf_counter() - t0, seq=seq,
+                             **span_fields(
+                                 ctx, "node_exec",
+                                 span_id=(node_ctx or {}).get("span_id")))
             for eid in task.out_edges:
                 t0 = time.perf_counter()
                 while True:
@@ -281,7 +362,9 @@ class _ActorGraph:
                 if waited >= _WAIT_SPAN_MIN_S:
                     _events.emit(_SOURCE, "channel wait", severity="DEBUG",
                                  entity_id=f"{self.gid}:{task.label}",
-                                 span_dur=waited, edge=eid, seq=seq, op="send")
+                                 span_dur=waited, edge=eid, seq=seq,
+                                 op="send",
+                                 **span_fields(node_ctx, "channel_wait"))
 
     # -- teardown ------------------------------------------------------
     def teardown(self) -> None:
@@ -433,6 +516,10 @@ class CompiledDAG:
         from collections import deque
 
         self._abandoned_q: "deque" = deque()
+        # traced executions: seq -> the execute-span context (result-wait
+        # spans chain under it); popped when the seq is consumed, so the
+        # dict mirrors _results' lifecycle and stays bounded
+        self._trace_ctxs: Dict[int, dict] = {}
         self._broken: Optional[str] = None  # set on a partial input write
         try:
             self._compile(root)
@@ -671,6 +758,19 @@ class CompiledDAG:
                 value = args[0]
             else:
                 value = _DAGInput(args, kwargs)
+            # a traced caller's context rides the channel payload: every
+            # node loop's exec/channel-wait spans join this trace
+            exec_ctx = None
+            if _events.ENABLED:
+                from ray_tpu.util import tracing
+
+                exec_ctx = tracing.child_context(f"cdag.execute {self._gid[:6]}")
+                if exec_ctx is not None:
+                    # t0 = when the request entered the graph: node loops
+                    # clamp their recv-wait spans to it, so idle-before-
+                    # request time is never attributed to this trace
+                    exec_ctx["t0"] = time.time()
+                    value = _Traced(exec_ctx, value)
             payload = _ser(value)
             seq = self._seq
             deadline = time.monotonic() + self._submit_timeout
@@ -713,6 +813,13 @@ class CompiledDAG:
                 self._check_alive()
                 raise
             self._seq = seq + 1
+            if exec_ctx is not None:
+                self._trace_ctxs[seq] = exec_ctx
+                from ray_tpu.util import tracing
+
+                tracing.emit_span(f"cdag.execute {self._gid[:6]}",
+                                  time.perf_counter() - t0, exec_ctx,
+                                  phase="submit", seq=seq)
             waited = time.perf_counter() - t0
             if waited >= _WAIT_SPAN_MIN_S:
                 _events.emit(_SOURCE, "execute backpressure", severity="DEBUG",
@@ -723,6 +830,7 @@ class CompiledDAG:
         """Record ``seq`` as consumed (gotten or abandoned), advancing the
         low-water mark so tracking stays O(max_inflight).  Lock held."""
         self._fetched.add(seq)
+        self._trace_ctxs.pop(seq, None)
         while self._fetched_below in self._fetched:
             self._fetched.discard(self._fetched_below)
             self._fetched_below += 1
@@ -776,6 +884,7 @@ class CompiledDAG:
                 self._drain_abandoned()
                 if seq in self._results:
                     payload, flags = self._results.pop(seq)
+                    exec_ctx = self._trace_ctxs.get(seq)
                     self._mark_consumed(seq)
                     break
                 if seq < self._fetched_below or seq in self._fetched:
@@ -809,11 +918,17 @@ class CompiledDAG:
                     f"compiled DAG result {seq} not ready after {timeout}s")
         waited = time.perf_counter() - t0
         if waited >= _WAIT_SPAN_MIN_S:
+            from ray_tpu.util.tracing import span_fields
+
             _events.emit(_SOURCE, "result wait", severity="DEBUG",
-                         entity_id=self._gid, span_dur=waited, seq=seq)
+                         entity_id=self._gid, span_dur=waited, seq=seq,
+                         **span_fields(exec_ctx, "result_wait"))
         if flags & FLAG_ERROR:
             raise _deser_error(payload)
-        return _deser(payload)
+        value = _deser(payload)
+        if isinstance(value, _Traced):  # traced execution: unwrap the output
+            value = value.value
+        return value
 
     def _check_alive(self) -> None:
         """Raise a typed error if any participating actor died — the

@@ -85,12 +85,15 @@ _INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
  /api/cluster_status, /api/serve/applications, /api/logs[/&lt;stream&gt;],
  <a href="/api/timeline">/api/timeline</a> (chrome://tracing),
  <a href="/api/events">/api/events</a> (flight recorder),
- /api/grafana_dashboard, /api/profile?duration=3[&amp;worker_id=], /metrics</div>
+ <a href="/api/traces">/api/traces</a>[/&lt;id&gt;] (request traces),
+ /api/grafana_dashboard,
+ /api/profile?duration=3[&amp;worker_id=][&amp;format=collapsed], /metrics</div>
 <script>
 const TABS=["nodes","actors","tasks","workers","objects","placement_groups",
-            "jobs","serve","events","logs"];
+            "jobs","serve","events","traces","logs"];
 const ID_FIELD={nodes:"node_id",actors:"actor_id",tasks:"task_id",
- workers:"worker_id",placement_groups:"pg_id",jobs:"job_id"};
+ workers:"worker_id",placement_groups:"pg_id",jobs:"job_id",
+ traces:"trace_id"};
 let tab="nodes",timer=null;
 const nav=document.getElementById("nav");
 TABS.forEach(t=>{const b=document.createElement("button");b.textContent=t;
@@ -237,10 +240,23 @@ class Dashboard:
             return
         if path == "/api/profile":
             # on-demand sampling profile (py-spy/profile_manager.py analog):
-            # ?duration=3 for the head; &worker_id=<hex> for a worker
+            # ?duration=3 for the head; &worker_id=<hex> for a worker;
+            # &format=collapsed for folded stacks (speedscope/flamegraph.pl)
             duration = min(30.0, float(qs.get("duration", ["3"])[0]))
             wid = qs.get("worker_id", [None])[0]
-            self._send(req, json.dumps(self._profile(wid, duration)))
+            fmt = qs.get("format", ["json"])[0]
+            # collapsed consumers want the whole profile, not the top-40
+            top = 10_000 if fmt == "collapsed" else 40
+            result = self._profile(wid, duration, top)
+            if fmt == "collapsed" and "report" in result:
+                from ray_tpu._private.sampling_profiler import (
+                    collapsed_from_report,
+                )
+
+                self._send(req, collapsed_from_report(result["report"]),
+                           ctype="text/plain; charset=utf-8")
+                return
+            self._send(req, json.dumps(result))
             return
         if path.startswith("/api/logs/"):
             # tail one log stream as plain text (reference log viewer:
@@ -273,7 +289,7 @@ class Dashboard:
         req.end_headers()
         req.wfile.write(data)
 
-    def _profile(self, worker_id_hex, duration: float):
+    def _profile(self, worker_id_hex, duration: float, top: int = 40):
         """Sample the head process, or ask a worker to sample itself."""
         import os as _os
         import threading as _threading
@@ -282,7 +298,7 @@ class Dashboard:
             from ray_tpu._private.sampling_profiler import profile_for
 
             return {"target": "head", "duration_s": duration,
-                    "report": profile_for(duration)}
+                    "report": profile_for(duration, top=top)}
         node = self.node
         try:
             wid = bytes.fromhex(worker_id_hex)
@@ -296,7 +312,8 @@ class Dashboard:
         holder = {"event": _threading.Event(), "report": None}
         node._profile_acks[token] = holder
         try:
-            w.send({"type": "profile", "token": token, "duration": duration})
+            w.send({"type": "profile", "token": token, "duration": duration,
+                    "top": top})
         except (OSError, ValueError):
             node._profile_acks.pop(token, None)
             return {"error": "worker unreachable"}
@@ -358,6 +375,16 @@ class Dashboard:
                     controller.get_deploy_config.remote(), timeout=10) or {})
             except Exception:
                 return {}
+        if what.startswith("traces/"):
+            # one assembled trace + critical-path analysis (the JSON the
+            # `ray_tpu trace <id>` CLI renders)
+            trace = node._get_trace(what[len("traces/"):])
+            if trace is None:
+                return None
+            from ray_tpu.util.trace_analysis import analyze
+
+            trace["analysis"] = analyze(trace)
+            return _jsonable(trace)
         if "/" in what:
             # drill-down: /api/<table>/<id> -> full detail for one row
             # (after every named serve/... route — must not shadow them)

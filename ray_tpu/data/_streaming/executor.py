@@ -126,6 +126,14 @@ class StreamingExecutor:
         self._span_t0: dict = {}
         self._stall_s = 0.0
         self._last_stall_event = 0.0
+        # a consumer inside a trace() block: the pump thread adopts the
+        # context (map-task submissions chain under it) and operator/stall
+        # spans carry the trace lineage
+        self._trace_ctx = None
+        if _events.ENABLED:
+            from ray_tpu.util import tracing
+
+            self._trace_ctx = tracing.current_context()
 
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "StreamingExecutor":
@@ -188,10 +196,19 @@ class StreamingExecutor:
             sub_t = self._span_t0.pop(id(item), None)
             if sub_t is not None:
                 # operator span: submit -> delivery, a timeline slice
+                # (trace-tagged when the consumer runs inside a trace)
                 _events.emit("streaming", self._op_name(), severity="DEBUG",
                              entity_id=str(split),
-                             span_dur=time.perf_counter() - sub_t)
+                             span_dur=time.perf_counter() - sub_t,
+                             **self._trace_fields("operator"))
         return item
+
+    def _trace_fields(self, phase: str) -> dict:
+        """Span-lineage kwargs for an emit when a trace context was
+        captured (else empty — untraced emits stay byte-identical)."""
+        from ray_tpu.util.tracing import span_fields
+
+        return span_fields(self._trace_ctx, phase)
 
     def iter_refs(self, split: int = 0) -> Iterator[Any]:
         """Blocking iterator over one split's output refs."""
@@ -245,10 +262,18 @@ class StreamingExecutor:
                 "streaming", "backpressure stall", severity="DEBUG",
                 op=self._op_name(), stalled_s=round(waited, 4),
                 total_stalled_s=round(self._stall_s, 3),
-                in_flight=list(self._in_flight), budget=self._budget)
+                in_flight=list(self._in_flight), budget=self._budget,
+                **self._trace_fields("backpressure"))
 
     def _pump(self) -> None:
         try:
+            if self._trace_ctx is not None:
+                # the pump thread submits the map tasks: adopting the
+                # consumer's context makes their specs (and so the task
+                # table) part of the trace
+                from ray_tpu.util import tracing
+
+                tracing.adopt(self._trace_ctx)
             self._source, self._counts, self._map_op = \
                 build_streaming_topology(self._plan)
             # preassignment needs a static source; a generator source

@@ -5,11 +5,17 @@
 aggregated by ``dashboard/state_aggregator.py``): live introspection of
 the control plane, served by the head's ``list_state`` RPC and also over
 HTTP by the dashboard (``/api/...``).
+
+``summarize_*`` aggregate HEAD-SIDE via the ``summarize_state`` RPC
+(``state_aggregator.py`` summary path): the head counts over its full
+tables and ships the counts, instead of this client pulling up to 100k
+rows to count locally.  ``list_traces``/``get_trace``/``summarize_traces``
+expose the request-trace plane (``util/tracing.py`` spans assembled by the
+head's TraceTable).
 """
 
 from __future__ import annotations
 
-from collections import Counter
 from typing import Dict, List, Optional
 
 
@@ -72,24 +78,46 @@ def list_events(limit: int = 1000, source: Optional[str] = None,
     return _list("events", limit, filters or None)
 
 
+def summarize_state(what: str) -> dict:
+    """Head-side aggregation RPC: the head counts over its full tables
+    and ships only the counts (the client never pulls row dumps)."""
+    value = _client().request(
+        {"type": "summarize_state", "what": what})["value"]
+    if isinstance(value, dict) and "__state_error__" in value:
+        raise ValueError(value["__state_error__"])
+    return value
+
+
 def summarize_events() -> Dict[str, Dict[str, int]]:
-    """Event counts grouped by source and severity."""
-    by_source: Dict[str, Counter] = {}
-    for e in list_events(limit=100_000):
-        by_source.setdefault(e["source"], Counter())[e["severity"]] += 1
-    return {src: dict(sev) for src, sev in by_source.items()}
+    """Event counts grouped by source and severity (head-side)."""
+    return summarize_state("events")
 
 
 def summarize_tasks() -> Dict[str, Dict[str, int]]:
-    """Task counts grouped by name and state (summarize_tasks analog)."""
-    by_name: Dict[str, Counter] = {}
-    for t in list_tasks(limit=100_000):
-        by_name.setdefault(t["name"], Counter())[t["state"]] += 1
-    return {name: dict(states) for name, states in by_name.items()}
+    """Task counts grouped by name and state (summarize_tasks analog,
+    aggregated head-side)."""
+    return summarize_state("tasks")
 
 
 def summarize_actors() -> Dict[str, Dict[str, int]]:
-    by_cls: Dict[str, Counter] = {}
-    for a in list_actors(limit=100_000):
-        by_cls.setdefault(a["class_name"], Counter())[a["state"]] += 1
-    return {cls: dict(states) for cls, states in by_cls.items()}
+    return summarize_state("actors")
+
+
+def summarize_traces() -> dict:
+    """Trace counts + duration percentiles from the head's TraceTable."""
+    return summarize_state("traces")
+
+
+def list_traces(limit: int = 100) -> List[dict]:
+    """Summaries of recently updated traces (id, root span name, span
+    count, start, duration)."""
+    return _list("traces", limit)
+
+
+def get_trace(trace_id: str) -> Optional[dict]:
+    """One assembled trace: recorder spans (router admission, channel
+    waits, compiled-graph node executions, get waits...) merged with
+    task-table spans (queue + execution attribution), sorted by start.
+    None if the id is unknown."""
+    return _client().request(
+        {"type": "get_trace", "trace_id": trace_id})["value"]

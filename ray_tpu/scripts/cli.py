@@ -13,6 +13,9 @@ Reference: ``python/ray/scripts/scripts.py`` (cluster lifecycle) and
     job-logs <job_id> / job-stop <job_id>
     timeline [--out FILE]                      chrome-trace of task events
     events [--source S --severity L --limit N] flight-recorder event table
+    trace [TRACE_ID]                           span tree + critical path
+    doctor                                     pathology analysis (exit 1 on findings)
+    profile [--duration N --worker-id HEX]     sampling profile via the dashboard
     serve-status                               serve deployments + autoscaling
 """
 
@@ -190,6 +193,88 @@ def cmd_events(args) -> None:
         print(json.dumps(r, default=repr))
 
 
+def cmd_trace(args) -> None:
+    """Request traces: without an id, list recent traces; with one, the
+    assembled span tree + per-phase critical-path attribution."""
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    if not args.trace_id:
+        rows = state.list_traces(limit=args.limit)
+        if args.json:
+            print(json.dumps(rows, indent=2, default=repr))
+            return
+        if not rows:
+            print("(no traces recorded — run a workload inside "
+                  "ray_tpu.util.tracing.trace(), or send serve traffic)")
+            return
+        for r in rows:
+            print(f"{r['trace_id']}  {r['duration_s'] * 1e3:9.2f}ms  "
+                  f"{r['num_spans']:4d} spans  {r['name']}")
+        return
+    trace = state.get_trace(args.trace_id)
+    if trace is None:
+        raise SystemExit(f"unknown trace {args.trace_id!r} (see "
+                         f"`ray_tpu trace` for recent ids)")
+    from ray_tpu.util.trace_analysis import analyze, render_trace
+
+    analysis = analyze(trace)
+    if args.json:
+        trace["analysis"] = analysis
+        print(json.dumps(trace, indent=2, default=repr))
+    else:
+        print(render_trace(trace, analysis))
+
+
+def cmd_doctor(args) -> None:
+    """Rule-based pathology analysis over the recorded event/task state;
+    exits non-zero when findings exist so CI can gate on it."""
+    _connect()
+    from ray_tpu.util.doctor import render, run_doctor
+
+    findings = run_doctor()
+    if args.json:
+        print(json.dumps(findings, indent=2, default=repr))
+    else:
+        print(render(findings))
+    if findings:
+        sys.exit(1)
+
+
+def cmd_profile(args) -> None:
+    """On-demand sampling profile via the dashboard's /api/profile —
+    ``--format collapsed`` emits folded stacks for speedscope /
+    flamegraph.pl."""
+    import urllib.request
+
+    rt = _connect()
+    snap = rt._private.worker.global_worker.client.request(
+        {"type": "state_snapshot"})["value"]
+    dash = snap.get("dashboard")
+    if not dash:
+        raise SystemExit("head has no dashboard; profiling needs it "
+                         "(RAY_TPU_DASHBOARD_PORT >= 0)")
+    duration = args.duration
+    if duration > 30.0:
+        # the dashboard clamps server-side; say so instead of silently
+        # returning a shorter profile than asked for
+        print("note: profile duration is capped at 30s by the dashboard",
+              file=sys.stderr)
+        duration = 30.0
+    url = ("http://%s:%d/api/profile?duration=%s&format=%s"
+           % (dash[0], dash[1], duration, args.format))
+    if args.worker_id:
+        url += f"&worker_id={args.worker_id}"
+    with urllib.request.urlopen(url, timeout=duration + 60) as resp:
+        body = resp.read().decode()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(body)
+        print(f"wrote profile to {args.out}")
+    else:
+        print(body, end="" if body.endswith("\n") else "\n")
+
+
 def cmd_serve_status(_args) -> None:
     """``serve status`` analog over the running cluster."""
     rt = _connect()
@@ -287,7 +372,8 @@ def main(argv=None) -> None:
 
     s = sub.add_parser("list", help="state API tables")
     s.add_argument("what", choices=["actors", "tasks", "nodes", "objects",
-                                    "workers", "placement_groups", "jobs"])
+                                    "workers", "placement_groups", "jobs",
+                                    "traces"])
     s.add_argument("--limit", type=int, default=100)
     s.set_defaults(fn=cmd_list)
 
@@ -314,13 +400,37 @@ def main(argv=None) -> None:
     s.add_argument("--source", default=None,
                    help="filter: scheduler|object_store|streaming|serve|"
                         "train|actor|worker_pool|node|collective|"
-                        "serve_llm|compiled_dag")
+                        "serve_llm|compiled_dag|trace")
     s.add_argument("--severity", default=None,
                    help="filter: DEBUG|INFO|WARNING|ERROR")
     s.add_argument("--limit", type=int, default=200)
     s.add_argument("--summary", action="store_true",
                    help="counts by source/severity instead of rows")
     s.set_defaults(fn=cmd_events)
+
+    s = sub.add_parser(
+        "trace",
+        help="request traces: list, or span tree + critical path for one")
+    s.add_argument("trace_id", nargs="?", default=None)
+    s.add_argument("--limit", type=int, default=20)
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_trace)
+
+    s = sub.add_parser(
+        "doctor",
+        help="pathology analysis over recorded events/tasks "
+             "(exit 1 on findings)")
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_doctor)
+
+    s = sub.add_parser(
+        "profile", help="sampling profile of the head or a worker")
+    s.add_argument("--duration", type=float, default=3.0)
+    s.add_argument("--worker-id", default=None, help="worker id hex")
+    s.add_argument("--format", choices=["json", "collapsed"],
+                   default="json")
+    s.add_argument("--out", default=None, help="write to file")
+    s.set_defaults(fn=cmd_profile)
 
     sub.add_parser(
         "serve-status", help="serve deployments + autoscaling state"

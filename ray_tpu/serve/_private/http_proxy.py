@@ -10,6 +10,7 @@ and encodes the replica's return value as the HTTP response.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import threading
 import time
@@ -17,6 +18,7 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
+from ray_tpu._private import events as _events
 from ray_tpu.serve._private.http_util import Request, encode_response
 from ray_tpu.serve._private.router import Router
 from ray_tpu.serve.config import ROUTE_TABLE_TTL_S
@@ -120,12 +122,24 @@ class HTTPProxyActor:
                 router = self._routers.get(name)
                 if router is None:
                     router = self._routers[name] = Router(self._controller, name)
-            result, replica = self._route_with_retry(router, request)
-            if isinstance(result, dict) and "__serve_stream__" in result:
-                self._stream_response(h, replica, result)
-                return
-            payload, ctype = encode_response(result)
-            self._respond(h, 200, payload, ctype)
+            # each routed request is a trace ROOT: the span tree under it
+            # (router admission -> replica task -> nested submissions /
+            # compiled-graph nodes) is what `ray_tpu trace <id>` renders.
+            # Off when the observability layer is off.
+            if _events.ENABLED:
+                from ray_tpu.util import tracing
+
+                cm = tracing.trace(f"HTTP {h.command} {h.path}",
+                                   {"deployment": name}, phase="http")
+            else:
+                cm = contextlib.nullcontext()
+            with cm:
+                result, replica = self._route_with_retry(router, request)
+                if isinstance(result, dict) and "__serve_stream__" in result:
+                    self._stream_response(h, replica, result)
+                    return
+                payload, ctype = encode_response(result)
+                self._respond(h, 200, payload, ctype)
         except GetTimeoutError as e:
             if "no replica" in str(e):
                 self._respond(h, 503, b'{"error": "no replica available"}',

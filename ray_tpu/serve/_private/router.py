@@ -221,6 +221,15 @@ class Router:
         deadline = time.monotonic() + timeout if timeout is not None else None
         t_arrival = time.perf_counter()
         stall_reported = False
+        # traced callers (HTTP ingress root or a user trace() block): the
+        # admission wait becomes a child span and the replica task is
+        # submitted UNDER it, so replica execution chains off the admission
+        # in the assembled tree (tracing_helper's context-injection analog)
+        trace_ctx = None
+        if _events.ENABLED:
+            from ray_tpu.util import tracing
+
+            trace_ctx = tracing.child_context(f"admission {self._name}")
         self._ensure_listener()
         force = False
         with self._lock:
@@ -246,7 +255,18 @@ class Router:
                         self._pending -= 1
                         self._set_queue_gauge()
                         assigned = True
-                        ref = handle.handle_request.remote(method_name, args, kwargs)
+                        if trace_ctx is not None:
+                            from ray_tpu.util import tracing
+
+                            token = tracing.adopt(trace_ctx)
+                            try:
+                                ref = handle.handle_request.remote(
+                                    method_name, args, kwargs)
+                            finally:
+                                tracing.restore(token)
+                        else:
+                            ref = handle.handle_request.remote(
+                                method_name, args, kwargs)
                         self._inflight.setdefault(tag, {})[ref.binary()] = ref
                         self._ref_tags[ref.binary()] = tag
                         self._push_metrics()
@@ -259,6 +279,13 @@ class Router:
                                 "serve", f"admission {self._name}",
                                 severity="DEBUG", entity_id=tag,
                                 span_dur=waited)
+                            if trace_ctx is not None:
+                                from ray_tpu.util import tracing
+
+                                tracing.emit_span(
+                                    f"admission {self._name}", waited,
+                                    trace_ctx, phase="router_admission",
+                                    replica=tag, deployment=self._name)
                         return (ref, handle) if return_replica else ref
                     self._push_metrics()
                     waitable = [r for refs in self._inflight.values()

@@ -1,0 +1,292 @@
+"""``ray_tpu doctor`` — rule-based pathology analysis over recorded state.
+
+The flight recorder (``_private/events.py``), the metric registry, and the
+task table already RECORD every known pathology this runtime can hit —
+backpressure stalls, spill thrash, OOM kills, gang restarts, split
+starvation, poisoned/stuck compiled-graph channels, router saturation,
+slow-node skew.  This module closes the loop: ``diagnose()`` runs the
+rule set over the recorded rows and returns actionable findings WITH the
+evidence rows, so an operator staring at a p99 regression gets "streaming
+pump stalled 4.2s on backpressure (budget 1); raise the block budget or
+speed up the consumer" instead of a wall of DEBUG events.
+
+Rules are thresholded against healthy baselines (a backpressured streaming
+pipeline is the design working, not a pathology — it takes sustained stall
+seconds to flag), and a clean run returns ``[]``: the bench harness runs
+``diagnose`` at the end as a false-positive gate.
+
+Pure functions over row lists — testable without a cluster; ``run_doctor``
+is the thin live-cluster wrapper the CLI uses.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+# finding severities mirror event severities (ERROR > WARNING > INFO)
+_SEV_ORDER = {"ERROR": 0, "WARNING": 1, "INFO": 2}
+
+# -- rule thresholds (shared with the tests; module-level so an operator
+# can tune them for an unusual deployment) ---------------------------------
+STALL_TOTAL_S = 0.5       # cumulative pump stall that counts as a stall
+STARVATION_TOTAL_S = 2.0  # cumulative consumer starvation seconds
+SPILL_COUNT = 3           # spills before "thrash"
+CHANNEL_WAIT_STUCK_S = 5.0  # one channel wait this long = stuck
+ROUTER_STALL_COUNT = 1    # saturated-router stalls (replicas > 0)
+WORKER_CHURN_COUNT = 3    # unexpected worker deaths
+SKEW_RATIO = 3.0          # slowest-node / fastest-node mean exec ratio
+SKEW_MIN_TASKS = 5        # per (task name, node) sample floor
+SKEW_MIN_DELTA_S = 0.05   # absolute mean gap floor (noise guard)
+
+
+def _finding(rule: str, severity: str, summary: str,
+             evidence: Sequence[dict], remedy: str) -> dict:
+    return {
+        "rule": rule,
+        "severity": severity,
+        "summary": summary,
+        "remedy": remedy,
+        "count": len(evidence),
+        "evidence": list(evidence)[:5],
+    }
+
+
+def _rows(events: Sequence[dict], source: str,
+          message: Optional[str] = None,
+          prefix: Optional[str] = None) -> List[dict]:
+    out = []
+    for e in events:
+        if e.get("source") != source:
+            continue
+        m = e.get("message", "")
+        if message is not None and m != message:
+            continue
+        if prefix is not None and not m.startswith(prefix):
+            continue
+        out.append(e)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# rules (each: events, tasks -> finding | None)
+# ---------------------------------------------------------------------------
+
+def _rule_backpressure_stall(events, tasks):
+    stalls = _rows(events, "streaming", "backpressure stall")
+    # total_stalled_s is cumulative per executor: take each executor's max
+    # (rows don't carry an executor id — op is the closest key)
+    by_op: Dict[str, float] = {}
+    for r in stalls:
+        d = r.get("data") or {}
+        op = str(d.get("op", "?"))
+        by_op[op] = max(by_op[op] if op in by_op else 0.0,
+                        float(d.get("total_stalled_s") or 0.0))
+    total = sum(by_op.values())
+    if total < STALL_TOTAL_S:
+        return None
+    return _finding(
+        "backpressure_stall", "WARNING",
+        f"streaming pump stalled {total:.2f}s on per-split block budgets "
+        f"(ops: {', '.join(sorted(by_op))})",
+        stalls,
+        "consumers are slower than the pipeline: raise "
+        "RAY_TPU_STREAMING_BLOCK_BUDGET / max_in_flight_blocks, speed up "
+        "the consumer, or add splits")
+
+
+def _rule_split_starvation(events, tasks):
+    rows = _rows(events, "streaming", "split starved")
+    total = sum(float((r.get("data") or {}).get("wait_s") or 0.0)
+                for r in rows)
+    if total < STARVATION_TOTAL_S:
+        return None
+    return _finding(
+        "split_starvation", "WARNING",
+        f"streaming consumers sat {total:.2f}s on empty splits "
+        f"({len(rows)} waits) — the pipeline can't keep up",
+        rows,
+        "producers are the bottleneck: add parallelism to the source/map "
+        "stage or raise the block budget so submission runs ahead")
+
+
+def _rule_spill_thrash(events, tasks):
+    rows = _rows(events, "object_store", "spilled object to disk")
+    if len(rows) < SPILL_COUNT:
+        return None
+    mb = sum(float((r.get("data") or {}).get("size_mb") or 0.0)
+             for r in rows)
+    return _finding(
+        "spill_thrash", "WARNING",
+        f"object store spilled {len(rows)} objects (~{mb:.0f} MB) to disk",
+        rows,
+        "working set exceeds shm capacity: raise the object-store "
+        "capacity, free refs sooner, or stream instead of materializing")
+
+
+def _rule_oom_kills(events, tasks):
+    rows = _rows(events, "scheduler", "OOM kill")
+    if not rows:
+        return None
+    return _finding(
+        "oom_kills", "ERROR",
+        f"{len(rows)} worker(s) OOM-killed by the memory monitor",
+        rows,
+        "tasks exceed per-worker memory: lower per-node concurrency, "
+        "shrink task working sets, or add memory/nodes")
+
+
+def _rule_gang_restart(events, tasks):
+    restarts = _rows(events, "train", "gang restarted")
+    failures = _rows(events, "train", prefix="gang failure")
+    if not restarts and not failures:
+        return None
+    return _finding(
+        "gang_restart", "ERROR" if failures else "WARNING",
+        f"train gang restarted {len(restarts)}x / "
+        f"{len(failures)} rank failure(s)",
+        failures + restarts,
+        "a rank is dying mid-training (see the evidence rows' error "
+        "field): check worker OOMs/preemptions; checkpoints bound lost "
+        "work")
+
+
+def _rule_stuck_channel(events, tasks):
+    dead = [r for r in _rows(events, "compiled_dag")
+            if r.get("severity") == "ERROR"]
+    # only SEND-side waits count as stuck: a long recv wait is a loop
+    # idling between requests (normal), a long blocked put means the
+    # consumer stopped draining
+    stuck = [r for r in _rows(events, "compiled_dag", "channel wait")
+             if float(r.get("span_dur") or 0.0) >= CHANNEL_WAIT_STUCK_S
+             and (r.get("data") or {}).get("op") == "send"]
+    if not dead and not stuck:
+        return None
+    return _finding(
+        "stuck_channel", "ERROR" if dead else "WARNING",
+        f"compiled-graph channels unhealthy: {len(dead)} loop death(s), "
+        f"{len(stuck)} channel wait(s) >= {CHANNEL_WAIT_STUCK_S:.0f}s",
+        dead + stuck,
+        "a node loop died (poisoning its edges) or a stage starves its "
+        "peers: check the ERROR rows' actor, teardown() and recompile; "
+        "balance stage times or raise max_inflight")
+
+
+def _rule_router_saturation(events, tasks):
+    rows = [r for r in _rows(events, "serve",
+                             "router stalled: no replica available")
+            if (r.get("data") or {}).get("replicas", 0) > 0]
+    if len(rows) < ROUTER_STALL_COUNT:
+        return None
+    return _finding(
+        "router_saturation", "WARNING",
+        f"serve router(s) stalled {len(rows)}x with every replica at "
+        f"max_concurrent_queries",
+        rows,
+        "replicas are saturated: raise num_replicas (or autoscaling "
+        "max), raise max_concurrent_queries, or speed up the handler")
+
+
+def _rule_worker_churn(events, tasks):
+    rows = [r for r in _rows(events, "worker_pool", prefix="worker died")
+            if r.get("severity") == "WARNING"]
+    if len(rows) < WORKER_CHURN_COUNT:
+        return None
+    return _finding(
+        "worker_churn", "WARNING",
+        f"{len(rows)} workers died while holding tasks/actors",
+        rows,
+        "repeated unexpected worker deaths (segfaults, OOM, kills): "
+        "check the per-worker logs under the session dir")
+
+
+def _rule_slow_node_skew(events, tasks):
+    # same task name, >=2 nodes, enough samples each: a node whose mean
+    # exec time is SKEW_RATIO x the fastest is dragging the tail
+    by_name_node: Dict[str, Dict[str, List[float]]] = {}
+    for t in tasks or ():
+        if t.get("exec_start") is None or t.get("exec_end") is None \
+                or not t.get("node_id"):
+            continue
+        dur = t["exec_end"] - t["exec_start"]
+        by_name_node.setdefault(t.get("name", "?"), {}) \
+            .setdefault(t["node_id"], []).append(dur)
+    worst = None
+    for name, per_node in by_name_node.items():
+        means = {n: sum(v) / len(v) for n, v in per_node.items()
+                 if len(v) >= SKEW_MIN_TASKS}
+        if len(means) < 2:
+            continue
+        fast_n, fast = min(means.items(), key=lambda kv: kv[1])
+        slow_n, slow = max(means.items(), key=lambda kv: kv[1])
+        if slow < fast * SKEW_RATIO or slow - fast < SKEW_MIN_DELTA_S:
+            continue
+        if worst is None or slow / max(fast, 1e-9) > worst["ratio"]:
+            worst = {"name": name, "slow": slow_n, "fast": fast_n,
+                     "ratio": slow / max(fast, 1e-9),
+                     "slow_s": slow, "fast_s": fast}
+    if worst is None:
+        return None
+    return _finding(
+        "slow_node_skew", "WARNING",
+        f"node {worst['slow']} runs {worst['name']!r} "
+        f"{worst['ratio']:.1f}x slower than {worst['fast']} "
+        f"({worst['slow_s'] * 1e3:.0f}ms vs {worst['fast_s'] * 1e3:.0f}ms "
+        f"mean)",
+        [worst],
+        "a straggler node skews the gang/tail: check its host_stats on "
+        "the dashboard (CPU steal, thermal, noisy neighbor) or drain it")
+
+
+RULES = (
+    _rule_oom_kills,
+    _rule_gang_restart,
+    _rule_stuck_channel,
+    _rule_backpressure_stall,
+    _rule_split_starvation,
+    _rule_spill_thrash,
+    _rule_router_saturation,
+    _rule_worker_churn,
+    _rule_slow_node_skew,
+)
+
+
+def diagnose(events: Sequence[dict],
+             tasks: Sequence[dict] = ()) -> List[dict]:
+    """Run every rule over recorded events + task rows; returns findings
+    sorted by severity (an empty list IS the healthy verdict)."""
+    findings = []
+    for rule in RULES:
+        f = rule(events, tasks)
+        if f is not None:
+            findings.append(f)
+    findings.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
+    return findings
+
+
+def run_doctor(limit: int = 100_000) -> List[dict]:
+    """Pull the live cluster's event + task tables and diagnose them."""
+    from ray_tpu.experimental.state import api as state
+
+    events = state.list_events(limit=limit)
+    tasks = state.list_tasks(limit=limit)
+    return diagnose(events, tasks)
+
+
+def render(findings: List[dict]) -> str:
+    """The doctor's report as text (what ``ray_tpu doctor`` prints)."""
+    if not findings:
+        return ("ray_tpu doctor: no findings — recorded state shows no "
+                "known pathology.")
+    out = [f"ray_tpu doctor: {len(findings)} finding(s)\n"]
+    for f in findings:
+        out.append(f"[{f['severity']}] {f['rule']}: {f['summary']}")
+        out.append(f"  remedy: {f['remedy']}")
+        for ev in f["evidence"][:3]:
+            desc = {k: v for k, v in ev.items()
+                    if k in ("ts", "message", "entity_id", "origin",
+                             "data", "name", "slow", "fast", "ratio")}
+            out.append(f"  evidence: {desc}")
+        if f["count"] > 3:
+            out.append(f"  ... {f['count'] - 3} more evidence row(s)")
+        out.append("")
+    return "\n".join(out).rstrip()

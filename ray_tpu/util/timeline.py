@@ -37,6 +37,7 @@ def merged_timeline(tasks: List[dict], recorder_rows: List[dict]) -> List[dict]:
     that caused them.  Perfetto/chrome load the merged list directly."""
     events = events_from_task_rows(tasks)
     events.extend(events_from_recorder_rows(recorder_rows))
+    events.extend(_trace_flow_events(recorder_rows))
     events.extend(_metadata_events(events))
     return events
 
@@ -50,18 +51,16 @@ def events_from_recorder_rows(rows: List[dict]) -> List[dict]:
     entity_id (``<graph>:<node>``) rather than origin, so each graph node
     gets its own timeline row — the pipeline bubble structure (exec spans
     interleaved with channel-wait spans) reads directly off the trace,
-    next to the task slices."""
+    next to the task slices.  The ``trace`` source is keyed the same way
+    (entity_id = trace_id): each request trace renders as one row whose
+    spans are linked by flow arrows (:func:`_trace_flow_events`)."""
     out: List[dict] = []
     for r in rows:
         ts = r.get("ts")
         source = r.get("source")
         if ts is None or source is None:
             continue
-        pid = f"recorder:{source}"
-        if source == "compiled_dag":
-            tid = str(r.get("entity_id") or r.get("origin") or "events")
-        else:
-            tid = str(r.get("origin") or r.get("entity_id") or "events")
+        pid, tid = _recorder_row_key(r)
         args = {"severity": r.get("severity")}
         if r.get("entity_id"):
             args["entity_id"] = r["entity_id"]
@@ -80,6 +79,53 @@ def events_from_recorder_rows(rows: List[dict]) -> List[dict]:
                 "s": "t", "ts": ts * 1e6, "pid": pid, "tid": tid,
                 "args": args,
             })
+    return out
+
+
+def _recorder_row_key(r: dict):
+    """(pid, tid) for a recorder event: per-source process rows, keyed by
+    origin — except compiled_dag (per graph node) and trace (per trace
+    id), whose slices AND flow arrows must land on the same row."""
+    source = r.get("source")
+    pid = f"recorder:{source}"
+    if source in ("compiled_dag", "trace"):
+        tid = str(r.get("entity_id") or r.get("origin") or "events")
+    else:
+        tid = str(r.get("origin") or r.get("entity_id") or "events")
+    return pid, tid
+
+
+def _trace_flow_events(rows: List[dict]) -> List[dict]:
+    """Per-trace flow arrows: recorder span events carrying trace lineage
+    (``data.span_id``/``parent_span_id`` — trace-source spans AND traced
+    compiled-graph spans) get chrome flow "s"/"f" pairs parent -> child,
+    so a request's causal chain reads as arrows across the merged rows."""
+    spans: List[dict] = []
+    by_id: dict = {}
+    for r in rows:
+        d = r.get("data") or {}
+        if r.get("ts") is None or not d.get("span_id"):
+            continue
+        spans.append(r)
+        by_id.setdefault(d["span_id"], r)
+
+    out: List[dict] = []
+    for r in spans:
+        d = r["data"]
+        parent = by_id.get(d.get("parent_span_id"))
+        if parent is None or parent is r:
+            continue
+        p_pid, p_tid = _recorder_row_key(parent)
+        c_pid, c_tid = _recorder_row_key(r)
+        p_start = (parent["ts"] - (parent.get("span_dur") or 0.0)) * 1e6
+        c_start = (r["ts"] - (r.get("span_dur") or 0.0)) * 1e6
+        out.append({"name": r.get("message", "span"), "cat": "trace_flow",
+                    "ph": "s", "id": d["span_id"], "ts": p_start,
+                    "pid": p_pid, "tid": p_tid})
+        out.append({"name": r.get("message", "span"), "cat": "trace_flow",
+                    "ph": "f", "bp": "e", "id": d["span_id"],
+                    "ts": max(c_start, p_start), "pid": c_pid,
+                    "tid": c_tid})
     return out
 
 
