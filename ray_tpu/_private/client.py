@@ -307,11 +307,14 @@ class CoreClient:
     def notify_unblocked(self) -> None:
         self.send({"type": "unblocked"})
 
-    def add_refs(self, oids: List[bytes]) -> None:
-        self.send({"type": "add_ref", "oids": oids})
+    def add_refs(self, oids: List[bytes], reason: str = "handle") -> None:
+        """``reason`` labels the pin in the head's ownership audit
+        ("handle" for live ObjectRefs, "task_arg" for spec-build arg
+        pins); lifetime accounting is reason-agnostic."""
+        self.send({"type": "add_ref", "oids": oids, "reason": reason})
 
-    def remove_refs(self, oids: List[bytes]) -> None:
-        self.send({"type": "remove_ref", "oids": oids})
+    def remove_refs(self, oids: List[bytes], reason: str = "handle") -> None:
+        self.send({"type": "remove_ref", "oids": oids, "reason": reason})
 
     def broadcast(self, oid: bytes, timeout: float = 120.0) -> dict:
         return self.request({"type": "broadcast", "oid": oid,

@@ -25,7 +25,7 @@ import os
 import threading
 import time
 from collections import deque
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 SEVERITIES = ("DEBUG", "INFO", "WARNING", "ERROR")
 
@@ -48,8 +48,17 @@ ENABLED = os.environ.get("RAY_TPU_EVENTS", "1") not in ("0", "false", "no")
 
 
 def _int_env(name: str, default: int) -> int:
+    """Shared env-int parse-with-fallback (util/tsdb.py imports these two
+    rather than growing a third copy)."""
     try:
         return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def _float_env(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
     except ValueError:
         return default
 
@@ -57,7 +66,7 @@ def _int_env(name: str, default: int) -> int:
 DEFAULT_CAPACITY = _int_env("RAY_TPU_EVENTS_CAPACITY", 4096)
 # per-source cap at the head (one cluster-wide table, bounded per source)
 DEFAULT_TABLE_CAPACITY = _int_env("RAY_TPU_EVENTS_TABLE_CAPACITY", 10_000)
-DEFAULT_FLUSH_S = float(os.environ.get("RAY_TPU_EVENTS_FLUSH_S", "2.0"))
+DEFAULT_FLUSH_S = _float_env("RAY_TPU_EVENTS_FLUSH_S", 2.0)
 
 
 class EventBuffer:
@@ -231,6 +240,15 @@ class EventTable:
 
     def list(self, limit: int = 1000, source: Optional[str] = None,
              severity: Optional[str] = None) -> List[dict]:
+        return self.list_with_total(limit, source, severity)[0]
+
+    def list_with_total(self, limit: int = 1000, source: Optional[str] = None,
+                        severity: Optional[str] = None,
+                        ) -> Tuple[List[dict], int]:
+        """(newest ``limit`` filtered rows, filtered total) in one pass —
+        the state API's truncation marker needs the total, and computing
+        it by listing the whole table a second time doubled the sort on
+        every dashboard poll."""
         with self._lock:
             if source is not None:
                 rows = list(self._by_source.get(source, ()))
@@ -238,8 +256,9 @@ class EventTable:
                 rows = [r for q in self._by_source.values() for r in q]
         if severity is not None:
             rows = [r for r in rows if r.get("severity") == severity]
+        total = len(rows)
         rows.sort(key=lambda r: r.get("ts", 0.0))
-        return rows[-limit:]
+        return rows[-limit:], total
 
     def sources(self) -> List[str]:
         with self._lock:

@@ -667,6 +667,44 @@ class Node:
 
         self.job_manager = JobManager(self)
         self.worker_metrics_registry = metrics_mod._Registry()
+        # Resource accounting over time: every registry snapshot that
+        # reaches the head (worker pushes, node-agent resource samples,
+        # the head's own self-sample loop) also folds into a bounded
+        # in-memory TSDB, so trends — RSS slopes, store growth, queue
+        # climbs — are queryable instead of inferable (util/tsdb.py).
+        from ray_tpu.util import tsdb as tsdb_mod
+
+        self.tsdb = tsdb_mod.TimeSeriesStore()
+        # Two expiry horizons off the push cadence (stretched if the
+        # resource sampler runs slower than the pusher): the LIVE merged
+        # registry drops origins after 3 missed pushes (a dead worker
+        # must leave /metrics promptly; the next push self-heals a false
+        # positive), while the TSDB keeps series 4x longer — history is
+        # the thing a transient pusher backoff must not erase, and a
+        # dead process's recent trend is exactly what a post-mortem
+        # wants to read.
+        # RAY_TPU_RESOURCE_SAMPLE_S: unset -> /proc sampling every push
+        # tick; > 0 -> that cadence; <= 0 -> disabled (the head honors
+        # the same knob the node agents document)
+        raw = os.environ.get("RAY_TPU_RESOURCE_SAMPLE_S")
+        self._resource_sample_s = (
+            None if raw is None else events_mod._float_env(
+                "RAY_TPU_RESOURCE_SAMPLE_S", metrics_mod.push_interval_s()))
+        base_s = max(metrics_mod.push_interval_s(),
+                     self._resource_sample_s or 0.0)
+        self._origin_expiry_s = tsdb_mod.ORIGIN_EXPIRY_INTERVALS * base_s
+        self._tsdb_expiry_s = 4 * self._origin_expiry_s
+        # latest per-entity /proc stats for the top view:
+        # worker_id hex (or "head"/"agent:<node>") -> stats dict.
+        # _proc_lock guards it — folded by connection-handler threads,
+        # rebuilt by the sampler tick, read by top_snapshot.
+        self._proc_live: Dict[str, dict] = {}
+        self._proc_lock = threading.Lock()
+        self._tsdb_stop = threading.Event()
+        t = threading.Thread(target=self._tsdb_loop, name="tsdb-sampler",
+                             daemon=True)
+        t.start()
+        self._threads.append(t)
         # flight recorder: worker-shipped events fold in here; the head's
         # own emits live in the process-local ring and merge at query time
         self.events = events_mod.EventTable()
@@ -843,7 +881,7 @@ class Node:
             # args for the re-execution (released again when it finishes)
             repin = [d for d in copy.get("dep_ids", []) if self.registry.contains(d)]
             for d in repin:
-                self.registry.add_ref(d)
+                self.registry.add_ref(d, reason="task_arg")
             copy["pinned_refs"] = repin
             # an affinity to the dead node would leave the resubmission
             # unschedulable forever; reconstruction may run anywhere
@@ -1147,11 +1185,13 @@ class Node:
         elif mtype == "pipeline_returned":
             self._on_pipeline_returned(worker, msg)
         elif mtype == "add_ref":
+            reason = msg.get("reason", "handle")
             for oid in msg["oids"]:
-                self.registry.add_ref(oid)
+                self.registry.add_ref(oid, reason=reason)
         elif mtype == "remove_ref":
+            reason = msg.get("reason", "handle")
             for oid in msg["oids"]:
-                self.registry.remove_ref(oid)
+                self.registry.remove_ref(oid, reason=reason)
         elif mtype == "create_pg":
             self.create_placement_group(msg["spec"])
         elif mtype == "remove_pg":
@@ -1208,9 +1248,12 @@ class Node:
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
                                "value": self.job_manager.stop(msg["job_id"])})
         elif mtype == "list_state":
+            rows, total = self._list_state_page(
+                msg["what"], msg.get("limit", 1000), msg.get("filters"))
+            # total rides next to the rows so clients can surface
+            # truncation instead of passing a partial view off as complete
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
-                               "value": self._list_state(msg["what"], msg.get("limit", 1000),
-                                                         msg.get("filters"))})
+                               "value": rows, "total": total})
         elif mtype == "replica_added":
             self._on_replica_added(worker, msg)
         elif mtype == "dynamic_yield":
@@ -1235,6 +1278,31 @@ class Node:
                 holder["event"].set()
         elif mtype == "metrics_report":
             self.worker_metrics_registry.merge(msg["origin"], msg["metrics"])
+            from ray_tpu.util import tsdb as tsdb_mod
+
+            if tsdb_mod.ENABLED:
+                self.tsdb.ingest(msg["origin"], msg["metrics"])
+                self._fold_resource_report(msg["origin"], msg["metrics"])
+        elif mtype == "list_metrics":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self.tsdb.list_metrics()})
+        elif mtype == "query_metric":
+            try:
+                value = self.tsdb.query(
+                    msg["name"], window_s=msg.get("window_s", 3600.0),
+                    step_s=msg.get("step_s", 0.0), tags=msg.get("tags"),
+                    agg=msg.get("agg"))
+            except ValueError as e:
+                value = {"__state_error__": str(e)}
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": value})
+        elif mtype == "memory_audit":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self._memory_audit(
+                                   limit=msg.get("limit", 200))})
+        elif mtype == "top_snapshot":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self._top_snapshot()})
         elif mtype == "events_report":
             self.events.add(msg["origin"], msg["events"])
             self.traces.add(msg["origin"], msg["events"])
@@ -1535,9 +1603,20 @@ class Node:
             else:
                 head = self.nodes.get(self._head_node_id)
                 loc.fetch_addr = tuple(head.fetch_addr) if head and head.fetch_addr else None
+        # ownership audit: attribute the payload to its producer — the
+        # sealing actor/worker, or the driver for puts over a client
+        # connection (`ray memory`'s owner column)
+        if sealer is not None:
+            if sealer.actor_id is not None:
+                owner, owner_kind = sealer.actor_id.hex(), "actor"
+            else:
+                owner, owner_kind = sealer.worker_id.hex(), "worker"
+        else:
+            owner, owner_kind = "driver", "driver"
         # contained refs are counted (and remembered for cascade-decrement
         # when this object dies) inside the registry
-        self.registry.seal(oid, loc, contained)
+        self.registry.seal(oid, loc, contained, owner=owner,
+                           owner_kind=owner_kind)
         self._notify_sealed(oid)
         with self.lock:
             # retry dep-blocked actor queues inline (the seal may be the
@@ -1698,9 +1777,9 @@ class Node:
         spec-private objects (the big-args payload) whose initial refcount
         belongs to the spec itself."""
         for oid in spec.pop("pinned_refs", None) or []:
-            self.registry.remove_ref(oid)
+            self.registry.remove_ref(oid, reason="task_arg")
         for oid in spec.pop("owned_oids", None) or []:
-            self.registry.remove_ref(oid)
+            self.registry.remove_ref(oid, reason="handle")
 
     def _register_pending_get(self, pg: _PendingGet) -> None:
         replies = []
@@ -1986,7 +2065,7 @@ class Node:
                     tid = spec["task_id"]
                     deps = list(dict.fromkeys(spec.get("dep_ids", [])))
                     for d in deps:
-                        self.registry.add_ref(d)
+                        self.registry.add_ref(d, reason="lineage")
                     self._lineage_pins[tid] = deps
                     self._lineage_refcnt[tid] = len(spec["return_ids"])
                 for oid in spec["return_ids"]:
@@ -2112,7 +2191,7 @@ class Node:
             self._lineage_refcnt.pop(tid, None)
             pins = self._lineage_pins.pop(tid, [])
         for d in pins:  # registry calls outside the node lock
-            self.registry.remove_ref(d)
+            self.registry.remove_ref(d, reason="lineage")
 
     def _seal_error_returns(self, spec: dict, err: Exception) -> None:
         from ray_tpu._private.object_store import store_value
@@ -3290,10 +3369,17 @@ class Node:
     # ------------------------------------------------------------------
     def _list_state(self, what: str, limit: int = 1000,
                     filters: Optional[dict] = None) -> List[dict]:
-        """State API backend (experimental/state/api.py:729-1333 analog).
-        ``filters`` (events only: source/severity) apply BEFORE the limit
-        truncation — filtering the newest N cluster-wide rows client-side
-        would hide a rare WARNING behind thousands of sampled DEBUGs."""
+        return self._list_state_page(what, limit, filters)[0]
+
+    def _list_state_page(self, what: str, limit: int = 1000,
+                         filters: Optional[dict] = None,
+                         ) -> Tuple[List[dict], int]:
+        """State API backend (experimental/state/api.py:729-1333 analog),
+        returning ``(rows, total)`` so a truncated listing is visibly
+        truncated.  ``filters`` (events only: source/severity) apply
+        BEFORE the limit truncation — filtering the newest N cluster-wide
+        rows client-side would hide a rare WARNING behind thousands of
+        sampled DEBUGs."""
 
         def rows(items):
             out = []
@@ -3310,15 +3396,17 @@ class Node:
 
         with self.gcs.lock:
             if what == "actors":
-                return rows(self.gcs.actors.values())
+                return rows(self.gcs.actors.values()), len(self.gcs.actors)
             if what == "nodes":
-                return rows(self.gcs.nodes.values())
+                return rows(self.gcs.nodes.values()), len(self.gcs.nodes)
             if what == "tasks":
-                return rows(self.gcs.tasks.values())
+                return rows(self.gcs.tasks.values()), len(self.gcs.tasks)
             if what == "placement_groups":
-                return rows(self.gcs.placement_groups.values())
+                return (rows(self.gcs.placement_groups.values()),
+                        len(self.gcs.placement_groups))
         if what == "objects":
-            return self.registry.list_objects(limit)
+            return (self.registry.list_objects(limit),
+                    self.registry.stats()["num_objects"])
         if what == "workers":
             with self.lock:
                 return [
@@ -3326,24 +3414,28 @@ class Node:
                      "state": w.state, "is_actor_worker": w.is_actor_worker,
                      "pid": w.proc.pid if w.proc else None}
                     for w in list(self.workers.values())[:limit]
-                ]
+                ], len(self.workers)
         if what == "jobs":
             mgr = getattr(self, "job_manager", None)
-            return mgr.list_jobs() if mgr else []
+            jobs = mgr.list_jobs() if mgr else []
+            return jobs[:limit], len(jobs)
         if what == "events":
-            # worker-shipped table + the head's own ring, one timeline
+            # worker-shipped table + the head's own ring, one timeline;
+            # the table computes its filtered total in the same pass
             src = (filters or {}).get("source")
             sev = (filters or {}).get("severity")
-            rows = self.events.list(limit, source=src, severity=sev)
-            rows.extend(
+            rows, table_total = self.events.list_with_total(
+                limit, source=src, severity=sev)
+            local = [
                 dict(r, origin="head") for r in events_mod.local_events()
                 if (src is None or r.get("source") == src)
-                and (sev is None or r.get("severity") == sev))
+                and (sev is None or r.get("severity") == sev)]
+            rows.extend(local)
             rows.sort(key=lambda r: r.get("ts", 0.0))
-            return rows[-limit:]
+            return rows[-limit:], table_total + len(local)
         if what == "traces":
             self._fold_local_traces()
-            return self.traces.list(limit)
+            return self.traces.list(limit), len(self.traces)
         raise ValueError(f"unknown state table {what!r}")
 
     # ------------------------------------------------------------------
@@ -3458,6 +3550,351 @@ class Node:
             return self.traces.summarize()
         raise ValueError(f"unknown summary table {what!r}")
 
+    # ------------------------------------------------------------------
+    # resource accounting over time (metrics TSDB + top/memory surfaces)
+    # ------------------------------------------------------------------
+    def _tsdb_loop(self) -> None:
+        """Head-side sampler on the shared deadline grid
+        (``metrics.grid_ticks``): every push interval, expire origins
+        that stopped pushing, refresh the runtime gauges, sample local
+        processes' /proc stats, and fold the head's own registry into
+        the TSDB.  The ticker's ``stalled`` flag skips expiry on a tick
+        right after a head stall (everyone's timestamps lag equally —
+        sweeping then would wipe live peers)."""
+        from ray_tpu._private.resource_spec import ProcSampler
+        from ray_tpu.util import tsdb as tsdb_mod
+        from ray_tpu.util.metrics import grid_ticks, push_interval_s
+        from ray_tpu.util.metrics import registry as head_registry
+
+        sampler = ProcSampler()
+        interval = push_interval_s()
+        res = self._resource_sample_s
+        if res is None:
+            sample_every = 1  # default: /proc sample on every push tick
+        elif res <= 0:
+            sample_every = 0  # explicitly disabled, like the node agents
+        else:
+            sample_every = max(1, round(res / interval))
+        tick_n = 0
+        for stalled in grid_ticks(interval, self._tsdb_stop.wait):
+            if self._shutdown:
+                continue
+            tick_n += 1
+            try:
+                if not stalled:
+                    # the LIVE registry's hygiene is not a TSDB feature:
+                    # dead pushers must leave /metrics even with the
+                    # history layer switched off
+                    expired = self.worker_metrics_registry.expire_origins(
+                        self._origin_expiry_s)
+                    for origin in expired:
+                        events_mod.emit(
+                            "node", "metrics origin expired",
+                            severity="DEBUG", entity_id=origin)
+                if not tsdb_mod.ENABLED:
+                    continue
+                if sample_every and tick_n % sample_every == 0:
+                    self._sample_local_procs(sampler)
+                self.refresh_runtime_gauges()
+                self.tsdb.ingest("head", head_registry().snapshot())
+                if not stalled:
+                    self.tsdb.expire_stale(self._tsdb_expiry_s)
+            except Exception:
+                logger.debug("tsdb sampler tick failed", exc_info=True)
+
+    def _sample_local_procs(self, sampler) -> None:
+        """/proc stats for the head process and every worker whose process
+        lives on this host (agent nodes sample their own workers and ship
+        over metrics_report).  Lands as tagged gauges in the head registry
+        — and therefore in /metrics and the TSDB — with dead workers'
+        label series retired via Metric.remove."""
+        from ray_tpu._private.resource_spec import (
+            PROC_CPU_PCT,
+            PROC_OPEN_FDS,
+            PROC_RSS_MB,
+            _PROC_METRIC_HELP,
+            resource_metrics_snapshot,
+        )
+        from ray_tpu.util.metrics import Gauge
+
+        entities = [({"entity": "head", "worker_id": "head",
+                      "node": self._head_node_id}, os.getpid())]
+        with self.lock:
+            for wid, w in self.workers.items():
+                if w.proc is not None and w.state != "dead":
+                    entities.append((
+                        {"entity": "actor" if w.is_actor_worker else "worker",
+                         "worker_id": wid.hex(), "node": w.node_id},
+                        w.proc.pid))
+        _, raw = resource_metrics_snapshot(sampler, entities)
+        gauges = {
+            name: Gauge(name, _PROC_METRIC_HELP[name])
+            for name in (PROC_RSS_MB, PROC_CPU_PCT, PROC_OPEN_FDS)
+        }
+        live_keys = set()
+        proc_live = {}
+        for tags, pid, stats in raw:
+            full = {**tags, "pid": str(pid)}
+            live_keys.add(tuple(sorted(full.items())))
+            gauges[PROC_RSS_MB].set(stats["rss_mb"], tags=full)
+            gauges[PROC_CPU_PCT].set(stats["cpu_pct"], tags=full)
+            if "open_fds" in stats:
+                gauges[PROC_OPEN_FDS].set(stats["open_fds"], tags=full)
+            proc_live[tags["worker_id"]] = dict(stats, node=tags["node"],
+                                                local=True)
+        # retire label series of processes that vanished (Metric.remove —
+        # without this the per-worker gauges grow with worker churn)
+        for g in gauges.values():
+            for labels in g.label_sets():
+                if tuple(sorted(labels.items())) not in live_keys:
+                    g.remove(labels)
+        # local rows replace wholesale; remote rows (shipped by agents)
+        # persist until their next report or until they go stale (a dead
+        # remote worker stops appearing in its agent's reports — prune by
+        # timestamp, or churn accumulates rows forever)
+        cutoff = time.time() - self._origin_expiry_s
+        with self._proc_lock:
+            self._proc_live = {
+                **{k: v for k, v in self._proc_live.items()
+                   if not v.get("local") and v.get("ts", 0.0) >= cutoff},
+                **proc_live,
+            }
+
+    def _fold_resource_report(self, origin: str, metrics: Dict[str, dict]) -> None:
+        """Keep the live top-view cache current from a node agent's (or
+        any remote sampler's) shipped per-process gauges."""
+        from ray_tpu._private.resource_spec import (
+            PROC_CPU_PCT,
+            PROC_OPEN_FDS,
+            PROC_RSS_MB,
+        )
+
+        names = {PROC_RSS_MB: "rss_mb", PROC_CPU_PCT: "cpu_pct",
+                 PROC_OPEN_FDS: "open_fds"}
+        now = time.time()
+        with self._proc_lock:
+            for name, field in names.items():
+                m = metrics.get(name)
+                if not m:
+                    continue
+                for key, value in m.get("values", {}).items():
+                    tags = dict(key)
+                    wid = tags.get("worker_id") or (
+                        f"agent:{tags.get('node', origin)}"
+                        if tags.get("entity") == "agent" else None)
+                    if wid is None:
+                        continue
+                    row = self._proc_live.setdefault(
+                        wid, {"node": tags.get("node", origin)})
+                    row[field] = value
+                    row["local"] = False
+                    row["ts"] = now
+
+    def refresh_runtime_gauges(self) -> None:
+        """Refresh the head's runtime gauges (store/arena occupancy, task
+        states, queue depth, owner-pinned bytes...) — shared by the
+        dashboard's scrape path and the TSDB sample loop, so /metrics and
+        the time series always agree (metric_defs.cc analog)."""
+        from ray_tpu.util.metrics import Gauge
+
+        g = Gauge("ray_tpu_objects_in_store", "objects tracked by the registry")
+        stats = self.registry.stats()
+        g.set(stats["num_objects"])
+        Gauge("ray_tpu_object_store_bytes", "head-local shm bytes").set(
+            stats["bytes_used"])
+        Gauge("ray_tpu_objects_spilled", "objects spilled to disk").set(
+            stats.get("num_spilled", 0))
+        arena = getattr(self, "arena", None)
+        if arena is not None:
+            try:
+                astats = arena.stats()
+                Gauge("ray_tpu_arena_bytes_used",
+                      "native arena bytes allocated").set(astats["bytes_used"])
+                Gauge("ray_tpu_arena_capacity_bytes",
+                      "native arena capacity").set(astats["capacity"])
+            except Exception:
+                pass
+        with self.lock:
+            n_workers = len([w for w in self.workers.values()
+                             if w.state != "dead"])
+            n_nodes = len([ns for ns in self.nodes.values() if ns.alive])
+            n_pending = len(self.pending_tasks)
+        Gauge("ray_tpu_num_workers", "live workers").set(n_workers)
+        Gauge("ray_tpu_num_nodes", "alive nodes").set(n_nodes)
+        Gauge("ray_tpu_sched_queue_depth",
+              "tasks pending cluster-wide (not yet staged on a node)").set(
+            n_pending)
+        for src, n in self.events.counts().items():
+            Gauge("ray_tpu_events_recorded",
+                  "flight-recorder events held per source").set(
+                n, tags={"source": src})
+        with self.gcs.lock:
+            for state in ("PENDING", "RUNNING", "FINISHED", "FAILED"):
+                n = sum(1 for t in self.gcs.tasks.values() if t.state == state)
+                Gauge("ray_tpu_tasks", "tasks by state").set(
+                    n, tags={"state": state})
+        # object-store bytes pinned per owner, from the ownership table —
+        # the "who owns these 6 GiB" trend; stale owners' series retire
+        audit = self._memory_audit(limit=0)
+        g = Gauge("ray_tpu_owner_pinned_bytes",
+                  "sealed object-store bytes attributed per owner")
+        live = set()
+        for row in audit["by_owner"][:50]:
+            tags = {"owner": row["owner"], "kind": row["owner_kind"]}
+            live.add(tuple(sorted(tags.items())))
+            g.set(row["bytes"], tags=tags)
+        for labels in g.label_sets():
+            if tuple(sorted(labels.items())) not in live:
+                g.remove(labels)
+
+    def _memory_audit(self, limit: int = 200) -> dict:
+        """The ``ray memory`` analog: every sealed object's bytes
+        attributed to the worker/actor/driver that produced it, with pin
+        reasons, ages, and orphan flags (owner process no longer alive).
+        ``limit`` caps the per-object rows shipped; ``limit=0`` (the
+        every-tick gauge refresh and ``top``) takes the aggregate-only
+        registry pass — no per-object row dicts, no sort."""
+        with self.lock:
+            live_workers = {w.worker_id.hex() for w in self.workers.values()
+                            if w.state != "dead"}
+            live_actors = {a.info.actor_id.hex() for a in self.actors.values()
+                           if a.worker is not None and a.worker.state != "dead"}
+        with self.gcs.lock:
+            actor_names = {a.actor_id.hex(): a.class_name
+                           for a in self.gcs.actors.values()}
+
+        def annotate(owner: str, kind: str):
+            """(display label, owner process still alive)."""
+            if kind == "actor":
+                return (f"{actor_names.get(owner, 'actor')}:{owner[:8]}",
+                        owner in live_actors)
+            if kind == "worker":
+                return f"worker:{owner[:8]}", owner in live_workers
+            # driver/head seals live exactly as long as the session
+            return owner, True
+
+        rows: List[dict] = []
+        num_objects = 0
+        if limit:
+            rows = self.registry.memory_audit()
+            num_objects = len(rows)
+            owner_aggs: Dict[tuple, dict] = {}
+            by_reason: Dict[str, int] = {}
+            for r in rows:
+                key = (r["owner"], r["owner_kind"])
+                agg = owner_aggs.setdefault(key, {"bytes": 0, "objects": 0})
+                agg["bytes"] += r["size"] or 0
+                agg["objects"] += 1
+                by_reason[r["pin_reason"]] = by_reason.get(
+                    r["pin_reason"], 0) + (r["size"] or 0)
+        else:
+            # aggregate-only path: O(owners) read of the incrementally-
+            # maintained summary (no table scan under the registry lock
+            # on the every-tick gauge refresh); the pin-reason breakdown
+            # needs per-object pins and only ships with the rows
+            owner_aggs = self.registry.owner_summary()
+            by_reason = {}
+            num_objects = sum(a["objects"] for a in owner_aggs.values())
+        total = attributed = orphan_bytes = 0
+        by_owner = []
+        for (owner, kind), agg in owner_aggs.items():
+            label, alive = annotate(owner, kind)
+            total += agg["bytes"]
+            if owner != "unknown":
+                attributed += agg["bytes"]
+            if not alive:
+                orphan_bytes += agg["bytes"]
+            by_owner.append({
+                "owner": owner, "owner_kind": kind, "owner_label": label,
+                "bytes": agg["bytes"], "objects": agg["objects"],
+                "orphan": not alive,
+            })
+        by_owner.sort(key=lambda a: -a["bytes"])
+        rows = rows[:limit]  # only shipped rows need per-row annotation
+        for r in rows:
+            r["owner_label"], alive = annotate(r["owner"], r["owner_kind"])
+            r["orphan"] = not alive
+        return {
+            "ts": time.time(),
+            "total_bytes": total,
+            "attributed_bytes": attributed,
+            "attributed_frac": (attributed / total) if total else 1.0,
+            "orphan_bytes": orphan_bytes,
+            "num_objects": num_objects,
+            "by_owner": by_owner,
+            "by_pin_reason": by_reason,
+            "rows": rows,
+            "store": self.registry.stats(),
+        }
+
+    def _top_snapshot(self) -> dict:
+        """One frame of ``ray_tpu top``: nodes with live host stats,
+        workers/actors with their sampled RSS/CPU/fds and pinned bytes,
+        plus store + task-state summaries."""
+        from ray_tpu._private.resource_spec import host_stats
+
+        audit = self._memory_audit(limit=0)
+        pinned = {a["owner"]: a["bytes"] for a in audit["by_owner"]}
+        with self._proc_lock:
+            proc_live = dict(self._proc_live)
+        with self.lock:
+            nodes = [{
+                "node_id": ns.node_id, "alive": ns.alive,
+                "total": dict(ns.total), "available": dict(ns.available),
+                "utilization": round(ns.utilization(), 3),
+                "host_stats": ns.host_stats if ns.agent_conn is not None
+                else None,
+                # only head-local/emulated nodes genuinely share this
+                # host: filling a remote node's missing stats (agent yet
+                # to pong) with the head's /proc would mislabel them
+                "_local_host": ns.agent_conn is None,
+            } for ns in self.nodes.values()]
+            workers = []
+            for wid, w in self.workers.items():
+                if w.state == "dead":
+                    continue
+                hexid = wid.hex()
+                stats = proc_live.get(hexid, {})
+                workers.append({
+                    "worker_id": hexid, "node_id": w.node_id,
+                    "pid": w.proc.pid if w.proc else None,
+                    "state": w.state,
+                    "kind": "actor" if w.is_actor_worker else "worker",
+                    "actor_id": w.actor_id.hex() if w.actor_id else None,
+                    "rss_mb": stats.get("rss_mb"),
+                    "cpu_pct": stats.get("cpu_pct"),
+                    "open_fds": stats.get("open_fds"),
+                    "pinned_bytes": pinned.get(hexid)
+                    or (pinned.get(w.actor_id.hex()) if w.actor_id else 0)
+                    or 0,
+                })
+        with self.gcs.lock:
+            actor_names = {a.actor_id.hex(): a.class_name
+                           for a in self.gcs.actors.values()}
+            task_states: Dict[str, int] = {}
+            for t in self.gcs.tasks.values():
+                task_states[t.state] = task_states.get(t.state, 0) + 1
+        for w in workers:
+            if w["actor_id"]:
+                w["actor_class"] = actor_names.get(w["actor_id"])
+        head_stats = proc_live.get("head", {})
+        for n in nodes:
+            if n.pop("_local_host") and n["host_stats"] is None \
+                    and n["alive"]:
+                n["host_stats"] = host_stats()
+        return {
+            "ts": time.time(),
+            "nodes": nodes,
+            "workers": workers,
+            "head": head_stats,
+            "tasks": task_states,
+            "store": audit["store"],
+            "owners": audit["by_owner"][:20],
+            "total_pinned_bytes": audit["total_bytes"],
+            "orphan_bytes": audit["orphan_bytes"],
+            "tsdb": self.tsdb.stats(),
+        }
+
     def _state_snapshot(self) -> dict:
         snap = self.gcs.snapshot()
         snap["object_store"] = self.registry.stats()
@@ -3477,6 +3914,7 @@ class Node:
     # ------------------------------------------------------------------
     def shutdown(self) -> None:
         self._shutdown = True
+        self._tsdb_stop.set()
         try:
             self._dump_head_events()  # final increment of the crash trail
         except Exception:

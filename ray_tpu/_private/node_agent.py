@@ -99,6 +99,27 @@ class NodeAgent:
                                          name="agent-monitor")
         self._monitor.start()
 
+        # Per-entity resource sampler (reporter_agent analog): RSS / CPU% /
+        # open fds for every worker on THIS host plus the agent itself,
+        # shipped as tagged gauges over the metrics_report path so they
+        # land in the head's merged registry and its TSDB.  The head
+        # cannot read a remote host's /proc — this loop is the only
+        # source of per-worker stats for agent nodes.
+        self._resource_interval = self._resource_sample_interval()
+        if self._resource_interval > 0:
+            t = threading.Thread(target=self._resource_loop, daemon=True,
+                                 name="agent-resources")
+            t.start()
+
+    @staticmethod
+    def _resource_sample_interval() -> float:
+        """Sampling cadence; <= 0 disables (shared parse helper — the
+        head honors the same knob for its local workers)."""
+        from ray_tpu._private.events import _float_env
+        from ray_tpu.util.metrics import push_interval_s
+
+        return _float_env("RAY_TPU_RESOURCE_SAMPLE_S", push_interval_s())
+
     # -- plumbing ---------------------------------------------------------
     def _send(self, msg: dict) -> None:
         with self._send_lock:
@@ -204,6 +225,39 @@ class NodeAgent:
                 proc.kill()
             except Exception:
                 pass
+
+    def _resource_loop(self) -> None:
+        """/proc sampling of agent + workers on the shared deadline grid
+        (``metrics.grid_ticks``) — spacing must stay uniform for the
+        head's TSDB."""
+        from ray_tpu._private.resource_spec import (
+            ProcSampler,
+            resource_metrics_snapshot,
+        )
+        from ray_tpu.util.metrics import grid_ticks
+
+        sampler = ProcSampler()
+
+        def wait(timeout: float) -> bool:
+            time.sleep(timeout)
+            return self._shutdown
+
+        for _ in grid_ticks(self._resource_interval, wait):
+            entities = [({"entity": "agent", "node": self.node_id},
+                         os.getpid())]
+            with self._lock:
+                for wid, proc in self.procs.items():
+                    entities.append((
+                        {"entity": "worker", "worker_id": wid,
+                         "node": self.node_id}, proc.pid))
+            snap, _ = resource_metrics_snapshot(sampler, entities)
+            if not snap:
+                continue
+            try:
+                self._send({"type": "metrics_report", "origin": self.node_id,
+                            "metrics": snap})
+            except (OSError, ValueError):
+                return  # head gone; serve_forever is tearing down
 
     def _monitor_loop(self) -> None:
         """Report worker processes that die (the head polls local procs

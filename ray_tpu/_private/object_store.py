@@ -120,6 +120,15 @@ class _Entry:
     ref_count: int = 1
     contained: List[bytes] = field(default_factory=list)
     last_access: float = field(default_factory=time.monotonic)
+    # ownership audit (`ray memory` analog): who sealed the payload —
+    # "driver", a worker id hex, or an actor id hex — plus wall-clock
+    # creation time for age and a per-reason pin breakdown.  pins is
+    # ADVISORY accounting layered over ref_count (the lifetime source of
+    # truth): it answers "why is this still alive", not "is it alive".
+    owner: Optional[str] = None
+    owner_kind: str = "unknown"  # driver | worker | actor | head
+    created: float = field(default_factory=time.time)
+    pins: Dict[str, int] = field(default_factory=lambda: {"handle": 1})
     # location SET (ownership_based_object_directory.h:37 analog): nodes
     # holding a pulled copy of the payload, node_id -> object-server addr.
     # Sources for future pulls; survivors when the origin node dies.
@@ -155,6 +164,12 @@ class ObjectRegistry:
         self._capacity = capacity_bytes
         self._spill_dir = spill_dir
         self._num_spilled = 0
+        # incrementally-maintained ownership aggregate: (owner, kind) ->
+        # [bytes, objects] over SEALED entries, adjusted at seal /
+        # node-loss unseal / delete.  owner_summary() reads it in
+        # O(owners) — the every-5s gauge refresh and /metrics scrape must
+        # never scan the full object table under this lock.
+        self._owner_agg: Dict[Tuple[str, str], list] = {}
         # set by the head Node: shm_name -> ask every node agent to unlink.
         # Any node may hold the origin segment OR a pulled replica, so
         # deletion broadcasts (the head's own copy/replica is unlinked
@@ -175,7 +190,8 @@ class ObjectRegistry:
 
     def seal(self, oid: bytes, loc: ObjectLocation,
              contained: Optional[List[bytes]] = None,
-             only_if_live: bool = False) -> bool:
+             only_if_live: bool = False, owner: Optional[str] = None,
+             owner_kind: Optional[str] = None) -> bool:
         """Seal ``oid`` with ``loc``.  With ``only_if_live``, a concurrent
         deletion wins atomically: the prepared payload is discarded instead
         of resurrecting the entry (returns False).  Plain seal returns True."""
@@ -213,10 +229,18 @@ class ObjectRegistry:
             else:
                 e.loc = loc
                 e.contained = list(contained or [])
+                # first seal records the producer as owner; a re-seal after
+                # lineage reconstruction keeps the original attribution
+                if owner is not None and e.owner is None:
+                    e.owner = owner
+                    e.owner_kind = owner_kind or "unknown"
+                e.created = time.time()
+                self._owner_agg_add(e, 1)
                 for c in e.contained:
                     ce = self._objects.get(c)
                     if ce is not None:
                         ce.ref_count += 1
+                        ce.pins["contained"] = ce.pins.get("contained", 0) + 1
                 if loc.shm_name and not loc.node_id:
                     self._bytes_used += loc.size
             if not missed:
@@ -262,8 +286,9 @@ class ObjectRegistry:
                     # drop contained-ref increments this payload made; a
                     # successful re-seal will re-add them
                     for c in e.contained:
-                        self._remove_ref_locked(c, 1, dead)
+                        self._remove_ref_locked(c, 1, dead, "contained")
                     e.contained = []
+                    self._owner_agg_add(e, -1)  # a re-seal re-adds
                     e.loc = None
                     e.sealed = threading.Event()  # fresh event: old waiters
                     # saw the sealed one; new waiters block until refill
@@ -361,30 +386,59 @@ class ObjectRegistry:
             return list(e.replicas) if e is not None else []
 
     # -- reference counting --------------------------------------------
-    def add_ref(self, oid: bytes, n: int = 1) -> None:
+    def add_ref(self, oid: bytes, n: int = 1, reason: str = "handle") -> None:
+        """``reason`` feeds the audit's pin breakdown ("handle" = a live
+        ObjectRef somewhere, "task_arg" = pinned by a pending task's spec,
+        "contained" = referenced inside another sealed object)."""
         with self._lock:
             e = self._objects.get(oid)
             if e is not None:
                 e.ref_count += n
+                e.pins[reason] = e.pins.get(reason, 0) + n
 
-    def remove_ref(self, oid: bytes, n: int = 1) -> None:
+    def remove_ref(self, oid: bytes, n: int = 1,
+                   reason: str = "handle") -> None:
         """Owner-side count decrement; deletes (and cascades to contained
         refs) at zero.  Unsealed entries linger at count<=0 until their
         producer seals, then reclaim immediately."""
         dead: List[bytes] = []
         with self._lock:
-            self._remove_ref_locked(oid, n, dead)
+            self._remove_ref_locked(oid, n, dead, reason)
         self._reap(dead)
 
-    def _remove_ref_locked(self, oid: bytes, n: int, dead: List[bytes]) -> None:
+    def _remove_ref_locked(self, oid: bytes, n: int, dead: List[bytes],
+                           reason: str = "handle") -> None:
         e = self._objects.get(oid)
         if e is None:
             return
         e.ref_count -= n
+        left = e.pins.get(reason, 0) - n
+        if left > 0:
+            e.pins[reason] = left
+        else:
+            e.pins.pop(reason, None)
         if e.ref_count <= 0 and e.sealed.is_set():
             self._delete_locked(oid, e, dead)
 
+    def _owner_agg_add(self, e: "_Entry", n: int) -> None:
+        """Adjust the sealed-bytes-per-owner aggregate by ``n`` objects
+        of the entry's current size (lock held; n is +1 on seal, -1 on
+        unseal/delete — explicit, never inferred from a size sign that a
+        zero-byte payload would break).  An object counts exactly while
+        it is sealed with a location — the same filter a full
+        owner_summary() scan would apply."""
+        key = (e.owner or "unknown", e.owner_kind)
+        agg = self._owner_agg.get(key)
+        if agg is None:
+            agg = self._owner_agg[key] = [0, 0]
+        agg[0] += n * e.loc.size
+        agg[1] += n
+        if agg[1] <= 0:
+            del self._owner_agg[key]
+
     def _delete_locked(self, oid: bytes, e: _Entry, dead: List[tuple]) -> None:
+        if e.loc is not None and e.sealed.is_set():
+            self._owner_agg_add(e, -1)
         if e.loc is not None:
             if e.loc.arena_path:
                 dead.append(("arena", (e.loc.arena_key, e.loc.shm_name)))
@@ -398,7 +452,7 @@ class ObjectRegistry:
                 dead.append(("file", e.loc.spilled_path))
         del self._objects[oid]
         for c in e.contained:
-            self._remove_ref_locked(c, 1, dead)
+            self._remove_ref_locked(c, 1, dead, "contained")
         if self.on_delete is not None:
             dead.append(("hook", oid))
 
@@ -488,31 +542,81 @@ class ObjectRegistry:
                 # sees the spilled file) — unlink them with the original
                 self.broadcast_unlink(shm_name)
 
+    @staticmethod
+    def _where(e: "_Entry") -> str:
+        loc = e.loc
+        if loc is None:
+            return "pending"
+        if loc.inline is not None:
+            return "inline"
+        if loc.spilled_path:
+            return "spilled"
+        return loc.node_id or "head"
+
+    @staticmethod
+    def _pin_reason(e: "_Entry") -> str:
+        """The dominant reason this object is still alive, in pin-strength
+        order: a task-spec pin outlives handles, containment outlives a
+        dropped handle."""
+        for reason in ("task_arg", "lineage", "contained", "handle"):
+            if e.pins.get(reason, 0) > 0:
+                return reason
+        return "unknown"
+
     # -- admin ---------------------------------------------------------
     def list_objects(self, limit: int = 1000) -> List[dict]:
         """State-API view of the object directory (list_objects analog)."""
         import itertools
 
+        now = time.time()
         out = []
         with self._lock:
             for oid, e in itertools.islice(self._objects.items(), limit):
                 loc = e.loc
-                if loc is None:
-                    where = "pending"
-                elif loc.inline is not None:
-                    where = "inline"
-                elif loc.spilled_path:
-                    where = "spilled"
-                else:
-                    where = loc.node_id or "head"
                 out.append({
                     "object_id": oid.hex(),
                     "sealed": e.sealed.is_set(),
                     "ref_count": e.ref_count,
                     "size": loc.size if loc else None,
-                    "where": where,
+                    "where": self._where(e),
+                    "owner": e.owner,
+                    "owner_kind": e.owner_kind,
+                    "pin_reason": self._pin_reason(e),
+                    "age_s": round(now - e.created, 1),
                 })
         return out
+
+    def memory_audit(self) -> List[dict]:
+        """Every SEALED object with ownership/pin detail — the raw rows of
+        the ``ray memory`` table.  Rows are fully materialized under the
+        lock (pins is a live dict a concurrent add_ref mutates; copying
+        it outside would race), sorted outside."""
+        now = time.time()
+        with self._lock:
+            rows = [{
+                "object_id": oid.hex(),
+                "size": e.loc.size,
+                "where": self._where(e),
+                "owner": e.owner or "unknown",
+                "owner_kind": e.owner_kind,
+                "ref_count": e.ref_count,
+                "pins": dict(e.pins),
+                "pin_reason": self._pin_reason(e),
+                "age_s": round(now - e.created, 1),
+            } for oid, e in self._objects.items()
+                if e.sealed.is_set() and e.loc is not None]
+        rows.sort(key=lambda r: -r["size"])
+        return rows
+
+    def owner_summary(self) -> Dict[tuple, dict]:
+        """Sealed bytes/objects by (owner, kind) from the incrementally-
+        maintained aggregate — O(owners), never a table scan.  The shape
+        the every-5s gauge refresh and ``top`` need; per-object rows and
+        the pin-reason breakdown come from :meth:`memory_audit` (the
+        explicit ``ray_tpu memory`` ask)."""
+        with self._lock:
+            return {key: {"bytes": agg[0], "objects": agg[1]}
+                    for key, agg in self._owner_agg.items()}
 
     def stats(self) -> dict:
         with self._lock:

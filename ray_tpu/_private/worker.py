@@ -370,7 +370,7 @@ class Worker:
         # head releases the pins when the task completes.
         pinned = list(dict.fromkeys(dep_ids + [r.binary() for r in contained]))
         if pinned:
-            self.client.add_refs(pinned)
+            self.client.add_refs(pinned, reason="task_arg")
         owned_oids: List[bytes] = []
         total = serialization.total_size(meta, buffers)
         if total <= cfg.max_direct_call_object_size:
@@ -873,7 +873,9 @@ def main() -> None:
 
         atexit.register(_dump_profile)
 
-    # app metrics recorded in this worker flow to the head's /metrics
+    # app metrics recorded in this worker flow to the head's /metrics and
+    # its TSDB; the push cadence follows RAY_TPU_METRICS_PUSH_S so the
+    # head's sample grid, origin-expiry window, and this pusher agree
     from ray_tpu.util.metrics import MetricsPusher
 
     _metrics_pusher = MetricsPusher(

@@ -86,11 +86,15 @@ _INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
  <a href="/api/timeline">/api/timeline</a> (chrome://tracing),
  <a href="/api/events">/api/events</a> (flight recorder),
  <a href="/api/traces">/api/traces</a>[/&lt;id&gt;] (request traces),
+ <a href="/api/metrics/list">/api/metrics/list</a>,
+ /api/metrics/query?name=&amp;window=&amp;step=,
+ <a href="/api/memory">/api/memory</a> (ownership audit),
+ <a href="/api/top">/api/top</a>,
  /api/grafana_dashboard,
  /api/profile?duration=3[&amp;worker_id=][&amp;format=collapsed], /metrics</div>
 <script>
 const TABS=["nodes","actors","tasks","workers","objects","placement_groups",
-            "jobs","serve","events","traces","logs"];
+            "jobs","serve","events","traces","metrics","logs"];
 const ID_FIELD={nodes:"node_id",actors:"actor_id",tasks:"task_id",
  workers:"worker_id",placement_groups:"pg_id",jobs:"job_id",
  traces:"trace_id"};
@@ -125,6 +129,63 @@ async function showLog(stream){
  document.getElementById("logview").textContent=
   r.ok?await r.text():"(stream unavailable)";
 }
+function spark(seriesList){
+ // inline SVG sparkline: one polyline PER label series on shared scales
+ // (concatenating per-worker series into one path renders a sawtooth
+ // alternating between unrelated values, not a trend)
+ const ns="http://www.w3.org/2000/svg";
+ const svg=document.createElementNS(ns,"svg");
+ svg.setAttribute("width","160");svg.setAttribute("height","28");
+ const all=[];seriesList.forEach(s=>all.push(...s));
+ if(all.length<2)return svg;
+ const xs=all.map(p=>p[0]),ys=all.map(p=>p[1]);
+ const x0=Math.min(...xs),x1=Math.max(...xs),
+       y0=Math.min(...ys),y1=Math.max(...ys);
+ const sx=x1>x0?156/(x1-x0):0,sy=y1>y0?24/(y1-y0):0;
+ seriesList.slice(0,8).forEach((pts,si)=>{
+  if(pts.length<2)return;
+  const d=pts.map((p,i)=>(i?"L":"M")+(2+(p[0]-x0)*sx).toFixed(1)+","+
+    (26-(p[1]-y0)*sy).toFixed(1)).join(" ");
+  const path=document.createElementNS(ns,"path");
+  path.setAttribute("d",d);path.setAttribute("fill","none");
+  path.setAttribute("stroke",si?"#7a86b8":"#1a1a2e");
+  path.setAttribute("stroke-width","1.2");
+  svg.appendChild(path);
+ });
+ return svg;
+}
+async function renderMetrics(){
+ // TSDB-backed trend view: one sparkline per retained metric
+ document.getElementById("logpane").style.display="none";
+ const tbl=document.getElementById("tbl");tbl.style.display="";
+ const list=(await (await fetch("/api/metrics/list")).json()).slice(0,30);
+ const qs=await Promise.all(list.map(m=>
+  fetch(`/api/metrics/query?name=${encodeURIComponent(m.name)}`+
+        "&window=1800&step=30").then(r=>r.json()).catch(()=>null)));
+ const thead=document.querySelector("#tbl thead"),
+       tbody=document.querySelector("#tbl tbody");
+ thead.innerHTML="<tr><th>metric</th><th>type</th><th>series</th>"+
+  "<th>last</th><th>trend (30m)</th></tr>";
+ tbody.textContent="";
+ list.forEach((m,i)=>{
+  const q=qs[i];
+  const seriesList=q?q.series.map(s=>s.points):[];
+  // "last" is only meaningful for a single series; show the spread
+  // across series otherwise
+  let last="";
+  const lasts=seriesList.filter(p=>p.length).map(p=>p[p.length-1][1]);
+  if(lasts.length===1)last=String(lasts[0]);
+  else if(lasts.length>1)
+   last=`${Math.min(...lasts)}…${Math.max(...lasts)}`;
+  const tr=document.createElement("tr");
+  [m.name,m.type,String(m.num_series),last].forEach(t=>{
+   const td=document.createElement("td");td.textContent=t;tr.appendChild(td);});
+  const td=document.createElement("td");td.appendChild(spark(seriesList));
+  tr.appendChild(td);tbody.appendChild(tr);
+ });
+ if(!list.length){thead.innerHTML="";
+  tbody.innerHTML="<tr><td>(no series retained yet)</td></tr>";}
+}
 async function renderLogs(){
  document.getElementById("tbl").style.display="none";
  const pane=document.getElementById("logpane");pane.style.display="block";
@@ -158,6 +219,7 @@ async function render(){
   document.getElementById("cards").innerHTML=cards.map(([k,v])=>
    `<div class=card><b>${v}</b><small>${k}</small></div>`).join("");
   if(tab==="logs"){await renderLogs();return;}
+  if(tab==="metrics"){await renderMetrics();return;}
   document.getElementById("logpane").style.display="none";
   document.getElementById("tbl").style.display="";
   const url=tab==="serve"?"/api/serve/applications":"/api/"+tab+"?limit=200";
@@ -257,6 +319,41 @@ class Dashboard:
                            ctype="text/plain; charset=utf-8")
                 return
             self._send(req, json.dumps(result))
+            return
+        if path == "/api/metrics/list":
+            # TSDB directory: every metric with retained history
+            self._send(req, json.dumps(self.node.tsdb.list_metrics()))
+            return
+        if path == "/api/metrics/query":
+            # time-series query over the head TSDB (the sparkline/Grafana
+            # backend): ?name=...&window=3600&step=60[&agg=max]
+            name = qs.get("name", [""])[0]
+            if not name:
+                req.send_response(400)
+                req.end_headers()
+                req.wfile.write(b'{"error": "name required"}')
+                return
+            try:
+                result = self.node.tsdb.query(
+                    name,
+                    window_s=float(qs.get("window", ["3600"])[0]),
+                    step_s=float(qs.get("step", ["0"])[0]),
+                    agg=qs.get("agg", [None])[0],
+                )
+            except ValueError as e:
+                req.send_response(400)
+                req.end_headers()
+                req.wfile.write(json.dumps({"error": str(e)}).encode())
+                return
+            self._send(req, json.dumps(result))
+            return
+        if path == "/api/memory":
+            # object-ownership audit (`ray memory` analog over HTTP)
+            self._send(req, json.dumps(_jsonable(
+                self.node._memory_audit(limit=limit))))
+            return
+        if path == "/api/top":
+            self._send(req, json.dumps(_jsonable(self.node._top_snapshot())))
             return
         if path.startswith("/api/logs/"):
             # tail one log stream as plain text (reference log viewer:
@@ -360,7 +457,8 @@ class Dashboard:
                 generate_grafana_dashboard,
             )
 
-            return generate_grafana_dashboard(self._merged_snapshot())
+            return generate_grafana_dashboard(self._merged_snapshot(),
+                                              tsdb=node.tsdb)
         if what == "logs":
             return self._log_streams()
         if what == "serve/config":
@@ -597,42 +695,11 @@ class Dashboard:
 
     def _merged_snapshot(self) -> dict:
         """Head registry + worker-reported metrics, with runtime gauges
-        refreshed at scrape time (metric_defs.cc analog)."""
+        refreshed at scrape time (metric_defs.cc analog).  The gauge
+        refresh lives on the Node so the TSDB sample loop and this scrape
+        path can never disagree about what the runtime gauges mean."""
         node = self.node
-        from ray_tpu.util.metrics import Gauge
-
-        g = Gauge("ray_tpu_objects_in_store", "objects tracked by the registry")
-        stats = node.registry.stats()
-        g.set(stats["num_objects"])
-        Gauge("ray_tpu_object_store_bytes", "head-local shm bytes").set(stats["bytes_used"])
-        Gauge("ray_tpu_objects_spilled", "objects spilled to disk").set(
-            stats.get("num_spilled", 0))
-        arena = getattr(node, "arena", None)
-        if arena is not None:
-            try:
-                astats = arena.stats()
-                Gauge("ray_tpu_arena_bytes_used",
-                      "native arena bytes allocated").set(astats["bytes_used"])
-                Gauge("ray_tpu_arena_capacity_bytes",
-                      "native arena capacity").set(astats["capacity"])
-            except Exception:
-                pass
-        with node.lock:
-            n_workers = len([w for w in node.workers.values() if w.state != "dead"])
-            n_nodes = len([ns for ns in node.nodes.values() if ns.alive])
-            n_pending = len(node.pending_tasks)
-        Gauge("ray_tpu_num_workers", "live workers").set(n_workers)
-        Gauge("ray_tpu_num_nodes", "alive nodes").set(n_nodes)
-        Gauge("ray_tpu_sched_queue_depth",
-              "tasks pending cluster-wide (not yet staged on a node)").set(n_pending)
-        for src, n in node.events.counts().items():
-            Gauge("ray_tpu_events_recorded",
-                  "flight-recorder events held per source").set(
-                n, tags={"source": src})
-        with node.gcs.lock:
-            for state in ("PENDING", "RUNNING", "FINISHED", "FAILED"):
-                n = sum(1 for t in node.gcs.tasks.values() if t.state == state)
-                Gauge("ray_tpu_tasks", "tasks by state").set(n, tags={"state": state})
+        node.refresh_runtime_gauges()
         return metrics_mod.merge_snapshots(
             metrics_mod.registry().snapshot(),
             node.worker_metrics_registry.snapshot(),

@@ -16,6 +16,7 @@ head's TraceTable).
 
 from __future__ import annotations
 
+import warnings
 from typing import Dict, List, Optional
 
 
@@ -27,12 +28,31 @@ def _client():
     return global_worker.client
 
 
-def _list(what: str, limit: int, filters: Optional[dict] = None) -> List[dict]:
+def list_state_page(what: str, limit: int = 1000,
+                    filters: Optional[dict] = None) -> dict:
+    """One page of a state table WITH its truncation marker:
+    ``{"rows", "total", "truncated"}``.  The plain ``list_*`` helpers
+    return bare rows for compatibility — use this when completeness
+    matters (the CLI prints the marker from it)."""
     msg = {"type": "list_state", "what": what, "limit": limit}
     if filters:
         msg["filters"] = filters
     reply = _client().request(msg)
-    return reply["value"]
+    rows = reply["value"]
+    total = reply.get("total", len(rows))
+    return {"rows": rows, "total": total, "truncated": total > len(rows)}
+
+
+def _list(what: str, limit: int, filters: Optional[dict] = None) -> List[dict]:
+    page = list_state_page(what, limit, filters)
+    if page["truncated"]:
+        # a silent cap reads as "this is everything" on a large cluster —
+        # make the partial view loud without changing the return shape
+        warnings.warn(
+            f"list_{what} truncated: showing {len(page['rows'])} of "
+            f"{page['total']} rows (raise limit= to see the rest)",
+            stacklevel=3)
+    return page["rows"]
 
 
 def list_actors(limit: int = 1000) -> List[dict]:
@@ -121,3 +141,49 @@ def get_trace(trace_id: str) -> Optional[dict]:
     None if the id is unknown."""
     return _client().request(
         {"type": "get_trace", "trace_id": trace_id})["value"]
+
+
+# ---------------------------------------------------------------------------
+# resource accounting over time (head TSDB + ownership audit)
+# ---------------------------------------------------------------------------
+
+def list_metrics() -> List[dict]:
+    """Every metric with retained history in the head's TSDB: name, type,
+    number of label series, origins, freshest sample time."""
+    return _client().request({"type": "list_metrics"})["value"]
+
+
+def query_metric(name: str, window_s: float = 3600.0, step_s: float = 0.0,
+                 tags: Optional[Dict[str, str]] = None,
+                 agg: Optional[str] = None) -> dict:
+    """Aligned time series for one metric over the trailing window,
+    served from the head's staged-downsampling TSDB — the data behind
+    sparklines, trend doctor rules, and capacity questions a snapshot
+    can't answer.  ``step_s <= 0`` uses the sample interval; ``agg`` is
+    one of last/max/min/sum/avg/count (default: the metric's natural
+    aggregation)."""
+    msg = {"type": "query_metric", "name": name, "window_s": window_s,
+           "step_s": step_s}
+    if tags:
+        msg["tags"] = tags
+    if agg:
+        msg["agg"] = agg
+    value = _client().request(msg)["value"]
+    if isinstance(value, dict) and "__state_error__" in value:
+        raise ValueError(value["__state_error__"])
+    return value
+
+
+def memory_summary(limit: int = 200) -> dict:
+    """Object-ownership audit (``ray memory`` analog): sealed object-store
+    bytes attributed per owner (driver/worker/actor), pin-reason
+    breakdown, per-object rows sorted by size, and orphan flags for
+    objects whose owner process is gone."""
+    return _client().request(
+        {"type": "memory_audit", "limit": limit})["value"]
+
+
+def top_snapshot() -> dict:
+    """One frame of ``ray_tpu top``: nodes with host stats, workers with
+    sampled RSS/CPU/fds and pinned bytes, task-state and store summaries."""
+    return _client().request({"type": "top_snapshot"})["value"]

@@ -15,6 +15,9 @@ Reference: ``python/ray/scripts/scripts.py`` (cluster lifecycle) and
     events [--source S --severity L --limit N] flight-recorder event table
     trace [TRACE_ID]                           span tree + critical path
     doctor                                     pathology analysis (exit 1 on findings)
+    top [--interval S --iterations N --sort K] live nodes/workers resource view
+    memory [--limit N --json]                  object-ownership audit (`ray memory`)
+    metrics [NAME] [--window S --step S]       TSDB directory / time-series query
     profile [--duration N --worker-id HEX]     sampling profile via the dashboard
     serve-status                               serve deployments + autoscaling
 """
@@ -128,8 +131,14 @@ def cmd_list(args) -> None:
     _connect()
     from ray_tpu.experimental.state import api as state
 
-    rows = getattr(state, f"list_{args.what}")(limit=args.limit)
-    print(json.dumps(rows, indent=2, default=repr))
+    page = state.list_state_page(args.what, limit=args.limit)
+    print(json.dumps(page["rows"], indent=2, default=repr))
+    if page["truncated"]:
+        # loud, and on stderr so piped JSON stays parseable — a capped
+        # listing must never masquerade as the complete table
+        print(f"# truncated: showing {len(page['rows'])} of "
+              f"{page['total']} rows (use --limit {page['total']})",
+              file=sys.stderr)
 
 
 def cmd_submit(args) -> None:
@@ -239,6 +248,147 @@ def cmd_doctor(args) -> None:
         print(render(findings))
     if findings:
         sys.exit(1)
+
+
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def _render_top(snap: dict, sort: str) -> str:
+    """One ``top`` frame as text (htop-style, data from the head's
+    per-entity sampler + ownership audit)."""
+    out = []
+    tasks = snap.get("tasks", {})
+    store = snap.get("store", {})
+    out.append(
+        f"ray_tpu top — nodes {len(snap['nodes'])}  "
+        f"workers {len(snap['workers'])}  "
+        f"tasks P/R/F: {tasks.get('PENDING', 0)}/{tasks.get('RUNNING', 0)}/"
+        f"{tasks.get('FINISHED', 0)}  "
+        f"store {_fmt_bytes(store.get('bytes_used'))} "
+        f"in {store.get('num_objects', 0)} objects"
+        + (f"  ORPHANED {_fmt_bytes(snap['orphan_bytes'])}"
+           if snap.get("orphan_bytes") else ""))
+    out.append("")
+    out.append(f"{'NODE':<22} {'ALIVE':<6} {'UTIL':>5} {'LOAD1':>6} "
+               f"{'MEM-AVAIL':>10}")
+    for n in snap["nodes"]:
+        hs = n.get("host_stats") or {}
+        out.append(
+            f"{n['node_id']:<22} {str(n['alive']):<6} "
+            f"{n['utilization'] * 100:>4.0f}% "
+            f"{hs.get('load_1m', 0):>6.2f} "
+            f"{hs.get('mem_available_mb', 0):>8.0f}MB")
+    out.append("")
+    key = {"cpu": lambda w: -(w.get("cpu_pct") or 0),
+           "rss": lambda w: -(w.get("rss_mb") or 0),
+           "pinned": lambda w: -(w.get("pinned_bytes") or 0)}[sort]
+    out.append(f"{'WORKER':<18} {'KIND':<18} {'NODE':<14} {'PID':>7} "
+               f"{'STATE':<9} {'CPU%':>6} {'RSS':>9} {'FDS':>5} {'PINNED':>10}")
+    for w in sorted(snap["workers"], key=key):
+        kind = w.get("actor_class") or w["kind"]
+        rss = w.get("rss_mb")
+        cpu = w.get("cpu_pct")
+        out.append(
+            f"{w['worker_id'][:16]:<18} {kind[:17]:<18} "
+            f"{w['node_id'][:13]:<14} {w.get('pid') or '-':>7} "
+            f"{w['state']:<9} "
+            f"{f'{cpu:.1f}' if cpu is not None else '-':>6} "
+            f"{f'{rss:.0f}MB' if rss is not None else '-':>9} "
+            f"{int(w['open_fds']) if w.get('open_fds') is not None else '-':>5} "
+            f"{_fmt_bytes(w.get('pinned_bytes')):>10}")
+    owners = snap.get("owners") or []
+    if owners:
+        out.append("")
+        out.append(f"{'OWNER (pinned bytes)':<40} {'BYTES':>10} {'OBJECTS':>8}")
+        for o in owners[:10]:
+            label = o.get("owner_label", o["owner"])
+            flag = "  [ORPHAN]" if o.get("orphan") else ""
+            out.append(f"{label[:39]:<40} {_fmt_bytes(o['bytes']):>10} "
+                       f"{o['objects']:>8}{flag}")
+    return "\n".join(out)
+
+
+def cmd_top(args) -> None:
+    """Live cluster resource view (``htop`` for the cluster): nodes,
+    workers/actors sorted by CPU/RSS/pinned bytes, refreshed in place."""
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    i = 0
+    try:
+        while True:
+            frame = _render_top(state.top_snapshot(), args.sort)
+            if args.iterations != 1 and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")  # clear + home
+            print(frame)
+            i += 1
+            if args.iterations and i >= args.iterations:
+                return
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_memory(args) -> None:
+    """Object-ownership audit (``ray memory`` analog): bytes by owner and
+    pin reason, per-object rows, orphan flags."""
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    audit = state.memory_summary(limit=args.limit)
+    if args.json:
+        print(json.dumps(audit, indent=2, default=repr))
+        return
+    frac = audit["attributed_frac"] * 100.0
+    print(f"ray_tpu memory — {_fmt_bytes(audit['total_bytes'])} sealed in "
+          f"{audit['num_objects']} objects; {frac:.1f}% attributed to an "
+          f"owner; orphaned {_fmt_bytes(audit['orphan_bytes'])}")
+    reasons = ", ".join(f"{r}={_fmt_bytes(b)}" for r, b in
+                        sorted(audit["by_pin_reason"].items()))
+    if reasons:
+        print(f"pinned by: {reasons}")
+    print()
+    print(f"{'OWNER':<40} {'KIND':<8} {'BYTES':>10} {'OBJECTS':>8}")
+    for o in audit["by_owner"]:
+        flag = "  [ORPHAN: owner dead]" if o.get("orphan") else ""
+        print(f"{o['owner_label'][:39]:<40} {o['owner_kind']:<8} "
+              f"{_fmt_bytes(o['bytes']):>10} {o['objects']:>8}{flag}")
+    rows = audit.get("rows") or []
+    if rows:
+        print()
+        # full object ids: they share a per-process prefix, so a truncated
+        # id renders every row identical
+        print(f"{'OBJECT':<34} {'SIZE':>10} {'WHERE':<10} {'OWNER':<28} "
+              f"{'PIN':<10} {'AGE':>8}")
+        for r in rows:
+            flag = " [ORPHAN]" if r.get("orphan") else ""
+            print(f"{r['object_id']:<34} {_fmt_bytes(r['size']):>10} "
+                  f"{r['where'][:9]:<10} "
+                  f"{r.get('owner_label', r['owner'])[:27]:<28} "
+                  f"{r['pin_reason']:<10} {r['age_s']:>7.0f}s{flag}")
+
+
+def cmd_metrics(args) -> None:
+    """TSDB surface: without a name, the metric directory; with one, the
+    queried series as JSON."""
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    if not args.name:
+        for m in state.list_metrics():
+            print(f"{m['name']:<44} {m['type']:<10} "
+                  f"{m['num_series']:>4} series  "
+                  f"origins: {', '.join(m['origins'][:4])}")
+        return
+    result = state.query_metric(args.name, window_s=args.window,
+                                step_s=args.step, agg=args.agg)
+    print(json.dumps(result, indent=2))
 
 
 def cmd_profile(args) -> None:
@@ -422,6 +572,33 @@ def main(argv=None) -> None:
              "(exit 1 on findings)")
     s.add_argument("--json", action="store_true")
     s.set_defaults(fn=cmd_doctor)
+
+    s = sub.add_parser(
+        "top", help="live cluster resource view (nodes, workers, pinned "
+                    "bytes; Ctrl-C to exit)")
+    s.add_argument("--interval", type=float, default=2.0)
+    s.add_argument("--iterations", type=int, default=0,
+                   help="frames to render (0 = forever); 1 prints once")
+    s.add_argument("--sort", choices=["cpu", "rss", "pinned"], default="cpu")
+    s.set_defaults(fn=cmd_top)
+
+    s = sub.add_parser(
+        "memory",
+        help="object-ownership audit: bytes by owner/pin reason (`ray "
+             "memory` analog)")
+    s.add_argument("--limit", type=int, default=20,
+                   help="per-object rows to show (aggregates cover all)")
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_memory)
+
+    s = sub.add_parser(
+        "metrics", help="metrics TSDB: directory, or query one series")
+    s.add_argument("name", nargs="?", default=None)
+    s.add_argument("--window", type=float, default=3600.0)
+    s.add_argument("--step", type=float, default=0.0)
+    s.add_argument("--agg", choices=["last", "max", "min", "sum", "avg",
+                                     "count"], default=None)
+    s.set_defaults(fn=cmd_metrics)
 
     s = sub.add_parser(
         "profile", help="sampling profile of the head or a worker")

@@ -38,6 +38,18 @@ SKEW_RATIO = 3.0          # slowest-node / fastest-node mean exec ratio
 SKEW_MIN_TASKS = 5        # per (task name, node) sample floor
 SKEW_MIN_DELTA_S = 0.05   # absolute mean gap floor (noise guard)
 
+# -- trend-rule thresholds (over TSDB series — slopes only a time series
+# can express; point-in-time snapshots cannot false-positive OR true-
+# positive on any of these) -------------------------------------------------
+TREND_MIN_POINTS = 6        # samples before any slope is trusted
+RSS_SLOPE_MB_PER_MIN = 5.0  # per-process RSS growth rate to flag
+RSS_GROWTH_MIN_MB = 64.0    # absolute growth floor (warmup noise guard)
+RSS_MONOTONE_FRAC = 0.8     # fraction of deltas that must be increases
+STORE_SLOPE_MB_PER_MIN = 16.0  # object-store bytes growth rate to flag
+STORE_GROWTH_MIN_MB = 64.0
+QUEUE_CLIMB_MIN_DEPTH = 1.0  # queue never drained below this AND
+QUEUE_CLIMB_RATIO = 2.0      # ended >= this multiple of where it started
+
 
 def _finding(rule: str, severity: str, summary: str,
              evidence: Sequence[dict], remedy: str) -> dict:
@@ -237,6 +249,151 @@ def _rule_slow_node_skew(events, tasks):
         "the dashboard (CPU steal, thermal, noisy neighbor) or drain it")
 
 
+# ---------------------------------------------------------------------------
+# trend rules (each: series_map -> finding | None).  series_map is
+# {metric_name: [{"tags": {...}, "points": [[ts, value], ...]}, ...]} —
+# the shape `query_metric` returns, so the rules run identically over a
+# live TSDB and synthetic fixtures.
+# ---------------------------------------------------------------------------
+
+def _slope_per_min(points) -> float:
+    """Least-squares slope in value-units per minute."""
+    n = len(points)
+    if n < 2:
+        return 0.0
+    xs = [p[0] for p in points]
+    ys = [p[1] for p in points]
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    den = sum((x - mx) ** 2 for x in xs)
+    if den <= 0:
+        return 0.0
+    return sum((x - mx) * (y - my) for x, y in points) / den * 60.0
+
+
+def _monotone_frac(points) -> float:
+    deltas = [b[1] - a[1] for a, b in zip(points, points[1:])]
+    if not deltas:
+        return 0.0
+    return sum(1 for d in deltas if d > 0) / len(deltas)
+
+
+def _trend_rule_rss_growth(series_map):
+    """A worker whose RSS climbs monotonically for the whole window is
+    leaking (or unboundedly caching) — a snapshot can't see it, a slope
+    can."""
+    worst = None
+    for s in series_map.get("ray_tpu_proc_rss_mb", ()):
+        pts = s.get("points") or []
+        if len(pts) < TREND_MIN_POINTS:
+            continue
+        growth = pts[-1][1] - pts[0][1]
+        slope = _slope_per_min(pts)
+        mono = _monotone_frac(pts)
+        if (slope >= RSS_SLOPE_MB_PER_MIN and growth >= RSS_GROWTH_MIN_MB
+                and mono >= RSS_MONOTONE_FRAC):
+            row = {"tags": s.get("tags", {}), "slope_mb_per_min": round(slope, 2),
+                   "growth_mb": round(growth, 1), "monotone_frac": round(mono, 2),
+                   "window_points": len(pts)}
+            if worst is None or slope > worst["slope_mb_per_min"]:
+                worst = row
+    if worst is None:
+        return None
+    who = worst["tags"].get("worker_id", "?")
+    return _finding(
+        "rss_growth", "WARNING",
+        f"process {who} RSS grew {worst['growth_mb']:.0f}MB at "
+        f"{worst['slope_mb_per_min']:.1f}MB/min, "
+        f"{worst['monotone_frac'] * 100:.0f}% monotone — memory leak "
+        "suspect",
+        [worst],
+        "a worker/actor is accumulating memory: check for unbounded "
+        "caches or growing actor state; restart_policy/max_calls bound "
+        "the blast radius while you find it")
+
+
+def _trend_rule_store_leak(series_map):
+    """Object-store bytes climbing steadily means refs are being created
+    faster than released — the 'who owns these 6 GiB' precursor."""
+    for name in ("ray_tpu_object_store_bytes", "ray_tpu_arena_bytes_used"):
+        for s in series_map.get(name, ()):
+            pts = s.get("points") or []
+            if len(pts) < TREND_MIN_POINTS:
+                continue
+            growth_mb = (pts[-1][1] - pts[0][1]) / (1 << 20)
+            slope_mb = _slope_per_min(pts) / (1 << 20)
+            if (slope_mb >= STORE_SLOPE_MB_PER_MIN
+                    and growth_mb >= STORE_GROWTH_MIN_MB
+                    and _monotone_frac(pts) >= RSS_MONOTONE_FRAC):
+                ev = {"metric": name, "tags": s.get("tags", {}),
+                      "slope_mb_per_min": round(slope_mb, 2),
+                      "growth_mb": round(growth_mb, 1)}
+                return _finding(
+                    "object_store_leak", "WARNING",
+                    f"{name} grew {growth_mb:.0f}MB at "
+                    f"{slope_mb:.1f}MB/min without receding — object "
+                    "refs are outliving their use",
+                    [ev],
+                    "run `ray_tpu memory` to see which owner holds the "
+                    "bytes; del refs promptly, or stream instead of "
+                    "materializing")
+    return None
+
+
+def _trend_rule_queue_climb(series_map):
+    """A queue that never drains AND keeps climbing is demand outrunning
+    capacity — backlog, not burst."""
+    for s in series_map.get("ray_tpu_sched_queue_depth", ()):
+        pts = s.get("points") or []
+        if len(pts) < TREND_MIN_POINTS:
+            continue
+        lo = min(p[1] for p in pts)
+        first = max(pts[0][1], QUEUE_CLIMB_MIN_DEPTH)
+        last = pts[-1][1]
+        if (lo >= QUEUE_CLIMB_MIN_DEPTH and last >= first * QUEUE_CLIMB_RATIO
+                and _slope_per_min(pts) > 0):
+            ev = {"tags": s.get("tags", {}), "min_depth": lo,
+                  "start_depth": pts[0][1], "end_depth": last,
+                  "slope_per_min": round(_slope_per_min(pts), 2)}
+            return _finding(
+                "queue_depth_climb", "WARNING",
+                f"scheduler queue climbed {pts[0][1]:.0f} -> {last:.0f} "
+                f"without ever draining below {lo:.0f} — sustained "
+                "overload, not a burst",
+                [ev],
+                "demand exceeds cluster capacity: add nodes, lower "
+                "submission rate, or batch smaller tasks into fewer "
+                "larger ones")
+    return None
+
+
+TREND_RULES = (
+    _trend_rule_rss_growth,
+    _trend_rule_store_leak,
+    _trend_rule_queue_climb,
+)
+
+# metric names the live doctor pulls from the TSDB for the trend pass
+TREND_METRICS = (
+    "ray_tpu_proc_rss_mb",
+    "ray_tpu_object_store_bytes",
+    "ray_tpu_arena_bytes_used",
+    "ray_tpu_sched_queue_depth",
+)
+
+
+def diagnose_trends(series_map: Dict[str, list]) -> List[dict]:
+    """Run the trend rules over queried series (same finding shape as
+    :func:`diagnose`; pure — feed it synthetic series in tests)."""
+    findings = []
+    for rule in TREND_RULES:
+        f = rule(series_map)
+        if f is not None:
+            findings.append(f)
+    findings.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
+    return findings
+
+
 RULES = (
     _rule_oom_kills,
     _rule_gang_restart,
@@ -263,13 +420,33 @@ def diagnose(events: Sequence[dict],
     return findings
 
 
-def run_doctor(limit: int = 100_000) -> List[dict]:
-    """Pull the live cluster's event + task tables and diagnose them."""
+def run_doctor(limit: int = 100_000,
+               trend_window_s: float = 1800.0) -> List[dict]:
+    """Pull the live cluster's event + task tables and diagnose them,
+    then run the trend rules over the head TSDB's recent history (the
+    pathologies only a time series can express)."""
+    import warnings
+
     from ray_tpu.experimental.state import api as state
 
-    events = state.list_events(limit=limit)
-    tasks = state.list_tasks(limit=limit)
-    return diagnose(events, tasks)
+    with warnings.catch_warnings():
+        # the doctor reads capped tables knowingly; the truncation
+        # warning is for listings presented as complete views
+        warnings.simplefilter("ignore")
+        events = state.list_events(limit=limit)
+        tasks = state.list_tasks(limit=limit)
+    findings = diagnose(events, tasks)
+    series_map: Dict[str, list] = {}
+    for name in TREND_METRICS:
+        try:
+            q = state.query_metric(name, window_s=trend_window_s)
+            series_map[name] = q.get("series", [])
+        except Exception:  # noqa: BLE001 — an old head without a TSDB
+            # still gets the event/task diagnosis
+            break
+    findings.extend(diagnose_trends(series_map))
+    findings.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
+    return findings
 
 
 def render(findings: List[dict]) -> str:
@@ -284,7 +461,10 @@ def render(findings: List[dict]) -> str:
         for ev in f["evidence"][:3]:
             desc = {k: v for k, v in ev.items()
                     if k in ("ts", "message", "entity_id", "origin",
-                             "data", "name", "slow", "fast", "ratio")}
+                             "data", "name", "slow", "fast", "ratio",
+                             "tags", "metric", "slope_mb_per_min",
+                             "growth_mb", "monotone_frac", "min_depth",
+                             "start_depth", "end_depth", "slope_per_min")}
             out.append(f"  evidence: {desc}")
         if f["count"] > 3:
             out.append(f"  ... {f['count'] - 3} more evidence row(s)")

@@ -11,6 +11,7 @@ merged registry in Prometheus text exposition format at ``/metrics``.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -23,6 +24,14 @@ class _Registry:
         self.lock = threading.Lock()
         # name -> {"type", "help", "values": {labelkey: value-or-histogram}}
         self.metrics: Dict[str, dict] = {}
+        # origin -> last merge wall time: dead origins (a worker that
+        # exited, a node that left) stop refreshing and get expired by
+        # expire_origins instead of polluting /metrics forever
+        self.origin_seen: Dict[str, float] = {}
+        # origin -> {metric name -> full label keys it last pushed}: the
+        # replacement-merge and expiry index, so both touch only the
+        # origin's OWN series (never a rebuild of the cross-origin dict)
+        self.origin_keys: Dict[str, Dict[str, set]] = {}
 
     def register(self, name: str, mtype: str, help_: str) -> dict:
         with self.lock:
@@ -42,14 +51,56 @@ class _Registry:
             }
 
     def merge(self, origin: str, snap: Dict[str, dict]) -> None:
-        """Fold a remote process's snapshot in, labeled by origin."""
+        """Fold a remote process's snapshot in, labeled by origin.
+
+        REPLACEMENT semantics per (origin, metric): each push carries the
+        origin's complete current value set for every metric it reports,
+        so label series absent from this push no longer exist at the
+        origin (a dead worker pid in a node agent's per-process gauges, a
+        series retired via ``Metric.remove``) and must leave the merged
+        view — accumulate-only merging kept them forever.  The
+        ``origin_keys`` index makes the replacement O(this origin's
+        series), not a rebuild of every origin's values."""
+        origin_tag = ("origin", origin)
         with self.lock:
+            self.origin_seen[origin] = time.time()
+            prev = self.origin_keys.setdefault(origin, {})
             for name, m in snap.items():
                 cur = self.metrics.setdefault(
                     name, {"type": m["type"], "help": m["help"], "values": {}}
                 )
+                vals = cur["values"]
+                new_keys = set()
                 for key, value in m["values"].items():
-                    cur["values"][tuple(key) + (("origin", origin),)] = value
+                    fk = tuple(key) + (origin_tag,)
+                    vals[fk] = value
+                    new_keys.add(fk)
+                for fk in prev.get(name, set()) - new_keys:
+                    vals.pop(fk, None)
+                prev[name] = new_keys
+
+    def expire_origins(self, max_age_s: float,
+                       now: Optional[float] = None) -> List[str]:
+        """Drop every merged label series whose origin has not pushed
+        within ``max_age_s`` (3 push intervals at the head).  Without
+        this, merge() keeps dead workers'/nodes' series forever and the
+        merged registry grows monotonically with churn."""
+        if now is None:
+            now = time.time()
+        with self.lock:
+            stale = {o for o, ts in self.origin_seen.items()
+                     if now - ts > max_age_s}
+            if not stale:
+                return []
+            for o in stale:
+                for name, keys in self.origin_keys.pop(o, {}).items():
+                    m = self.metrics.get(name)
+                    if m is None:
+                        continue
+                    for fk in keys:
+                        m["values"].pop(fk, None)
+                del self.origin_seen[o]
+            return sorted(stale)
 
 
 _global = _Registry()
@@ -75,6 +126,20 @@ class Metric:
         merged = dict(self._default_tags)
         merged.update(tags or {})
         return _labelkey(merged)
+
+    def remove(self, tags: Optional[Dict[str, str]] = None) -> bool:
+        """Retire one label series (e.g. a per-worker gauge after that
+        worker dies) without restarting the process.  Returns whether the
+        series existed."""
+        key = self._key(tags)
+        with _global.lock:
+            return self._m["values"].pop(key, None) is not None
+
+    def label_sets(self) -> List[Dict[str, str]]:
+        """The live label sets of this metric (samplers diff this against
+        what they just observed to find series to retire)."""
+        with _global.lock:
+            return [dict(key) for key in self._m["values"]]
 
 
 class Counter(Metric):
@@ -174,20 +239,66 @@ def prometheus_text(snap: Optional[Dict[str, dict]] = None) -> str:
     return "\n".join(out) + "\n"
 
 
+def push_interval_s() -> float:
+    """The cluster-wide metrics push cadence (workers, node agents, and
+    the head's self-sample loop all tick at this; the head's TSDB and its
+    origin-expiry windows are sized from it)."""
+    try:
+        return max(0.05, float(os.environ.get("RAY_TPU_METRICS_PUSH_S", "5")))
+    except ValueError:
+        return 5.0
+
+
+def grid_ticks(interval_s: float, wait_fn):
+    """Deadline-grid ticker shared by every sampling/push loop (this
+    pusher, the node agent's resource sampler, the head's TSDB loop).
+
+    Ticks are scheduled on a fixed grid (next = start + k*interval), not
+    ``interval`` after the previous body finished: sleep-after-work
+    drifts by the body's duration, and the TSDB's downsampling assumes
+    uniform sample spacing.  Grid points the body overran are skipped
+    (no burst catch-up; the grid phase is preserved).
+
+    ``wait_fn(timeout) -> truthy`` ends the loop (an ``Event.wait``, or
+    a sleep returning a shutdown flag).  Yields ``stalled``: True when
+    the previous tick was delayed by more than one extra interval —
+    loops that expire peers by timestamp must skip expiry on such a
+    tick, because a stall of THIS process delays everyone's timestamps
+    equally and would read every live peer as dead."""
+    next_tick = time.monotonic() + interval_s
+    last = time.monotonic()
+    while True:
+        if wait_fn(max(0.0, next_tick - time.monotonic())):
+            return
+        now = time.monotonic()
+        next_tick += interval_s
+        if next_tick <= now:  # body overran: skip to the next future
+            next_tick = now + interval_s - ((now - next_tick) % interval_s)
+        stalled = now - last > 2 * interval_s
+        last = now
+        yield stalled
+
+
 class MetricsPusher:
     """Background thread shipping this process's registry to the head
     (the per-node metrics-agent push path).
 
-    Send failures are retried with bounded exponential backoff — a
-    transient head hiccup (GC pause, reconnect) must not permanently
-    silence this process's metrics.  The loop only exits when
-    :meth:`stop` is called or ``closed_fn`` reports the client closed."""
+    Ticks ride the shared deadline grid (:func:`grid_ticks`) so the
+    sample spacing the head's TSDB assumes stays uniform under slow
+    sends.  A failed send is simply retried at the NEXT grid tick — one
+    small send per interval costs nothing, and any longer backoff would
+    open a gap wider than the head's 3-interval origin-expiry window,
+    letting a single transient failure pass for this process's death
+    (wiping its series from /metrics and its TSDB history).  The loop
+    only exits when :meth:`stop` is called or ``closed_fn`` reports the
+    client closed."""
 
-    def __init__(self, send_fn, origin: str, interval_s: float = 5.0,
+    def __init__(self, send_fn, origin: str, interval_s: Optional[float] = None,
                  closed_fn=None):
         self._send = send_fn
         self._origin = origin
-        self._interval = interval_s
+        self._interval = interval_s if interval_s is not None \
+            else push_interval_s()
         self._closed = closed_fn
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
@@ -198,20 +309,17 @@ class MetricsPusher:
         return self
 
     def _loop(self) -> None:
-        backoff = self._interval
-        while not self._stop.wait(backoff):
+        for _ in grid_ticks(self._interval, self._stop.wait):
             if self._closed is not None and self._closed():
                 return
             snap = _global.snapshot()
             if not snap:
-                backoff = self._interval
                 continue
             try:
                 self._send({"type": "metrics_report", "origin": self._origin,
                             "metrics": snap})
-                backoff = self._interval
             except Exception:
-                backoff = min(30.0, backoff * 2)
+                pass  # retried at the next grid tick (see class docstring)
 
     def stop(self) -> None:
         self._stop.set()

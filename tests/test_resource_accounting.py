@@ -1,0 +1,548 @@
+"""Resource accounting over time: head TSDB, `ray_tpu top`/`memory`,
+object-ownership auditing, trend doctor rules, and the metrics-layer
+satellites (origin expiry, Metric.remove, deadline-ticked pusher,
+list truncation markers).
+"""
+
+import io
+import json
+import os
+import time
+import urllib.request
+import warnings
+from contextlib import redirect_stdout
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.tsdb import TimeSeriesStore
+
+
+# ---------------------------------------------------------------------------
+# TSDB (pure, no cluster)
+# ---------------------------------------------------------------------------
+
+T0 = 1_700_000_000.0  # fixed epoch: downsample buckets must be deterministic
+
+
+def test_tsdb_downsampling_sum_max_last():
+    """Each 1-min/10-min bucket keeps (last, max, min, sum, count) so any
+    aggregation stays answerable after the raw ring rolled over."""
+    ts = TimeSeriesStore(raw_points=10, m1_points=100, m10_points=100)
+    # 30 min of 5s samples: value = sample index
+    n = 360
+    for i in range(n):
+        ts.add_sample("m", float(i), tags={"k": "v"}, origin="w", ts=T0 + i * 5)
+    now = T0 + n * 5
+    # raw ring kept only the last 10 samples — minute-stage history must
+    # still answer for the whole window
+    for agg, check in [
+        ("last", lambda pts: pts[-1][1] == n - 1 or pts[-1][1] >= n - 12),
+        ("max", lambda pts: max(p[1] for p in pts) >= n - 12),
+        ("sum", lambda pts: sum(p[1] for p in pts)
+         == pytest.approx(sum(range(n)), rel=0.08)),
+        ("count", lambda pts: sum(p[1] for p in pts)
+         == pytest.approx(n, rel=0.08)),
+    ]:
+        q = ts.query("m", window_s=n * 5 + 60, step_s=60, agg=agg, now=now)
+        pts = q["series"][0]["points"]
+        assert len(pts) >= 28, (agg, len(pts))
+        assert check(pts), (agg, pts[-3:])
+    # closed 1-min buckets aggregate exactly 12 consecutive 5s samples:
+    # sum = 12a + 66 for some integer a, and adjacent buckets differ by
+    # 12*12 (the ramp advances 12 per minute)
+    q = ts.query("m", window_s=n * 5, step_s=60, agg="sum", now=now)
+    interior = [p[1] for p in q["series"][0]["points"][2:-2]]
+    assert interior
+    assert all((v - 66) % 12 == 0 for v in interior), interior[:5]
+    assert all(b - a == 144 for a, b in zip(interior, interior[1:]))
+
+
+def test_tsdb_histograms_become_count_and_sum_series():
+    ts = TimeSeriesStore()
+    snap = {"lat_s": {"type": "histogram", "help": "h", "values": {
+        (("k", "v"),): {"buckets": [1, 2], "bounds": (0.1,),
+                        "sum": 3.5, "count": 3},
+    }}}
+    ts.ingest("w1", snap, ts=T0)
+    names = {m["name"] for m in ts.list_metrics()}
+    assert names == {"lat_s_count", "lat_s_sum"}
+    q = ts.query("lat_s_sum", window_s=60, step_s=5, now=T0 + 1)
+    assert q["series"][0]["points"][-1][1] == 3.5
+    assert q["series"][0]["tags"]["origin"] == "w1"
+
+
+def test_tsdb_retention_cap_under_10k_series_load():
+    """The byte cap holds under synthetic 10k-series load; eviction is
+    least-recently-updated first."""
+    cap = 300_000
+    ts = TimeSeriesStore(max_bytes=cap, raw_points=64, m1_points=16,
+                         m10_points=8)
+    for i in range(10_000):
+        ts.add_sample("m", float(i), tags={"s": str(i)}, origin="o",
+                      ts=T0 + i * 0.1)
+    stats = ts.stats()
+    assert stats["est_bytes"] <= cap
+    assert stats["evicted_series"] > 0
+    assert stats["num_series"] < 10_000
+    # survivors are the newest series (LRU eviction)
+    q = ts.query("m", window_s=10_000, tags={"s": "9999"}, now=T0 + 1000)
+    assert q["series"], "most recent series must survive the cap"
+    q = ts.query("m", window_s=10_000, tags={"s": "0"}, now=T0 + 1000)
+    assert not q["series"], "oldest series must be evicted first"
+
+
+def test_tsdb_24h_of_5s_history_stays_under_cap():
+    """Acceptance: 24 h of synthetic 5 s samples (several processes wide)
+    stays under the default-shaped cap via staged downsampling."""
+    cap = 8 << 20
+    ts = TimeSeriesStore(max_bytes=cap)  # default ring shape
+    n = (24 * 3600) // 5  # 17280 samples per series
+    for origin in ("w1", "w2", "w3", "w4"):
+        for i in range(n):
+            ts.add_sample("rss", 100.0 + i * 0.01, tags={"w": origin},
+                          origin=origin, ts=T0 + i * 5)
+    assert ts.memory_bytes() <= cap
+    assert ts.stats()["evicted_series"] == 0, "history decayed, not dropped"
+    now = T0 + n * 5
+    # the full day is queryable at 10-min resolution...
+    q = ts.query("rss", window_s=24 * 3600, step_s=600, tags={"w": "w1"},
+                 now=now)
+    pts = q["series"][0]["points"]
+    assert len(pts) >= 130  # 28h ring ≥ 144 buckets; ≥130 in-window
+    assert pts[0][1] < pts[-1][1]  # the day-long ramp survived downsampling
+    # ...and the last hour at raw resolution
+    q = ts.query("rss", window_s=3600, step_s=5, tags={"w": "w1"}, now=now)
+    assert len(q["series"][0]["points"]) >= 700
+    # a day-wide window at a raw-resolution step must ESCALATE to the
+    # rings that cover it, not silently return the raw ring's last hour
+    # labeled as the full window
+    q = ts.query("rss", window_s=24 * 3600, step_s=5, tags={"w": "w1"},
+                 now=now)
+    pts = q["series"][0]["points"]
+    assert pts[0][0] <= now - 20 * 3600, "window not covered"
+
+
+def test_tsdb_origin_expiry():
+    """A dead origin's series (and its freshness bookkeeping) leave the
+    store once it stops pushing."""
+    ts = TimeSeriesStore()
+    ts.add_sample("m", 1.0, origin="dead", ts=T0)
+    ts.add_sample("m", 2.0, origin="live", ts=T0 + 100)
+    assert ts.expire_stale(30.0, now=T0 + 110) == 1
+    q = ts.query("m", window_s=1000, now=T0 + 110)
+    origins = {s["tags"]["origin"] for s in q["series"]}
+    assert origins == {"live"}
+    assert set(ts.origins()) == {"live"}
+
+
+def test_tsdb_query_edge_cases():
+    ts = TimeSeriesStore()
+    for i in range(10):
+        ts.add_sample("m", float(i), origin="o", ts=T0 + i * 5)
+    now = T0 + 50
+    # empty / negative window -> no points, no error
+    assert ts.query("m", window_s=0, now=now)["series"][0]["points"] == []
+    assert ts.query("m", window_s=-5, now=now)["series"][0]["points"] == []
+    # step > window -> exactly one bin
+    pts = ts.query("m", window_s=30, step_s=600, now=now)["series"][0]["points"]
+    assert len(pts) == 1 and pts[0][1] == 9.0
+    # step <= 0 -> defaults to the sample interval
+    q = ts.query("m", window_s=60, step_s=0, now=now)
+    assert len(q["series"][0]["points"]) == 10
+    # unknown metric -> empty result, not an error
+    assert ts.query("nope", window_s=60, now=now)["series"] == []
+    # unknown agg -> loud
+    with pytest.raises(ValueError):
+        ts.query("m", agg="p99")
+
+
+# ---------------------------------------------------------------------------
+# metrics satellites (pure)
+# ---------------------------------------------------------------------------
+
+def test_registry_merge_expires_dead_origins():
+    from ray_tpu.util.metrics import _Registry
+
+    reg = _Registry()
+    snap = {"m": {"type": "gauge", "help": "", "values": {(): 1.0}}}
+    reg.merge("w-dead", snap)
+    time.sleep(0.15)
+    reg.merge("w-live", snap)
+    expired = reg.expire_origins(0.1)
+    assert expired == ["w-dead"]
+    keys = set(reg.snapshot()["m"]["values"])
+    assert (("origin", "w-live"),) in keys
+    assert (("origin", "w-dead"),) not in keys
+    # idempotent; a refreshed origin survives the next sweep
+    reg.merge("w-live", snap)
+    assert reg.expire_origins(10.0) == []
+
+
+def test_registry_merge_replaces_origins_previous_series():
+    """Label series absent from an origin's next push (a dead worker pid
+    in an agent's per-process gauges) must leave the merged view — under
+    a live origin, origin expiry alone never fires."""
+    from ray_tpu.util.metrics import _Registry
+
+    reg = _Registry()
+    reg.merge("agent", {"rss": {"type": "gauge", "help": "", "values": {
+        (("pid", "1"),): 10.0, (("pid", "2"),): 20.0}}})
+    reg.merge("agent", {"rss": {"type": "gauge", "help": "", "values": {
+        (("pid", "2"),): 21.0}}})  # pid 1 died
+    keys = set(reg.snapshot()["rss"]["values"])
+    assert (("pid", "2"), ("origin", "agent")) in keys
+    assert (("pid", "1"), ("origin", "agent")) not in keys
+    # other origins' series are untouched by this origin's replacement
+    reg.merge("other", {"rss": {"type": "gauge", "help": "", "values": {
+        (("pid", "9"),): 5.0}}})
+    reg.merge("agent", {"rss": {"type": "gauge", "help": "", "values": {
+        (("pid", "2"),): 22.0}}})
+    keys = set(reg.snapshot()["rss"]["values"])
+    assert (("pid", "9"), ("origin", "other")) in keys
+
+
+def test_tsdb_expire_stale_drops_idle_series_under_live_origin():
+    """A series whose labels vanished from a live origin's pushes (dead
+    pid on an agent node) goes stale and expires series-level."""
+    ts = TimeSeriesStore()
+    ts.add_sample("rss", 1.0, tags={"pid": "1"}, origin="agent", ts=T0)
+    for i in range(5):
+        ts.add_sample("rss", 2.0, tags={"pid": "2"}, origin="agent",
+                      ts=T0 + 100 + i)
+    assert ts.expire_stale(60.0, now=T0 + 105) == 1
+    q = ts.query("rss", window_s=1000, now=T0 + 105)
+    assert {s["tags"]["pid"] for s in q["series"]} == {"2"}
+    assert "agent" in ts.origins()  # the origin itself is still live
+
+
+def test_metric_remove_retires_label_series():
+    from ray_tpu.util.metrics import Gauge, registry
+
+    g = Gauge("ra_test_remove", "t")
+    g.set(1.0, tags={"worker": "a"})
+    g.set(2.0, tags={"worker": "b"})
+    assert sorted(d["worker"] for d in g.label_sets()) == ["a", "b"]
+    assert g.remove({"worker": "a"}) is True
+    assert g.remove({"worker": "a"}) is False  # already gone
+    vals = registry().snapshot()["ra_test_remove"]["values"]
+    assert list(vals) == [(("worker", "b"),)]
+
+
+def test_metrics_pusher_deadline_spacing_under_slow_send():
+    """A send that takes ~60% of the interval must not stretch the
+    spacing: deadline ticks keep the grid, sleep-after-work would drift
+    to interval+send every cycle."""
+    from ray_tpu.util.metrics import Counter, MetricsPusher
+
+    Counter("ra_test_spacing", "t").inc()
+    stamps = []
+
+    def slow_send(msg):
+        stamps.append(time.monotonic())
+        time.sleep(0.06)
+
+    interval = 0.1
+    pusher = MetricsPusher(slow_send, origin="t", interval_s=interval).start()
+    deadline = time.time() + 10
+    while len(stamps) < 8 and time.time() < deadline:
+        time.sleep(0.02)
+    pusher.stop()
+    assert len(stamps) >= 8
+    gaps = [b - a for a, b in zip(stamps, stamps[1:])]
+    gaps.sort()
+    median = gaps[len(gaps) // 2]
+    # sleep-after-work would put the median at ~0.16s; the grid holds ~0.1
+    assert median == pytest.approx(interval, abs=0.03), gaps
+
+
+def test_owner_aggregate_survives_zero_size_objects():
+    """The incremental by-owner aggregate must count objects explicitly,
+    not infer add/remove from a size sign a zero-byte payload breaks."""
+    from ray_tpu._private.object_store import ObjectLocation, ObjectRegistry
+
+    reg = ObjectRegistry()
+    for i in range(5):
+        oid = bytes([i]) * 16
+        reg.seal(oid, ObjectLocation(inline=b""), owner="driver",
+                 owner_kind="driver")
+    agg = reg.owner_summary()
+    assert agg[("driver", "driver")]["objects"] == 5
+    for i in range(5):
+        reg.remove_ref(bytes([i]) * 16)
+    assert reg.owner_summary() == {}
+
+
+# ---------------------------------------------------------------------------
+# trend doctor rules (pure)
+# ---------------------------------------------------------------------------
+
+def _series(name_vals, tags=None, step=30.0):
+    return {"tags": tags or {}, "points": [[T0 + i * step, v]
+                                           for i, v in enumerate(name_vals)]}
+
+
+def test_trend_rules_fire_on_induced_pathologies():
+    from ray_tpu.util import doctor
+
+    leak = _series([100 + 20 * i for i in range(20)],  # +20MB / 30s
+                   tags={"worker_id": "wleak"})
+    store = _series([(64 + 48 * i) * (1 << 20) for i in range(20)])
+    queue = _series([4 + 3 * i for i in range(20)])
+    findings = doctor.diagnose_trends({
+        "ray_tpu_proc_rss_mb": [leak],
+        "ray_tpu_object_store_bytes": [store],
+        "ray_tpu_sched_queue_depth": [queue],
+    })
+    rules = {f["rule"] for f in findings}
+    assert rules == {"rss_growth", "object_store_leak", "queue_depth_climb"}
+    rss = next(f for f in findings if f["rule"] == "rss_growth")
+    assert "wleak" in rss["summary"]
+    assert rss["evidence"][0]["slope_mb_per_min"] == pytest.approx(40.0)
+    # render() must format trend findings, not KeyError on their shape
+    assert "rss_growth" in doctor.render(findings)
+
+
+def test_trend_rules_stay_silent_on_healthy_series():
+    from ray_tpu.util import doctor
+
+    flat = _series([100.0 + (i % 3) for i in range(20)],
+                   tags={"worker_id": "w"})
+    sawtooth_queue = _series([0, 5, 2, 0, 7, 1, 0, 4, 0, 6] * 2)
+    shrinking_store = _series([(512 - 10 * i) * (1 << 20) for i in range(20)])
+    warmup = _series([100.0, 400.0, 405.0, 406.0, 406.0, 406.0, 406.0],
+                     tags={"worker_id": "w2"})  # one-time jump, no slope after
+    assert doctor.diagnose_trends({
+        "ray_tpu_proc_rss_mb": [flat, warmup],
+        "ray_tpu_object_store_bytes": [shrinking_store],
+        "ray_tpu_sched_queue_depth": [sawtooth_queue],
+    }) == []
+    # too few points -> no verdict either way
+    short = _series([100 + 50 * i for i in range(3)], tags={"worker_id": "w"})
+    assert doctor.diagnose_trends({"ray_tpu_proc_rss_mb": [short]}) == []
+
+
+# ---------------------------------------------------------------------------
+# live cluster: sampler -> TSDB -> query/top/memory surfaces
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def ra_cluster():
+    """One cluster for the live tests, with fast push/sample cadence so
+    series accumulate in test time (workers inherit the env)."""
+    env = {"RAY_TPU_METRICS_PUSH_S": "0.25", "RAY_TPU_EVENTS_FLUSH_S": "0.3"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def _wait_for(pred, timeout=30.0, interval=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+def test_query_metric_returns_live_series(ra_cluster):
+    from ray_tpu.experimental.state import api as state
+
+    @ray_tpu.remote
+    def tick(i):
+        return i
+
+    ray_tpu.get([tick.remote(i) for i in range(20)], timeout=60)
+
+    def has_series():
+        q = state.query_metric("ray_tpu_proc_rss_mb", window_s=120,
+                               step_s=0.25)
+        return q["series"] and any(len(s["points"]) >= 3
+                                   for s in q["series"])
+    _wait_for(has_series)
+    names = {m["name"] for m in state.list_metrics()}
+    assert "ray_tpu_proc_rss_mb" in names
+    assert "ray_tpu_sched_queue_depth" in names
+    # per-worker series carry worker_id tags and an origin
+    q = state.query_metric("ray_tpu_proc_rss_mb", window_s=120)
+    tags = [s["tags"] for s in q["series"]]
+    assert any(t.get("worker_id") not in (None, "head") for t in tags)
+    assert all("origin" in t for t in tags)
+    # values are plausible RSS (MBs, not bytes or zero)
+    vals = [p[1] for s in q["series"] for p in s["points"]]
+    assert vals and all(5.0 < v < 100_000 for v in vals)
+
+
+def test_memory_audit_attributes_bytes_to_owners(ra_cluster):
+    """Acceptance: >= 95% of sealed object-store bytes attribute to an
+    owner; driver puts, task returns, and actor returns all label."""
+    from ray_tpu.experimental.state import api as state
+
+    @ray_tpu.remote
+    def produce():
+        return np.zeros(600_000, dtype=np.uint8)
+
+    @ray_tpu.remote
+    class Holder:
+        def make(self):
+            return np.zeros(800_000, dtype=np.uint8)
+
+    driver_ref = ray_tpu.put(np.zeros(1_000_000, dtype=np.uint8))
+    task_refs = [produce.remote() for _ in range(3)]
+    ray_tpu.wait(task_refs, num_returns=len(task_refs), timeout=60)
+    holder = Holder.remote()
+    actor_ref = holder.make.remote()
+    ray_tpu.wait([actor_ref], num_returns=1, timeout=60)
+
+    audit = state.memory_summary(limit=50)
+    assert audit["total_bytes"] >= 1_000_000 + 3 * 600_000 + 800_000
+    assert audit["attributed_frac"] >= 0.95
+    kinds = {o["owner_kind"] for o in audit["by_owner"]}
+    assert {"driver", "worker", "actor"} <= kinds
+    actor_row = next(o for o in audit["by_owner"]
+                     if o["owner_kind"] == "actor")
+    assert actor_row["owner_label"].startswith("Holder:")
+    assert actor_row["bytes"] >= 800_000
+    # per-object rows carry pin reason + age
+    assert all(r["pin_reason"] in ("handle", "task_arg", "contained",
+                                   "lineage") for r in audit["rows"])
+    assert all(r["age_s"] >= 0 for r in audit["rows"])
+    del driver_ref, task_refs, actor_ref, holder
+
+
+def test_memory_audit_flags_orphans_after_actor_death(ra_cluster):
+    from ray_tpu.experimental.state import api as state
+
+    @ray_tpu.remote
+    class Leaker:
+        def make(self):
+            return np.zeros(700_000, dtype=np.uint8)
+
+    leaker = Leaker.remote()
+    ref = leaker.make.remote()
+    ray_tpu.wait([ref], num_returns=1, timeout=60)
+    ray_tpu.kill(leaker)
+
+    def orphaned():
+        audit = state.memory_summary(limit=50)
+        return [r for r in audit["rows"]
+                if r.get("orphan") and r["size"] >= 700_000]
+    rows = _wait_for(orphaned)
+    assert rows[0]["owner_kind"] == "actor"
+    audit = state.memory_summary(limit=0)
+    assert audit["orphan_bytes"] >= 700_000
+    del ref
+
+
+def test_top_and_memory_cli_render_live(ra_cluster):
+    """Acceptance: `ray_tpu top` and `ray_tpu memory` render against the
+    real running cluster."""
+    from ray_tpu.scripts import cli
+    from ray_tpu.experimental.state import api as state
+
+    @ray_tpu.remote
+    def spin():
+        t0 = time.time()
+        while time.time() - t0 < 0.3:
+            pass
+        return 1
+
+    refs = [spin.remote() for _ in range(4)]
+    _wait_for(lambda: any(
+        w.get("rss_mb") for w in state.top_snapshot()["workers"]))
+    ray_tpu.get(refs, timeout=60)
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        cli.main(["top", "--iterations", "1", "--sort", "rss"])
+    frame = buf.getvalue()
+    assert "ray_tpu top" in frame and "WORKER" in frame and "NODE" in frame
+    assert "MB" in frame  # a sampled RSS actually rendered
+
+    held = ray_tpu.put(np.zeros(500_000, dtype=np.uint8))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        cli.main(["memory", "--limit", "5"])
+    out = buf.getvalue()
+    assert "attributed to an owner" in out
+    assert "driver" in out and "OWNER" in out
+    # metrics directory CLI lists TSDB contents
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        cli.main(["metrics"])
+    assert "ray_tpu_proc_rss_mb" in buf.getvalue()
+    del held
+
+
+def test_list_objects_truncation_marker(ra_cluster):
+    """Satellite: list_* cannot masquerade a capped view as complete."""
+    from ray_tpu.experimental.state import api as state
+
+    refs = [ray_tpu.put(np.zeros(10, dtype=np.uint8)) for _ in range(5)]
+    page = state.list_state_page("objects", limit=2)
+    assert len(page["rows"]) == 2
+    assert page["total"] >= 5
+    assert page["truncated"] is True
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        rows = state.list_objects(limit=2)
+    assert len(rows) == 2
+    assert any("truncated" in str(x.message) for x in w)
+    # an unbounded listing is complete and quiet
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        state.list_objects(limit=100_000)
+    assert not [x for x in w if "truncated" in str(x.message)]
+    del refs
+
+
+def test_dashboard_metrics_memory_top_endpoints(ra_cluster):
+    from ray_tpu._private.worker import global_worker
+
+    dash = global_worker.node.dashboard
+    if dash is None:
+        pytest.skip("dashboard disabled in this environment")
+    host, port = dash.address
+
+    def get(path):
+        with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                    timeout=30) as r:
+            return json.loads(r.read().decode())
+
+    _wait_for(lambda: any(m["name"] == "ray_tpu_num_workers"
+                          for m in get("/api/metrics/list")))
+    q = get("/api/metrics/query?name=ray_tpu_num_workers&window=120&step=1")
+    assert q["series"] and q["series"][0]["points"]
+    mem = get("/api/memory")
+    assert "by_owner" in mem and mem["attributed_frac"] >= 0.95
+    top = get("/api/top")
+    assert top["workers"] and top["nodes"]
+    # grafana dashboard includes TSDB-retained metrics (per-proc gauges)
+    dash_json = get("/api/grafana_dashboard")
+    descs = [p["description"] for p in dash_json["panels"]]
+    assert any("ray_tpu_proc_rss_mb" in d for d in descs)
+
+
+def test_doctor_healthy_run_has_no_trend_findings(ra_cluster):
+    """The trend rules' false-positive gate: a working cluster that just
+    ran tasks shows no leak/climb findings."""
+    from ray_tpu.util.doctor import run_doctor
+
+    @ray_tpu.remote
+    def work(i):
+        return i * 2
+
+    ray_tpu.get([work.remote(i) for i in range(30)], timeout=60)
+    time.sleep(0.8)  # a few TSDB ticks over the settled state
+    findings = run_doctor()
+    trend_rules = {"rss_growth", "object_store_leak", "queue_depth_climb"}
+    assert not [f for f in findings if f["rule"] in trend_rules], findings
