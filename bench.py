@@ -952,6 +952,28 @@ def run_observability_overhead() -> dict:
     raise RuntimeError(f"observability probe failed: {proc.stderr[-2000:]}")
 
 
+def run_raylint_bench() -> dict:
+    """raylint_runtime row: full-repo static analysis wall time (all 8
+    rules + baseline compare).  The tier-1 gate runs this on every PR,
+    so it must stay cheap — the gate is < 10 s."""
+    import os
+    import time
+
+    from ray_tpu.devtools.raylint import LintConfig, run_gate
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    t0 = time.perf_counter()
+    result = run_gate(root)
+    wall = time.perf_counter() - t0
+    return {"raylint_runtime": {
+        "wall_s": round(wall, 3),
+        "files_analyzed": len(LintConfig(root=root).iter_paths()),
+        "findings_new": len(result.new),
+        "findings_baselined": len(result.baselined),
+        "gate_lt_10s": wall < 10.0,
+    }}
+
+
 def main() -> None:
     trainer_out = run_through_trainer()
     raw_out = run_raw()
@@ -996,6 +1018,10 @@ def main() -> None:
         decode_out.update(run_metric_query_bench())
     except Exception as e:
         decode_out["metric_query_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        decode_out.update(run_raylint_bench())
+    except Exception as e:
+        decode_out["raylint_error"] = f"{type(e).__name__}: {e}"[:200]
 
     tps = trainer_out["tokens_per_sec"]
     raw_tps = raw_out["tokens_per_sec"]

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import itertools
 import pickle
+import queue
 import threading
 import time
 from multiprocessing.connection import Client as MPClient
@@ -225,9 +226,7 @@ class CoreClient:
         full client API."""
         with self._pubsub_lock:
             if self._pubsub_queue is None:
-                import queue as _queue
-
-                self._pubsub_queue = _queue.Queue()
+                self._pubsub_queue = queue.Queue()
                 threading.Thread(target=self._pubsub_loop, daemon=True,
                                  name="pubsub-dispatch").start()
             first = channel not in self._subscriptions

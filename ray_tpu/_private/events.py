@@ -341,8 +341,6 @@ class TraceTable:
                 tid = span["trace_id"]
                 t = self._traces.get(tid)
                 if t is None:
-                    from collections import deque
-
                     t = self._traces[tid] = {
                         "spans": deque(maxlen=self._max_spans),
                         "dropped": 0,

@@ -1,0 +1,29 @@
+"""Named locks with an opt-in acquisition-order witness.
+
+``make_lock("node.registry")`` is a plain ``threading.Lock`` (or RLock)
+in production.  Under ``RAY_TPU_LOCKWITNESS=1`` it returns a
+:class:`~ray_tpu.devtools.raylint.lockwitness.WitnessLock` proxy that
+feeds the global lock-order graph, so a tier-1 test can drive a live
+cluster and assert the whole run was deadlock-order-clean.  The env
+check happens once at lock creation — the hot path never pays for the
+feature it isn't using.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+def make_lock(name: str, *, rlock: bool = False):
+    """A named Lock/RLock, witness-wrapped when RAY_TPU_LOCKWITNESS=1.
+
+    The env var is read per call so tests can enable the witness after
+    import; lock CREATION is rare (never on a hot path), only the
+    acquire/release fast path matters and that stays native when off.
+    """
+    lock = threading.RLock() if rlock else threading.Lock()
+    if os.environ.get("RAY_TPU_LOCKWITNESS"):
+        from ray_tpu.devtools.raylint.lockwitness import wrap_lock
+
+        return wrap_lock(name, lock)
+    return lock

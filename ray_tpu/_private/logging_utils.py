@@ -23,6 +23,3 @@ def get_logger(name: str) -> logging.Logger:
         root.setLevel(os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"))
     return logger
 
-
-def emit_worker_log(msg: dict) -> None:
-    get_logger("worker").info("%s", msg.get("text", ""))

@@ -29,22 +29,55 @@ _lib: Optional[ctypes.CDLL] = None
 _build_failed = False
 
 
+def _tsan_enabled() -> bool:
+    """RAY_TPU_STORE_TSAN=1 builds the store core under ThreadSanitizer
+    (+ clang thread-safety warnings when the compiler is clang): the
+    sanitizer wiring the reference carries in its C++ tree (SURVEY §7).
+    The instrumented .so caches under its own name so a sanitizer run
+    never poisons the production build cache (or vice versa)."""
+    return os.environ.get("RAY_TPU_STORE_TSAN", "") == "1"
+
+
+def _compiler_is_clang(cxx: str) -> bool:
+    try:
+        probe = subprocess.run([cxx, "--version"], capture_output=True,
+                               timeout=10, text=True)
+        return "clang" in probe.stdout.lower()
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
 def _build() -> Optional[str]:
     """Compile the .so next to its source (cached across sessions)."""
-    out = os.path.join(_SRC_DIR, _LIB_NAME)
+    tsan = _tsan_enabled()
+    lib_name = _LIB_NAME.replace(".so", "_tsan.so") if tsan else _LIB_NAME
+    out = os.path.join(_SRC_DIR, lib_name)
     src = os.path.join(_SRC_DIR, "store_core.cc")
     if not os.path.exists(src):
         return None
     if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
         return out
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O2", "-fPIC", "-std=c++17"]
+    if tsan:
+        cmd = [cxx, "-g", "-O1", "-fPIC", "-std=c++17",
+               "-fsanitize=thread", "-fno-omit-frame-pointer"]
+        if _compiler_is_clang(cxx):
+            cmd.append("-Wthread-safety")  # g++ has no such warning
     try:
-        subprocess.run(
-            ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o", out, src],
-            check=True, capture_output=True, timeout=120,
-        )
+        subprocess.run(cmd + ["-shared", "-o", out, src],
+                       check=True, capture_output=True, timeout=120)
         return out
     except (OSError, subprocess.SubprocessError) as e:
-        logger.info("native store core unavailable (build failed: %s)", e)
+        if tsan:
+            # the operator explicitly asked for a sanitized store: a
+            # silent fall-through to the Python path would read as "no
+            # races found" while running uninstrumented code
+            logger.warning(
+                "RAY_TPU_STORE_TSAN=1 but the TSan build failed (%s) — "
+                "the store is NOT sanitizer-instrumented", e)
+        else:
+            logger.info("native store core unavailable (build failed: %s)", e)
         return None
 
 
@@ -61,7 +94,20 @@ def load() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(path)
         except OSError as e:
-            logger.info("native store core failed to load: %s", e)
+            if _tsan_enabled():
+                # a TSan .so usually can't dlopen into an uninstrumented
+                # interpreter ("cannot allocate memory in static TLS
+                # block"): the process must be started with libtsan
+                # preloaded or the coverage silently doesn't exist
+                logger.warning(
+                    "RAY_TPU_STORE_TSAN=1 but the instrumented store "
+                    "failed to load (%s) — run python under "
+                    "LD_PRELOAD=libtsan.so.0 (path via `%s -print-file-"
+                    "name=libtsan.so.0`); falling back to the "
+                    "UNINSTRUMENTED Python store",
+                    e, os.environ.get("CXX", "g++"))
+            else:
+                logger.info("native store core failed to load: %s", e)
             _build_failed = True
             return None
         lib.rtpu_store_create.restype = ctypes.c_void_p

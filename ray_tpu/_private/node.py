@@ -46,6 +46,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private import events as events_mod
 from ray_tpu._private import logging_utils, wire
 from ray_tpu._private.config import get_config
+from ray_tpu._private.locks import make_lock
 from ray_tpu._private.gcs import (
     ActorInfo,
     GcsTables,
@@ -216,7 +217,7 @@ class _ForkServerClient:
     def __init__(self, session_dir: str):
         self._sock_path = os.path.join(session_dir, "forkserver.sock")
         self._proc: Optional[subprocess.Popen] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("node.forkserver")
         self._broken = False
 
     @property
@@ -270,11 +271,13 @@ class _ForkServerClient:
             try:
                 s = socket.socket(socket.AF_UNIX)
                 s.settimeout(10)
-                s.connect(self._sock_path)
-                s.sendall((json.dumps({"env": env, "cwd": cwd}) + "\n").encode())
+                # the lock IS the forkserver protocol serializer: one
+                # request/response round trip per holder, by design
+                s.connect(self._sock_path)  # raylint: disable=R4
+                s.sendall((json.dumps({"env": env, "cwd": cwd}) + "\n").encode())  # raylint: disable=R4
                 data = b""
                 while not data.endswith(b"\n"):
-                    chunk = s.recv(1 << 16)
+                    chunk = s.recv(1 << 16)  # raylint: disable=R4
                     if not chunk:
                         break
                     data += chunk
@@ -471,15 +474,16 @@ class Node:
 
         self.cfg = get_config()
         self.session_dir = session_dir or (
+            # raylint: disable=R3 (once per session)
             f"/tmp/ray_tpu/session_{os.getpid()}_{os.urandom(4).hex()}"
         )
         os.makedirs(self.session_dir, exist_ok=True)
         self.address = os.path.join(self.session_dir, "raylet.sock")
-        self.authkey = os.urandom(16)
+        self.authkey = os.urandom(16)  # raylint: disable=R3 (one-shot, off the per-task path)
 
         # Session-scoped shm namespace: sweep segments a SIGKILL'd previous
         # head orphaned, then mark this session alive for the next sweeper.
-        self.session_id = os.urandom(4).hex()
+        self.session_id = os.urandom(4).hex()  # raylint: disable=R3 (one-shot, off the per-task path)
         os.environ[shm_mod._SESSION_ENV] = self.session_id  # workers inherit
         swept = shm_mod.sweep_orphaned_segments()
         if swept:
@@ -490,7 +494,7 @@ class Node:
 
         _usage.reset()  # per-session scope for the usage report
 
-        self.lock = threading.RLock()
+        self.lock = make_lock("node.registry", rlock=True)
         self.cond = threading.Condition(self.lock)
         from ray_tpu._private.config import resolve_object_store_memory
 
@@ -622,7 +626,8 @@ class Node:
             None if os.environ.get("RAY_TPU_DISABLE_FORKSERVER")
             else _ForkServerClient(self.session_dir))
         self._zombie_seen: Dict[int, float] = {}
-        self._threads = []
+        # bounded: one entry per service thread, joined at shutdown
+        self._threads = []  # raylint: disable=R5
         t = threading.Thread(target=self._reaper_loop, name="reaper", daemon=True)
         t.start()
         self._threads.append(t)
@@ -699,7 +704,7 @@ class Node:
         # _proc_lock guards it — folded by connection-handler threads,
         # rebuilt by the sampler tick, read by top_snapshot.
         self._proc_live: Dict[str, dict] = {}
-        self._proc_lock = threading.Lock()
+        self._proc_lock = make_lock("node.proc_live")
         self._tsdb_stop = threading.Event()
         t = threading.Thread(target=self._tsdb_loop, name="tsdb-sampler",
                              daemon=True)
@@ -714,7 +719,7 @@ class Node:
         # the head process's own ring is folded lazily at query time
         self.traces = events_mod.TraceTable()
         self._traces_local_seq = 0
-        self._traces_fold_lock = threading.Lock()
+        self._traces_fold_lock = make_lock("node.traces_fold")
         self._dispatch_n = 0  # dispatch-event sampling counter
         self.dashboard = None
         dash_port = int(os.environ.get("RAY_TPU_DASHBOARD_PORT", "0"))
@@ -926,7 +931,7 @@ class Node:
         agent_node_id: Optional[str] = None
         is_client = False
         with self.lock:
-            self._conn_locks[id(conn)] = threading.Lock()
+            self._conn_locks[id(conn)] = make_lock("node.conn")
             self._live_conns.add(conn)
         try:
             while not self._shutdown:
@@ -1019,7 +1024,7 @@ class Node:
 
     def _conn_lock(self, conn: Connection) -> threading.Lock:
         with self.lock:
-            return self._conn_locks.setdefault(id(conn), threading.Lock())
+            return self._conn_locks.setdefault(id(conn), make_lock("node.conn"))
 
     # execute-message spec subset: everything the worker's executor reads
     # (ray_tpu/_private/worker.py _execute_task/_seal_and_report); head-only
@@ -1126,6 +1131,9 @@ class Node:
                 loc = self.registry.wait_sealed_existing(msg["oid"], 5.0)
                 try:
                     if loc in (None, "missing"):
+                        # the broad arm below turning this into an
+                        # error reply IS the handling
+                        # raylint: disable=R2
                         raise FileNotFoundError(msg["oid"].hex())
                     reply = {"blob": payload_bytes(loc), "is_error": loc.is_error}
                 except (OSError, ValueError) as e:
@@ -1136,9 +1144,7 @@ class Node:
 
     def _handle_message(self, conn: Connection, worker: Optional[WorkerHandle], msg: dict) -> None:
         mtype = msg["type"]
-        if mtype == "submit_task":
-            self.submit_task(msg["spec"])
-        elif mtype == "submit_batch":
+        if mtype == "submit_batch":
             # coalesced submissions from one client, in submission order
             for kind, spec in msg["batch"]:
                 if kind == "task":
@@ -1160,8 +1166,6 @@ class Node:
             self._on_task_done(worker, msg)
         elif mtype == "create_actor":
             self.create_actor(msg["spec"])
-        elif mtype == "submit_actor_task":
-            self.submit_actor_task(msg["spec"])
         elif mtype == "kill_actor":
             self.kill_actor(msg["actor_id"], no_restart=msg.get("no_restart", True))
         elif mtype == "cancel_task":
@@ -1318,8 +1322,6 @@ class Node:
                 value = {"__state_error__": str(e)}
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
                                "value": value})
-        elif mtype == "log":
-            logging_utils.emit_worker_log(msg)
         else:
             logger.warning("unknown message type %s", mtype)
         # write out any execute messages this message's handling queued
@@ -1416,7 +1418,7 @@ class Node:
         (env_vars + working_dir) and only ever serves tasks declaring the
         identical env.  On a remote node the spawn is delegated to its
         agent (the worker still connects straight back to the head)."""
-        worker_id = os.urandom(8)
+        worker_id = os.urandom(8)  # raylint: disable=R3 (per spawn, not per task)
         key = _runtime_env_key(runtime_env)
         try:
             proc = self._spawn_on_node(ns, worker_id, runtime_env)
@@ -1740,7 +1742,7 @@ class Node:
             acks = []
             for i, ns in enumerate(wave):
                 addr, arena = sources[i % len(sources)]
-                token = os.urandom(8).hex()
+                token = os.urandom(8).hex()  # raylint: disable=R3 (per pull)
                 holder = {"event": threading.Event(), "ok": False, "error": None}
                 self._pull_acks[token] = holder
                 try:
@@ -2871,7 +2873,7 @@ class Node:
                     n_tpu = int(req.get(TPU, 0))
                     art.tpu_ids = [ns.tpu_free.pop() for _ in range(min(n_tpu, len(ns.tpu_free)))]
                     # dedicated worker for the actor
-                    worker_id = os.urandom(8)
+                    worker_id = os.urandom(8)  # raylint: disable=R3 (per actor)
                     extra_env: Dict[str, str] = {}
                     if art.tpu_ids:
                         extra_env["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in art.tpu_ids)

@@ -153,7 +153,7 @@ _counter = itertools.count(1)
 
 def _reseed_after_fork() -> None:
     global _prefix, _counter
-    _prefix = os.urandom(8)
+    _prefix = os.urandom(8)  # raylint: disable=R3 (one-shot, off the per-task path)
     _counter = itertools.count(1)
 
 
@@ -163,5 +163,5 @@ if hasattr(os, "register_at_fork"):
 
 def new_id(n: int = 16) -> bytes:
     if n != 16:
-        return os.urandom(n)
+        return os.urandom(n)  # raylint: disable=R3 (rare non-16-byte ids)
     return _prefix + struct.pack(">Q", next(_counter))

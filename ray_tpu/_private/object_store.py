@@ -34,6 +34,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 from ray_tpu._private import events as _events
 from ray_tpu._private import serialization
 from ray_tpu._private.config import get_config
+from ray_tpu._private.locks import make_lock
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.shm import ShmSegment, session_shm_name
 
@@ -158,7 +159,7 @@ class ObjectRegistry:
 
     def __init__(self, capacity_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("object_store.registry")
         self._objects: Dict[bytes, _Entry] = {}
         self._bytes_used = 0  # head-local shm bytes (spilled/inline/remote don't count)
         self._capacity = capacity_bytes
@@ -649,7 +650,7 @@ class ObjectRegistry:
 # ---------------------------------------------------------------------------
 
 _ATTACHED: Dict[str, ShmSegment] = {}
-_ATTACHED_LOCK = threading.Lock()
+_ATTACHED_LOCK = make_lock("object_store.attached")
 
 
 # Owner-side native arena (plasma analog); the head process sets this at
@@ -669,7 +670,7 @@ except ValueError:  # malformed override: keep the default, don't die at import
     _ARENA_FD_WRITE_MIN = 64 << 20
 # reader-side cache: arena path -> memoryview over its mmap
 _ARENA_MAPS: Dict[str, memoryview] = {}
-_ARENA_MAPS_LOCK = threading.Lock()
+_ARENA_MAPS_LOCK = make_lock("object_store.arena_maps")
 
 
 def set_owned_arena(arena) -> None:
@@ -782,7 +783,7 @@ def _store_value(ref: ObjectRef, value: Any, is_error: bool = False) -> Tuple[Ob
             # a prior attempt of this task left an allocation (it may be
             # SEALED and live — never touch it); index this attempt under
             # a fresh key and let first-seal-wins pick the survivor
-            key = os.urandom(16)
+            key = os.urandom(16)  # raylint: disable=R3 (retry-only path)
             off = _OWNED_ARENA.put(key, total)
         if off is not None:
             if total >= _ARENA_FD_WRITE_MIN:
@@ -821,7 +822,7 @@ def _write_segment(name: str, write_fn, expected: int) -> str:
     try:
         fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
     except FileExistsError:
-        name = f"{name}-r{os.urandom(3).hex()}"
+        name = f"{name}-r{os.urandom(3).hex()}"  # raylint: disable=R3 (collision retry)
         path = ShmSegment.path_for(name)
         fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
     try:

@@ -23,6 +23,7 @@ import time
 import traceback
 import weakref
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Dict, List, Optional, Tuple
 
 import cloudpickle
@@ -532,8 +533,6 @@ def _completion_executor():
     global _completion_pool
     with _completion_pool_lock:
         if _completion_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-
             _completion_pool = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="async-complete"
             )

@@ -77,6 +77,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import events as _events
 from ray_tpu._private import serialization
+from ray_tpu._private.locks import make_lock
+from ray_tpu.util import tracing as _tracing
 from ray_tpu.dag.channel import (
     FLAG_ERROR,
     ChannelClosedError,
@@ -207,7 +209,7 @@ class _Traced:
 # ---------------------------------------------------------------------------
 
 _LOCAL_GRAPHS: Dict[str, "_ActorGraph"] = {}
-_LOCAL_LOCK = threading.Lock()
+_LOCAL_LOCK = make_lock("compiled.local_channels")
 
 
 class _ActorGraph:
@@ -500,9 +502,9 @@ class CompiledDAG:
         self._slot_bytes = slot_bytes
         self._submit_timeout = submit_timeout
         self._get_timeout = get_timeout
-        self._gid = os.urandom(6).hex()
+        self._gid = os.urandom(6).hex()  # raylint: disable=R3 (per compile)
         self._torn_down = False
-        self._lock = threading.Lock()
+        self._lock = make_lock("compiled.graph")
         self._seq = 0            # next execution index to submit
         self._next_out = 0       # next seq expected from the output channel
         self._results: Dict[int, Tuple[bytes, int]] = {}
@@ -762,9 +764,7 @@ class CompiledDAG:
             # node loop's exec/channel-wait spans join this trace
             exec_ctx = None
             if _events.ENABLED:
-                from ray_tpu.util import tracing
-
-                exec_ctx = tracing.child_context(f"cdag.execute {self._gid[:6]}")
+                exec_ctx = _tracing.child_context(f"cdag.execute {self._gid[:6]}")
                 if exec_ctx is not None:
                     # t0 = when the request entered the graph: node loops
                     # clamp their recv-wait spans to it, so idle-before-
@@ -815,9 +815,7 @@ class CompiledDAG:
             self._seq = seq + 1
             if exec_ctx is not None:
                 self._trace_ctxs[seq] = exec_ctx
-                from ray_tpu.util import tracing
-
-                tracing.emit_span(f"cdag.execute {self._gid[:6]}",
+                _tracing.emit_span(f"cdag.execute {self._gid[:6]}",
                                   time.perf_counter() - t0, exec_ctx,
                                   phase="submit", seq=seq)
             waited = time.perf_counter() - t0

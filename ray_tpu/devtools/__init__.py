@@ -1,0 +1,2 @@
+"""Developer tooling that ships with the repo but never runs on a
+cluster hot path: the raylint static-analysis suite lives here."""

@@ -20,6 +20,7 @@ Reference: ``python/ray/scripts/scripts.py`` (cluster lifecycle) and
     metrics [NAME] [--window S --step S]       TSDB directory / time-series query
     profile [--duration N --worker-id HEX]     sampling profile via the dashboard
     serve-status                               serve deployments + autoscaling
+    lint [--rule R4 --json --update-baseline]  raylint static-analysis gate
 """
 
 from __future__ import annotations
@@ -235,13 +236,84 @@ def cmd_trace(args) -> None:
         print(render_trace(trace, analysis))
 
 
+def _repo_root() -> str:
+    """The checkout root (where raylint_baseline.json lives): the parent
+    of the ray_tpu package, falling back to the cwd when the package is
+    installed elsewhere but the cwd looks like a checkout (has the
+    package dir + a baseline)."""
+    import ray_tpu
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__)))
+    if not os.path.exists(os.path.join(root, "raylint_baseline.json")) \
+            and os.path.isdir(os.path.join(os.getcwd(), "ray_tpu")) \
+            and os.path.exists(os.path.join(os.getcwd(),
+                                            "raylint_baseline.json")):
+        return os.getcwd()
+    return root
+
+
+def _static_findings(rules=None, update_baseline=False, root=None):
+    """Run the raylint gate over the repo; returns the GateResult."""
+    from ray_tpu.devtools.raylint import run_gate
+
+    return run_gate(root or _repo_root(), rules=rules,
+                    update_baseline=update_baseline)
+
+
+def cmd_lint(args) -> None:
+    """raylint: the 8-rule static-analysis gate (no cluster needed).
+    Exit 1 on findings the checked-in baseline doesn't grandfather."""
+    from ray_tpu.devtools.raylint.runner import render_report, to_json
+
+    rules = None
+    if args.rule:
+        rules = sorted({r.strip().upper() for spec in args.rule
+                        for r in spec.split(",") if r.strip()})
+    try:
+        result = _static_findings(rules=rules,
+                                  update_baseline=args.update_baseline,
+                                  root=args.root)
+    except ValueError as e:  # bad --rule id / --update-baseline subset
+        raise SystemExit(f"ray_tpu lint: {e}")
+    if args.json:
+        print(json.dumps(to_json(result), indent=1))
+    else:
+        print(render_report(result, verbose=args.verbose))
+    if not result.ok:
+        sys.exit(1)
+
+
 def cmd_doctor(args) -> None:
     """Rule-based pathology analysis over the recorded event/task state;
-    exits non-zero when findings exist so CI can gate on it."""
+    exits non-zero when findings exist so CI can gate on it.  With
+    --static, raylint's non-baselined findings join the report (one
+    command for "is this cluster AND this tree healthy")."""
+    findings = []
+    if args.static:
+        lint = _static_findings(root=args.root)
+        findings.extend({
+            "severity": "WARNING",
+            "rule": f"raylint/{f.rule}",
+            "summary": f"{f.location()}: {f.message}",
+            "remedy": f.remedy,
+            "evidence": [{"file": f.path, "line": f.line}],
+            "count": 1,
+        } for f in lint.new)
+        # stale baseline keys fail `ray_tpu lint` (the baseline only
+        # burns down) — doctor --static must agree with the gate
+        findings.extend({
+            "severity": "WARNING",
+            "rule": "raylint/baseline",
+            "summary": f"stale baseline entry (finding fixed): {key}",
+            "remedy": "remove it via `ray_tpu lint --update-baseline`",
+            "evidence": [{"baseline_key": key}],
+            "count": 1,
+        } for key in lint.stale_keys)
     _connect()
     from ray_tpu.util.doctor import render, run_doctor
 
-    findings = run_doctor()
+    findings.extend(run_doctor())
     if args.json:
         print(json.dumps(findings, indent=2, default=repr))
     else:
@@ -571,7 +643,33 @@ def main(argv=None) -> None:
         help="pathology analysis over recorded events/tasks "
              "(exit 1 on findings)")
     s.add_argument("--json", action="store_true")
+    s.add_argument("--static", action="store_true",
+                   help="also run the raylint static gate and fold its "
+                        "new findings into the report/exit code")
+    s.add_argument("--root", default=None,
+                   help="checkout root for --static (default: the "
+                        "ray_tpu package's parent, or cwd if the "
+                        "baseline lives there)")
     s.set_defaults(fn=cmd_doctor)
+
+    s = sub.add_parser(
+        "lint",
+        help="raylint static-analysis suite over the repo "
+             "(8 invariant rules; exit 1 on non-baselined findings)")
+    s.add_argument("--rule", action="append", default=None,
+                   metavar="R1[,R2...]",
+                   help="run only these rule ids (repeatable)")
+    s.add_argument("--json", action="store_true")
+    s.add_argument("--verbose", action="store_true",
+                   help="also list baselined findings")
+    s.add_argument("--update-baseline", action="store_true",
+                   help="rewrite raylint_baseline.json from the current "
+                        "findings (full-rule runs only)")
+    s.add_argument("--root", default=None,
+                   help="checkout root to analyze (default: the ray_tpu "
+                        "package's parent, or cwd if the baseline lives "
+                        "there)")
+    s.set_defaults(fn=cmd_lint)
 
     s = sub.add_parser(
         "top", help="live cluster resource view (nodes, workers, pinned "

@@ -17,6 +17,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import events as _events
+from ray_tpu.util import tracing as _tracing
 from ray_tpu.serve.config import ROUTE_TABLE_TTL_S
 
 # Lazy router metric singletons (tags: deployment).
@@ -59,7 +60,7 @@ class Router:
         self._inflight: Dict[str, Dict[bytes, Any]] = {}
         self._ref_tags: Dict[bytes, str] = {}  # oid -> tag for done-reports
         self._rr = 0  # round-robin tiebreak among equally-loaded replicas
-        self._router_id = uuid.uuid4().hex[:12]
+        self._router_id = uuid.uuid4().hex[:12]  # raylint: disable=R3 (per router)
         # the session (client) this router belongs to: its poll/metrics
         # threads exit when the session is shut down or replaced
         from ray_tpu._private.worker import global_worker
@@ -227,9 +228,7 @@ class Router:
         # in the assembled tree (tracing_helper's context-injection analog)
         trace_ctx = None
         if _events.ENABLED:
-            from ray_tpu.util import tracing
-
-            trace_ctx = tracing.child_context(f"admission {self._name}")
+            trace_ctx = _tracing.child_context(f"admission {self._name}")
         self._ensure_listener()
         force = False
         with self._lock:
@@ -256,14 +255,12 @@ class Router:
                         self._set_queue_gauge()
                         assigned = True
                         if trace_ctx is not None:
-                            from ray_tpu.util import tracing
-
-                            token = tracing.adopt(trace_ctx)
+                            token = _tracing.adopt(trace_ctx)
                             try:
                                 ref = handle.handle_request.remote(
                                     method_name, args, kwargs)
                             finally:
-                                tracing.restore(token)
+                                _tracing.restore(token)
                         else:
                             ref = handle.handle_request.remote(
                                 method_name, args, kwargs)
@@ -280,9 +277,7 @@ class Router:
                                 severity="DEBUG", entity_id=tag,
                                 span_dur=waited)
                             if trace_ctx is not None:
-                                from ray_tpu.util import tracing
-
-                                tracing.emit_span(
+                                _tracing.emit_span(
                                     f"admission {self._name}", waited,
                                     trace_ctx, phase="router_admission",
                                     replica=tag, deployment=self._name)

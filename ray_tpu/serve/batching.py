@@ -129,6 +129,9 @@ class _Batcher:
         try:
             results = self._run_fn([s.item for s in batch])
             if len(results) != len(batch):
+                # caught by the BaseException arm on purpose: the error
+                # rides s.error to every waiting caller and re-raises there
+                # raylint: disable=R2
                 raise ValueError(
                     f"@serve.batch function returned {len(results)} "
                     f"results for a batch of {len(batch)}"

@@ -16,12 +16,14 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ray_tpu._private.locks import make_lock
+
 LabelKey = Tuple[Tuple[str, str], ...]
 
 
 class _Registry:
     def __init__(self):
-        self.lock = threading.Lock()
+        self.lock = make_lock("metrics.registry")
         # name -> {"type", "help", "values": {labelkey: value-or-histogram}}
         self.metrics: Dict[str, dict] = {}
         # origin -> last merge wall time: dead origins (a worker that

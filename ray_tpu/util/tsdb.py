@@ -37,6 +37,7 @@ from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Tuple
 
 from ray_tpu._private.events import _int_env
+from ray_tpu._private.locks import make_lock
 
 LabelKey = Tuple[Tuple[str, str], ...]
 
@@ -159,7 +160,7 @@ class TimeSeriesStore:
                  raw_points: int = DEFAULT_RAW_POINTS,
                  m1_points: int = DEFAULT_M1_POINTS,
                  m10_points: int = DEFAULT_M10_POINTS):
-        self._lock = threading.Lock()
+        self._lock = make_lock("tsdb.store")
         self._max_bytes = int(max_bytes)
         self._raw_points = int(raw_points)
         self._m1_points = int(m1_points)
@@ -285,11 +286,13 @@ class TimeSeriesStore:
                 row["num_series"] += 1
                 row["origins"].add(s.origin)
                 row["last_ts"] = max(row["last_ts"], s.last_ts)
-            out = []
-            for row in sorted(by_name.values(), key=lambda r: r["name"]):
-                row["origins"] = sorted(row["origins"])
-                out.append(row)
-            return out
+        # sort OUTSIDE the lock: by_name is ours alone once built, and
+        # the ingest path must never wait on a directory listing
+        out = []
+        for row in sorted(by_name.values(), key=lambda r: r["name"]):
+            row["origins"] = sorted(row["origins"])
+            out.append(row)
+        return out
 
     def query(self, name: str, window_s: float = 3600.0,
               step_s: float = 0.0, tags: Optional[Dict[str, str]] = None,

@@ -1,0 +1,9 @@
+"""R1 fixture: one frame type nobody handles."""
+
+
+class Client:
+    def ping(self, conn):
+        conn.send({"type": "ping_head"})
+
+    def orphan(self, conn):
+        conn.send({"type": "orphan_send"})  # EXPECT:R1 (no handler)

@@ -1,0 +1,10 @@
+"""R1 fixture: one dead dispatch arm."""
+
+
+class Node:
+    def handle(self, msg):
+        mtype = msg["type"]
+        if mtype == "ping_head":
+            return "pong"
+        elif mtype == "dead_arm":  # EXPECT:R1 (no sender)
+            return "never"

@@ -1,0 +1,7 @@
+class Client:
+    def ping(self, conn):
+        conn.send({"type": "ping_head"})
+
+    def batched(self, conn):
+        msg = {"type": "batched_put"}
+        conn.send(msg)

@@ -1,0 +1,7 @@
+class Node:
+    def handle(self, msg):
+        mtype = msg["type"]
+        if mtype == "ping_head":
+            return "pong"
+        elif mtype == "batched_put":
+            return "ok"

@@ -1,0 +1,30 @@
+import socket
+
+
+def narrow_first():
+    try:
+        socket.create_connection(("h", 1))
+    except TimeoutError:
+        return "timeout"
+    except OSError:
+        return None
+
+
+def raise_escapes(sock):
+    try:
+        data = sock.recv(1)
+    except OSError:
+        sock.close()
+        return None
+    if not data:
+        raise TimeoutError("peer idle")  # outside the try: propagates
+    return data
+
+
+def rereraised(sock):
+    try:
+        if not sock.recv(1):
+            raise TimeoutError("peer idle")
+    except OSError:
+        sock.close()
+        raise  # re-raise keeps the narrow exception alive

@@ -1,0 +1,19 @@
+import socket
+
+
+def dead_arm():
+    try:
+        socket.create_connection(("h", 1))
+    except OSError:
+        return None
+    except TimeoutError:  # EXPECT:R2 (OSError above already catches it)
+        return "timeout"
+
+
+def swallowed(sock):
+    try:
+        data = sock.recv(1)
+        if not data:
+            raise TimeoutError("peer idle")  # EXPECT:R2 (eaten below)
+    except OSError:
+        sock.close()

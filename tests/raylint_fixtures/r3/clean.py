@@ -1,0 +1,9 @@
+import itertools
+import os
+
+_prefix = os.urandom(8)
+_counter = itertools.count(1)
+
+
+def submit(spec):
+    return _prefix + str(next(_counter)).encode(), spec

@@ -1,0 +1,25 @@
+import json
+import threading
+import time
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+
+    def update(self, k, v):
+        with self._lock:
+            self._rows[k] = v
+        time.sleep(0.1)  # after release: fine
+
+    def scan(self):
+        with self._lock:
+            snapshot = list(self._rows.values())
+        return json.dumps(sorted(snapshot))
+
+    def deferred(self):
+        with self._lock:
+            def later():
+                time.sleep(1.0)  # runs after release: fine
+            return later

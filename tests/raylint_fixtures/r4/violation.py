@@ -1,0 +1,20 @@
+import threading
+import time
+
+
+class Table:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = {}
+
+    def slow_update(self, k, v):
+        with self._lock:
+            time.sleep(0.1)  # EXPECT:R4
+            self._rows[k] = v
+
+    def scan(self):
+        with self._lock:
+            import json  # EXPECT:R4
+
+            return json.dumps(  # EXPECT:R4
+                sorted(self._rows.values()))  # EXPECT:R4

@@ -1,0 +1,12 @@
+class HeadTable:
+    def __init__(self):
+        self.rows = {}
+        self.log = []
+
+    def on_push(self, origin, row):
+        self.rows[origin] = row
+        self.log.append(origin)
+
+    def expire(self, origin):
+        self.rows.pop(origin, None)
+        self.log.clear()

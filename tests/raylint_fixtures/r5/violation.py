@@ -1,0 +1,12 @@
+class HeadTable:
+    def __init__(self):
+        self.rows = {}  # EXPECT:R5 (grown below, never shrunk)
+        self.capped = {}
+
+    def on_push(self, origin, row):
+        self.rows[origin] = row
+
+    def on_other(self, origin):
+        self.capped[origin] = 1
+        if len(self.capped) > 100:
+            self.capped.pop(next(iter(self.capped)))

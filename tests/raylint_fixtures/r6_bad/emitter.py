@@ -1,0 +1,9 @@
+from r6_bad import events
+
+_SOURCE = "schedulerr"  # typo'd
+
+
+def notify():
+    events.emit("scheduler", "ok")
+    events.emit("not_declared", "boom")  # EXPECT:R6
+    events.emit(_SOURCE, "typo")  # EXPECT:R6 (resolved via constant)

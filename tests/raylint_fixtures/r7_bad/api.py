@@ -1,0 +1,10 @@
+def _list(what, limit=100):
+    return []
+
+
+def list_widgets(limit=100):  # EXPECT:R7 x2 (no handler, no surface)
+    return _list("widgets", limit)
+
+
+def list_gadgets(limit=100):
+    return _list("gadgets", limit)

@@ -1,0 +1,3 @@
+def cmd_list(args):
+    if args.what == "gadgets":
+        print("gadgets")

@@ -1,0 +1,5 @@
+class Head:
+    def handle_list(self, what):
+        if what == "gadgets":
+            return ["g"]
+        raise ValueError(what)

@@ -1,0 +1,6 @@
+def _list(what, limit=100):
+    return []
+
+
+def list_widgets(limit=100):
+    return _list("widgets", limit)

@@ -1,0 +1,21 @@
+import threading
+
+
+def daemonized(fn):
+    threading.Thread(target=fn, daemon=True).start()
+
+
+class Pump:
+    def start(self):
+        self._t = threading.Thread(target=self._loop)
+        self._t.start()
+
+    def stop(self):
+        self._t.join()
+
+    def _loop(self):
+        pass
+
+
+def suppressed(fn):
+    threading.Thread(target=fn).start()  # raylint: disable=R8 (short-lived)

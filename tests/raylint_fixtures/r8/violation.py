@@ -1,0 +1,14 @@
+import threading
+
+
+def fire_and_forget(fn):
+    threading.Thread(target=fn).start()  # EXPECT:R8
+
+
+class Pump:
+    def start(self):
+        self._t = threading.Thread(target=self._loop)  # EXPECT:R8
+        self._t.start()
+
+    def _loop(self):
+        pass

@@ -150,3 +150,41 @@ def test_cpp_unit_tests_under_asan():
     sys.stdout.write(out.stdout[-1000:])
     assert out.returncode == 0, out.stderr[-2000:]
     assert "ALL OK" in out.stdout
+
+
+@pytest.mark.slow
+def test_cpp_capacity_vs_close_under_tsan():
+    """The PR 1 race proof under ThreadSanitizer: one thread close()s the
+    arena while others spin on capacity/bytes_used/get/put
+    (test_close_vs_capacity in store_core_test.cc).  Also proves the
+    RAY_TPU_STORE_TSAN=1 build path produces the instrumented .so."""
+    import os
+    import shutil
+    import subprocess
+    import sys
+
+    if shutil.which("make") is None:
+        pytest.skip("make not available")
+    src_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "src", "store_core")
+    out = subprocess.run(["make", "test-tsan"], cwd=src_dir,
+                         capture_output=True, text=True, timeout=600)
+    sys.stdout.write(out.stdout[-1000:])
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "ALL OK" in out.stdout
+    assert "WARNING: ThreadSanitizer" not in out.stderr
+
+    # the env-gated runtime build: same flags, separate cache name
+    from ray_tpu._private import native
+
+    env_before = os.environ.get("RAY_TPU_STORE_TSAN")
+    os.environ["RAY_TPU_STORE_TSAN"] = "1"
+    try:
+        path = native._build()
+    finally:
+        if env_before is None:
+            os.environ.pop("RAY_TPU_STORE_TSAN", None)
+        else:
+            os.environ["RAY_TPU_STORE_TSAN"] = env_before
+    assert path is not None and path.endswith("_tsan.so")
+    assert os.path.exists(path)

@@ -1,0 +1,454 @@
+"""raylint: rule fixtures, the full-repo tier-1 gate, and the dynamic
+lock-order witness.
+
+Every rule is proven twice — it fires exactly on the seeded violation
+lines of its fixture (``# EXPECT:<rule>`` markers) and stays silent on
+the clean twin.  R1 additionally survives the acceptance mutation: a
+dispatch arm deliberately removed from a copy of the real ``node.py``
+must be caught.  The full-repo run IS the CI gate: any new finding
+beyond ``raylint_baseline.json`` fails this file, and therefore tier-1.
+"""
+
+import glob
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import textwrap
+import threading
+
+import pytest
+
+from ray_tpu.devtools.raylint import (
+    LintConfig, analyze, run_gate, split_new,
+)
+from ray_tpu.devtools.raylint.core import Project, SourceFile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "raylint_fixtures")
+
+
+@pytest.fixture(scope="module")
+def repo_project():
+    """The repo parsed ONCE for every repo-wide test in this file (the
+    parse is ~half the analysis cost; tier-1 rides a tight timeout)."""
+    cfg = LintConfig(root=REPO_ROOT)
+    return cfg, Project(cfg.root, cfg.iter_paths())
+
+
+def _expected_lines(relpath):
+    """{line: count} from ``# EXPECT:<rule>`` markers (``x2`` = two)."""
+    out = {}
+    with open(os.path.join(FIXTURES, relpath)) as f:
+        for i, line in enumerate(f, start=1):
+            m = re.search(r"# EXPECT:R\d(?: x(\d+))?", line)
+            if m:
+                out[i] = int(m.group(1) or 1)
+    return out
+
+
+def _fixture_config(**overrides):
+    defaults = dict(
+        root=FIXTURES,
+        head_handler_modules=(), clientbound_handler_modules=(),
+        clientbound_sender_modules=(), protocol_exclude=(),
+        hot_path_modules=(), head_container_modules=(),
+        events_module="", state_api_module="", state_surface_modules=(),
+    )
+    defaults.update(overrides)
+    return LintConfig(**defaults)
+
+
+def _assert_rule_matches(config, rule, violation_files, clean_files):
+    findings = analyze(config, rules=[rule])
+    by_file = {}
+    for f in findings:
+        by_file.setdefault(f.path, {}).setdefault(f.line, 0)
+        by_file[f.path][f.line] += 1
+    for rel in clean_files:
+        assert rel not in by_file, (
+            f"{rule} false positive(s) on clean fixture {rel}: "
+            f"{by_file.get(rel)}")
+    for rel in violation_files:
+        expected = _expected_lines(rel)
+        got = by_file.get(rel, {})
+        assert got == expected, (
+            f"{rule} on {rel}: expected findings at {expected}, "
+            f"got {got}\n" + "\n".join(f.render() for f in findings))
+
+
+def test_r1_protocol_fixture():
+    cfg = _fixture_config(
+        package="r1_bad", head_handler_modules=("r1_bad/node.py",))
+    _assert_rule_matches(cfg, "R1",
+                         ["r1_bad/client.py", "r1_bad/node.py"], [])
+    cfg = _fixture_config(
+        package="r1_good", head_handler_modules=("r1_good/node.py",))
+    assert analyze(cfg, rules=["R1"]) == []
+
+
+def test_r1_catches_removed_handler(repo_project):
+    """The acceptance mutation: delete one real dispatch arm from a
+    copy of node.py and R1 must flag every sender of that type."""
+    cfg, project = repo_project
+    assert analyze(cfg, rules=["R1"], project=project) == [], \
+        "R1 must be clean before the mutation"
+    rel = "ray_tpu/_private/node.py"
+    original = project.files[rel]
+    mutated = original.source.replace('elif mtype == "seal":',
+                                      'elif mtype == "seal_disabled":', 1)
+    assert mutated != original.source, \
+        "node.py no longer dispatches on seal?"
+    project.files[rel] = SourceFile(rel, mutated)
+    try:
+        findings = analyze(cfg, rules=["R1"], project=project)
+    finally:
+        project.files[rel] = original
+    unhandled = [f for f in findings if "seal" in f.detail
+                 and f.detail.startswith("unhandled-headbound")]
+    assert unhandled, (
+        "removing the seal arm must surface unhandled senders, got: "
+        + "\n".join(f.render() for f in findings))
+
+
+def test_r1_no_phantom_send_across_functions(tmp_path):
+    """A frame dict assigned in one function must never satisfy a
+    ``.send()`` in ANOTHER function: the phantom send would mark the
+    type as live and hide a dead handler — the exact regression class
+    R1 exists to catch."""
+    pkg = tmp_path / "mini"
+    pkg.mkdir()
+    (pkg / "client.py").write_text(
+        'class C:\n'
+        '    def build_only(self):\n'
+        '        msg = {"type": "ghost"}\n'
+        '        return msg  # never sent\n'
+        '\n'
+        '    def send_other(self, conn, msg):\n'
+        '        conn.send(msg)  # msg is a parameter, type unknown\n')
+    (pkg / "node.py").write_text(
+        'def dispatch(conn, msg):\n'
+        '    mtype = msg.get("type")\n'
+        '    if mtype == "ghost":\n'
+        '        pass\n')
+    cfg = _fixture_config(root=str(tmp_path), package="mini",
+                          head_handler_modules=("mini/node.py",))
+    findings = analyze(cfg, rules=["R1"])
+    dead = [f for f in findings if f.detail == "dead-head-handler:ghost"]
+    assert dead, (
+        "the ghost arm has no live sender and must be reported dead; "
+        "got: " + "\n".join(f.render() for f in findings))
+
+
+def test_r2_exception_shadow_fixture():
+    cfg = _fixture_config(package="r2")
+    _assert_rule_matches(cfg, "R2", ["r2/violation.py"], ["r2/clean.py"])
+
+
+def test_r3_hot_path_entropy_fixture():
+    cfg = _fixture_config(
+        package="r3",
+        hot_path_modules=("r3/violation.py", "r3/clean.py"))
+    _assert_rule_matches(cfg, "R3", ["r3/violation.py"], ["r3/clean.py"])
+
+
+def test_r4_lock_scope_weight_fixture():
+    cfg = _fixture_config(package="r4")
+    _assert_rule_matches(cfg, "R4", ["r4/violation.py"], ["r4/clean.py"])
+
+
+def test_r5_unbounded_container_fixture():
+    cfg = _fixture_config(
+        package="r5",
+        head_container_modules=("r5/violation.py", "r5/clean.py"))
+    _assert_rule_matches(cfg, "R5", ["r5/violation.py"], ["r5/clean.py"])
+
+
+def test_r6_event_source_fixture():
+    cfg = _fixture_config(
+        package="r6_bad", events_module="r6_bad/events.py")
+    _assert_rule_matches(cfg, "R6", ["r6_bad/emitter.py"], [])
+    cfg = _fixture_config(
+        package="r6_good", events_module="r6_good/events.py")
+    assert analyze(cfg, rules=["R6"]) == []
+
+
+def test_r7_state_parity_fixture():
+    cfg = _fixture_config(
+        package="r7_bad", state_api_module="r7_bad/api.py",
+        head_handler_modules=("r7_bad/node.py",),
+        state_surface_modules=("r7_bad/cli.py",))
+    _assert_rule_matches(cfg, "R7", ["r7_bad/api.py"], ["r7_bad/node.py"])
+    cfg = _fixture_config(
+        package="r7_good", state_api_module="r7_good/api.py",
+        head_handler_modules=("r7_good/node.py",),
+        state_surface_modules=("r7_good/cli.py",))
+    assert analyze(cfg, rules=["R7"]) == []
+
+
+def test_r8_bare_thread_fixture():
+    cfg = _fixture_config(package="r8")
+    _assert_rule_matches(cfg, "R8", ["r8/violation.py"], ["r8/clean.py"])
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline mechanics
+# ---------------------------------------------------------------------------
+
+def test_suppression_forms():
+    sf = SourceFile("x.py", "\n".join([
+        "import time",                                   # 1
+        "a = 1  # raylint: disable=R3",                  # 2
+        "b = 2  # raylint: disable=R3 (rationale here)",  # 3
+        "c = 3  # raylint: disable=R3,R4",               # 4
+        "# raylint: disable=R5",                         # 5 -> covers 6
+        "d = 4",                                         # 6
+        "e = 5  # raylint: disable",                     # 7 (all rules)
+        "f = 6",                                         # 8
+        "g = 7  # raylint: disable=R3 (see R4, R5 below)",  # 9
+        "h = 8  # raylint: disable=R3 one-shot, cold R4 path",  # 10
+    ]))
+    assert sf.suppressed(2, "R3") and not sf.suppressed(2, "R4")
+    assert sf.suppressed(3, "R3")
+    assert sf.suppressed(4, "R3") and sf.suppressed(4, "R4")
+    assert sf.suppressed(6, "R5") and not sf.suppressed(5, "R5")
+    assert sf.suppressed(7, "R1") and sf.suppressed(7, "R8")
+    assert not sf.suppressed(8, "R3")
+    # a comma inside the rationale must not suppress rules the prose
+    # merely mentions — only the ids before the rationale count
+    assert sf.suppressed(9, "R3")
+    assert not sf.suppressed(9, "R4") and not sf.suppressed(9, "R5")
+    assert sf.suppressed(10, "R3") and not sf.suppressed(10, "R4")
+
+
+def test_baseline_multiset_semantics():
+    from ray_tpu.devtools.raylint.core import Finding
+
+    def mk(detail):
+        return Finding(rule="R4", path="m.py", line=1, message="m",
+                       remedy="r", detail=detail, scope="f")
+
+    baseline = {}
+    for f in [mk("a"), mk("a"), mk("b")]:
+        baseline[f.baseline_key()] = baseline.get(f.baseline_key(), 0) + 1
+    # two 'a' + one 'b' baselined; a third 'a' occurrence is NEW
+    new, old = split_new([mk("a"), mk("a"), mk("a"), mk("b")], baseline)
+    assert len(old) == 3 and len(new) == 1
+
+
+def test_update_baseline_rejects_rule_subset(tmp_path):
+    # run against a throwaway root: if the guard ever regresses, the
+    # rewrite must hit this copy, never the checked-in baseline
+    src = os.path.join(REPO_ROOT, "raylint_baseline.json")
+    dst = tmp_path / "raylint_baseline.json"
+    shutil.copy(src, dst)
+    before = dst.read_text()
+    with pytest.raises(ValueError):
+        run_gate(str(tmp_path), rules=["R3"], update_baseline=True)
+    assert dst.read_text() == before
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate itself
+# ---------------------------------------------------------------------------
+
+def test_full_repo_gate_is_green(repo_project):
+    """THE gate: a new finding anywhere in ray_tpu/ beyond the baseline
+    fails tier-1.  Fix the finding, suppress it inline with a rationale,
+    or (for genuinely-intended cases) `ray_tpu lint --update-baseline`."""
+    from ray_tpu.devtools.raylint import run_gate
+
+    cfg, project = repo_project
+    result = run_gate(REPO_ROOT, config=cfg, project=project)
+    assert result.new == [], (
+        "new raylint findings:\n" + "\n".join(f.render() for f in result.new))
+    # the baseline only shrinks: stale entries mean someone fixed a
+    # grandfathered finding but left its key behind
+    assert result.stale_keys == [], (
+        "stale baseline entries (rerun --update-baseline): "
+        f"{result.stale_keys}")
+
+
+def test_lint_cli_json(capsys):
+    """`ray_tpu lint --json` through the real argparse entry (in-process:
+    a subprocess would pay ~5 s of interpreter+import on a box where
+    tier-1 rides the timeout)."""
+    from ray_tpu.scripts import cli
+
+    cli.main(["lint", "--json"])  # green tree: must NOT SystemExit
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["ok"] is True
+    assert payload["new"] == []
+    assert isinstance(payload["baselined"], list)
+
+
+def test_rule_subset_api(repo_project):
+    cfg, project = repo_project
+    r3 = analyze(cfg, rules=["R3"], project=project)
+    assert all(f.rule == "R3" for f in r3)
+    with pytest.raises(ValueError):
+        analyze(cfg, rules=["R99"], project=project)
+
+
+# ---------------------------------------------------------------------------
+# lock-order witness (the dynamic sanitizer)
+# ---------------------------------------------------------------------------
+
+def test_lockwitness_abba_cycle(monkeypatch):
+    from ray_tpu.devtools.raylint.lockwitness import WITNESS, wrap_lock
+
+    monkeypatch.delenv("RAY_TPU_LOCKWITNESS_DIR", raising=False)
+    WITNESS.reset()
+    A = wrap_lock("fixA", threading.Lock())
+    B = wrap_lock("fixB", threading.Lock())
+
+    def ab():
+        with A:
+            with B:
+                pass
+
+    def ba():
+        with B:
+            with A:
+                pass
+
+    for fn in (ab, ba):
+        t = threading.Thread(target=fn, daemon=True)
+        t.start()
+        t.join()
+    snap = WITNESS.snapshot()
+    assert "fixA->fixB" in snap["edges"] and "fixB->fixA" in snap["edges"]
+    assert len(snap["cycles"]) == 1
+    cyc = snap["cycles"][0]
+    assert cyc["locks"][0] == cyc["locks"][-1]  # closed cycle
+    assert cyc["closing_stack"]                 # stack captured
+    assert all(stk for stk in cyc["edges"].values())  # both directions
+    with pytest.raises(AssertionError):
+        WITNESS.assert_cycle_free()
+    WITNESS.reset()
+    WITNESS.assert_cycle_free()
+
+
+def test_lockwitness_rlock_reentry_no_false_cycle():
+    from ray_tpu.devtools.raylint.lockwitness import WITNESS, wrap_lock
+
+    WITNESS.reset()
+    A = wrap_lock("reA", threading.RLock())
+    B = wrap_lock("reB", threading.Lock())
+    with A:
+        with A:           # re-entry: no self edge
+            with B:
+                pass
+    with A:               # same order again: same edge, no cycle
+        with B:
+            pass
+    snap = WITNESS.snapshot()
+    assert snap["edges"] == ["reA->reB"]
+    WITNESS.assert_cycle_free()
+
+
+def test_lockwitness_condition_over_wrapped_rlock():
+    from ray_tpu.devtools.raylint.lockwitness import WITNESS, wrap_lock
+
+    WITNESS.reset()
+    L = wrap_lock("condL", threading.RLock())
+    cond = threading.Condition(L)
+    hits = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            hits.append(1)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.2)
+    with cond:
+        cond.notify_all()
+    t.join(timeout=5)
+    assert hits == [1]
+    WITNESS.assert_cycle_free()
+
+
+def test_lockwitness_live_cluster_cycle_free(tmp_path):
+    """The tier-1 acceptance: a real cluster (head + workers + actor +
+    puts + metrics) driven with every named lock witnessed stays
+    lock-order-cycle-free — in the head AND every worker process
+    (workers report cycles into RAY_TPU_LOCKWITNESS_DIR).
+
+    The drive runs in a SUBPROCESS with RAY_TPU_LOCKWITNESS=1 set before
+    the interpreter starts: module-level locks (the metrics registry,
+    object_store's attached/arena maps) are created at import time, so
+    flipping the env in-process — after conftest has already imported
+    ray_tpu — would leave exactly the head-side locks unwitnessed and
+    the 'cycle-free' verdict hollow for them."""
+    report_dir = str(tmp_path / "lockwitness")
+    drive = tmp_path / "drive.py"
+    drive.write_text(textwrap.dedent("""\
+        import json
+        import ray_tpu
+        from ray_tpu.devtools.raylint.lockwitness import WITNESS, WitnessLock
+
+        # import-time module-level locks must be wrapped — the reason
+        # this drive is a subprocess and not an in-process monkeypatch
+        from ray_tpu._private import object_store
+        from ray_tpu.util import metrics
+        assert isinstance(metrics._global.lock, WitnessLock), \\
+            "metrics registry lock unwitnessed"
+        assert isinstance(object_store._ATTACHED_LOCK, WitnessLock), \\
+            "object_store attached lock unwitnessed"
+        assert isinstance(object_store._ARENA_MAPS_LOCK, WitnessLock), \\
+            "object_store arena-maps lock unwitnessed"
+
+        ray_tpu.init(num_cpus=2, num_tpus=0)
+        try:
+            @ray_tpu.remote
+            def f(x):
+                return x + 1
+
+            @ray_tpu.remote
+            class Counter:
+                def __init__(self):
+                    self.n = 0
+
+                def inc(self):
+                    self.n += 1
+                    return self.n
+
+            assert ray_tpu.get([f.remote(i) for i in range(12)]) == \\
+                [i + 1 for i in range(12)]
+            c = Counter.remote()
+            assert ray_tpu.get([c.inc.remote() for _ in range(5)])[-1] == 5
+            ref = ray_tpu.put(b"x" * (1 << 18))
+            assert len(ray_tpu.get(ref)) == 1 << 18
+            metrics.Counter("raylint_witness_test_total", "coverage").inc()
+        finally:
+            ray_tpu.shutdown()
+        snap = WITNESS.snapshot()
+        WITNESS.assert_cycle_free()
+        print("WITNESS_SNAPSHOT " + json.dumps({"edges": snap["edges"]}))
+    """))
+    env = dict(os.environ,
+               RAY_TPU_LOCKWITNESS="1",
+               RAY_TPU_LOCKWITNESS_DIR=report_dir)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, str(drive)], cwd=REPO_ROOT, env=env,
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"witnessed drive failed (rc={proc.returncode}):\n"
+        f"{proc.stdout}\n{proc.stderr}")
+    marked = [ln for ln in proc.stdout.splitlines()
+              if ln.startswith("WITNESS_SNAPSHOT ")]
+    assert marked, f"no snapshot line in drive output:\n{proc.stdout}"
+    edges = json.loads(marked[-1].split(" ", 1)[1])["edges"]
+    assert edges, "witness saw no nested acquisitions — is it on?"
+    reports = glob.glob(os.path.join(report_dir, "*.json"))
+    assert reports == [], (
+        f"lock-order cycles reported: "
+        f"{[open(p).read() for p in reports]}")
