@@ -974,6 +974,206 @@ def run_raylint_bench() -> dict:
     }}
 
 
+_SYNCER_BENCH_CODE = """
+import json, statistics, time
+from ray_tpu._private import events
+events.ENABLED = False  # measure the mesh, not the recorder
+
+from ray_tpu._private.syncer import ResourceSyncer
+
+AUTHKEY = b"bench"
+N = 16
+TRIALS = 7
+
+def trial():
+    syncers = [
+        ResourceSyncer(f"n{i}", AUTHKEY, state_fn=lambda: {}, tick_s=0.05,
+                       seed=i).start()
+        for i in range(N)
+    ]
+    directory = {s.node_id: s.addr for s in syncers}
+    t0 = time.perf_counter()
+    for s in syncers:
+        s.set_peers(directory)
+    # converged when EVERY node's view holds all N snapshots
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if all(len(s.store.snapshot()[0]) == N for s in syncers):
+            break
+        time.sleep(0.005)
+    else:
+        raise RuntimeError("mesh never converged")
+    dt = time.perf_counter() - t0
+    for s in syncers:
+        s.stop()
+    time.sleep(0.1)
+    return dt
+
+times = sorted(trial() for _ in range(TRIALS))
+print("SYNCRESULT " + json.dumps({
+    "p50_s": times[len(times) // 2],
+    "p99_s": times[-1],
+    "nodes": N, "trials": TRIALS,
+}))
+"""
+
+
+def run_syncer_convergence_bench() -> dict:
+    """syncer_convergence row: how long a cold 16-node P2P mesh takes
+    until every node's store holds all 16 snapshots (fanout 2, tick
+    50ms).  This is the propagation envelope that bounds how fast a
+    peer-observed death can reach the head."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, "-c", _SYNCER_BENCH_CODE], capture_output=True,
+        text=True, timeout=300, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in proc.stdout.splitlines():
+        if line.startswith("SYNCRESULT "):
+            r = json.loads(line[len("SYNCRESULT "):])
+            return {"syncer_convergence": {
+                "p50_s": round(r["p50_s"], 3),
+                "p99_s": round(r["p99_s"], 3),
+                "nodes": r["nodes"], "trials": r["trials"],
+            }}
+    raise RuntimeError(f"syncer probe failed: {proc.stderr[-2000:]}")
+
+
+_MTTR_BENCH_CODE = """
+import json, os, threading, time
+import ray_tpu
+from ray_tpu._private.worker import global_worker
+from ray_tpu.air import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.autoscaler import AutoscalingConfig, TrendAutoscaler
+from ray_tpu.autoscaler.autoscaler import Monitor
+from ray_tpu.autoscaler.local_node_provider import LocalNodeProvider
+from ray_tpu.devtools.chaos import ChaosMonkey
+from ray_tpu.train.trainer import DataParallelTrainer
+
+HOSTS = 4
+STEPS = 200  # far past what the bench reaches; the driver stops the run
+PROGRESS = os.environ["MTTR_PROGRESS"]
+
+def loop(config=None):
+    import time as _t
+    from ray_tpu.air import session
+    from ray_tpu.air.checkpoint import Checkpoint
+    ckpt = session.get_checkpoint()
+    start = (ckpt.to_dict()["step"] + 1) if ckpt is not None else 0
+    for step in range(start, STEPS):
+        _t.sleep(0.1)
+        if session.get_world_rank() == 0:
+            with open(PROGRESS, "w") as f:
+                f.write(json.dumps({"step": step, "start": start}))
+        session.report({"step": step},
+                       checkpoint=Checkpoint.from_dict({"step": step})
+                       if session.get_world_rank() == 0 else None)
+
+ray_tpu.init(num_cpus=0, num_tpus=0)
+node = global_worker.node
+provider = LocalNodeProvider(node, {"slice_hosts": HOSTS}, "mttr")
+scaler = TrendAutoscaler(node, provider, AutoscalingConfig(
+    min_workers=1, max_workers=1, idle_timeout_s=3600.0,
+    worker_node={"num_cpus": 1, "slice_hosts": HOSTS}))
+sid = provider.create_node({"num_cpus": 1}, 1)[0]
+members = provider.slice_members(sid)
+deadline = time.time() + 120
+while time.time() < deadline:
+    if all(m in node.nodes and node.nodes[m].alive for m in members):
+        break
+    time.sleep(0.1)
+
+trainer = DataParallelTrainer(
+    loop,
+    scaling_config=ScalingConfig(num_workers=HOSTS,
+                                 resources_per_worker={"CPU": 1},
+                                 placement_strategy="STRICT_PACK"),
+    run_config=RunConfig(storage_path=os.path.dirname(PROGRESS),
+                         name="mttr",
+                         failure_config=FailureConfig(max_failures=2)),
+)
+th = threading.Thread(target=trainer.fit, daemon=True)
+th.start()
+
+def read_progress():
+    try:
+        with open(PROGRESS) as f:
+            return json.loads(f.read())
+    except Exception:
+        return None
+
+deadline = time.time() + 180
+while time.time() < deadline:
+    p = read_progress()
+    if p and p["step"] >= 2:
+        break
+    time.sleep(0.05)
+if not p or p["step"] < 2:
+    raise SystemExit("mttr: training never progressed to step 2")
+# reuse the loop's validated read: rank 0 rewrites the file non-atomically
+# every 0.1s, so a fresh read here can be torn (None)
+kill_step = p["step"]
+
+monitor = Monitor(scaler, interval_s=0.25).start()
+cm = ChaosMonkey(node=node, procs=provider.procs, seed=0)
+# kill rank 0's host: the one writer of PROGRESS dies with it, so the
+# next write is unambiguously the RESUMED gang taking a step
+with node.lock:
+    rank0_host = next(rt.info.bundle_nodes[0] for rt in node.pgs.values()
+                      if rt.info.state == "CREATED")
+os.unlink(PROGRESS)
+t_kill = time.perf_counter()
+cm.sigkill(rank0_host)
+deadline = time.time() + 300
+while time.time() < deadline:
+    p = read_progress()
+    # only the RESUMED incarnation writes start >= 1 — the dying rank 0
+    # can rewrite the unlinked file for a few ms after the SIGKILL lands
+    if p is not None and p.get("start", 0) >= 1:
+        break
+    time.sleep(0.02)
+else:
+    raise SystemExit("mttr: gang never resumed after the kill")
+mttr = time.perf_counter() - t_kill
+print("MTTRRESULT " + json.dumps({
+    "mttr_s": mttr, "slice_hosts": HOSTS, "kill_step": kill_step,
+    "resumed_from_step": p["start"], "resumed_step": p["step"],
+}))
+monitor.stop()
+os._exit(0)  # skip slow teardown; agents are killed by the parent row
+"""
+
+
+def run_slice_recovery_bench() -> dict:
+    """slice_recovery_mttr row: wall time from SIGKILLing a slice member
+    mid-train to the restarted gang (on the atomically replaced slice)
+    taking its first resumed step — detection + slice replacement + gang
+    restart + checkpoint restore, end to end."""
+    import tempfile
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    with tempfile.TemporaryDirectory() as td:
+        env["MTTR_PROGRESS"] = os.path.join(td, "progress.json")
+        proc = subprocess.run(
+            [sys.executable, "-c", _MTTR_BENCH_CODE], capture_output=True,
+            text=True, timeout=600, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+    for line in proc.stdout.splitlines():
+        if line.startswith("MTTRRESULT "):
+            r = json.loads(line[len("MTTRRESULT "):])
+            return {"slice_recovery_mttr": {
+                "mttr_s": round(r["mttr_s"], 2),
+                "slice_hosts": r["slice_hosts"],
+                "kill_step": r["kill_step"],
+                "resumed_from_step": r["resumed_from_step"],
+            }}
+    raise RuntimeError(f"mttr probe failed: {proc.stderr[-2000:]}")
+
+
 def main() -> None:
     trainer_out = run_through_trainer()
     raw_out = run_raw()
@@ -1022,6 +1222,14 @@ def main() -> None:
         decode_out.update(run_raylint_bench())
     except Exception as e:
         decode_out["raylint_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        decode_out.update(run_syncer_convergence_bench())
+    except Exception as e:
+        decode_out["syncer_convergence_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        decode_out.update(run_slice_recovery_bench())
+    except Exception as e:
+        decode_out["slice_recovery_error"] = f"{type(e).__name__}: {e}"[:200]
 
     tps = trainer_out["tokens_per_sec"]
     raw_tps = raw_out["tokens_per_sec"]

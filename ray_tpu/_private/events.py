@@ -38,6 +38,11 @@ KNOWN_SOURCES = (
     "scheduler", "node", "actor", "worker_pool", "object_store",
     "streaming", "serve", "serve_llm", "train", "collective",
     "compiled_dag", "trace",
+    # slice failure domain: P2P mesh observations (_private/syncer.py),
+    # fault injections (devtools/chaos), scale/replace decisions
+    # (autoscaler/policy.py) — doctor and the timeline correlate cause
+    # (chaos) with symptom (syncer/node) and remedy (autoscaler)
+    "syncer", "chaos", "autoscaler",
 )
 
 # Kill switch for the whole observability layer (events + hot-path metric

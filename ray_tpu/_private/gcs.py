@@ -43,6 +43,9 @@ class NodeInfo:
     resources: Dict[str, float]
     alive: bool = True
     start_time: float = field(default_factory=time.time)
+    # failure-domain id: hosts of one TPU slice share it and are
+    # provisioned/terminated/replaced as one unit (`ray_tpu slices`)
+    slice_id: Optional[str] = None
 
 
 @dataclass

@@ -367,6 +367,12 @@ class NodeState:
     agent_conn: Optional[Connection] = None
     agent_send_lock: Optional[threading.Lock] = None
     fetch_addr: Optional[tuple] = None
+    # failure domain: hosts of one TPU slice share a slice_id and are
+    # provisioned/terminated/replaced as one unit (SURVEY §7 hard-part 3)
+    slice_id: Optional[str] = None
+    # the node's P2P syncer listener (mesh directory entry); None for
+    # emulated/head-local nodes and agents with RAY_TPU_SYNCER=0
+    syncer_addr: Optional[tuple] = None
     # health checking (GcsHealthCheckManager analog)
     last_heartbeat: float = field(default_factory=time.time)
     last_ping: float = 0.0
@@ -375,10 +381,13 @@ class NodeState:
     host_stats: Optional[Dict[str, float]] = None
 
     def agent_send(self, msg: dict) -> None:
-        if self.agent_conn is None:
+        # read once: the death path nulls agent_conn concurrently, and an
+        # AttributeError mid-send would escape callers expecting OSError
+        conn = self.agent_conn
+        if conn is None:
             raise OSError("node has no agent connection")
         with self.agent_send_lock:
-            self.agent_conn.send(msg)
+            conn.send(msg)
 
     def utilization(self) -> float:
         fracs = []
@@ -553,6 +562,13 @@ class Node:
                             len(self.gcs.kv), len(self.gcs.actors))
 
         self.nodes: Dict[str, NodeState] = {}
+        # P2P mesh bookkeeping: highest snapshot version folded per node
+        # (version-gated merge at the head too), pruned on node removal
+        self._syncer_versions: Dict[str, int] = {}
+        # slices being terminated ON PURPOSE (slice-atomic replacement /
+        # idle scale-down): their member deaths are not "degraded".
+        # Self-cleaning: the last member's removal discards the mark.
+        self._draining_slices: set = set()
         self.actors: Dict[bytes, ActorRuntime] = {}
         self.pgs: Dict[bytes, PGRuntime] = {}
         self.pending_tasks: deque = deque()
@@ -776,6 +792,7 @@ class Node:
         total: Dict[str, float],
         tpu_ids: Optional[List[int]] = None,
         env: Optional[Dict[str, str]] = None,
+        slice_id: Optional[str] = None,
     ) -> None:
         with self.lock:
             ns = NodeState(
@@ -784,23 +801,45 @@ class Node:
                 available=dict(total),
                 tpu_free=list(tpu_ids or []),
                 env=dict(env or {}),
+                slice_id=slice_id,
             )
             self.nodes[node_id] = ns
-            self.gcs.nodes[node_id] = NodeInfo(node_id=node_id, resources=dict(total))
+            self.gcs.nodes[node_id] = NodeInfo(node_id=node_id, resources=dict(total),
+                                               slice_id=slice_id)
             self._wake_scheduler()
         events_mod.emit("node", "node joined", entity_id=node_id,
-                        resources=dict(total))
+                        resources=dict(total), slice_id=slice_id)
 
     def remove_node_state(self, node_id: str) -> None:
         """Simulate node death (Cluster.remove_node / chaos NodeKiller analog)."""
+        slice_state = None  # (slice_id, alive_siblings, gang_size) | None
         with self.lock:
             ns = self.nodes.get(node_id)
-            if ns is None:
+            if ns is None or not ns.alive:
+                # already removed — this path now has concurrent callers
+                # (missed-pong monitor, conn EOF, syncer death rumor /
+                # suspect quorum); re-running the body would double-emit
+                # 'node removed'/'slice degraded' and re-reconstruct
                 return
             ns.alive = False
             ns.agent_conn = None
+            self._syncer_versions.pop(node_id, None)
             if node_id in self.gcs.nodes:
                 self.gcs.nodes[node_id].alive = False
+            if ns.slice_id is not None:
+                siblings = [n for n in self.nodes.values()
+                            if n.slice_id == ns.slice_id
+                            and n.node_id != node_id]
+                alive_sib = sum(1 for n in siblings if n.alive)
+                if alive_sib == 0:
+                    # last member gone: the slice is fully drained/dead;
+                    # the draining mark has done its job
+                    self._draining_slices.discard(ns.slice_id)
+                elif ns.slice_id not in self._draining_slices:
+                    # an UNEXPECTED member death leaves the slice degraded
+                    # (a deliberate slice-atomic termination marks the
+                    # slice draining first and stays silent here)
+                    slice_state = (ns.slice_id, alive_sib, len(siblings) + 1)
             # tasks staged on the dead node (resources held, waiting for a
             # worker) go back to the cluster-wide pending queue — their
             # held resources died with the node
@@ -823,6 +862,15 @@ class Node:
         self.publish("node_change", {"node_id": node_id, "alive": False})
         events_mod.emit("node", "node removed", severity="WARNING",
                         entity_id=node_id, staged_tasks=len(staged))
+        if slice_state is not None:
+            # a slice is ONE failure domain: a dead member wedges any
+            # STRICT gang on it — doctor's slice_degraded rule watches
+            # for this event without a replacement in flight
+            sid, alive_sib, gang = slice_state
+            events_mod.emit(
+                "node", "slice degraded", severity="ERROR", entity_id=sid,
+                dead_node=node_id, alive_members=alive_sib, gang_size=gang)
+        self._broadcast_syncer_peers()
         self._reconstruct_lost_objects(node_id)
         with self.lock:
             self._wake_scheduler()
@@ -962,6 +1010,8 @@ class Node:
                         holder["ok"] = bool(msg.get("ok"))
                         holder["error"] = msg.get("error")
                         holder["event"].set()
+                elif mtype == "syncer_report":
+                    self._on_syncer_report(msg)
                 else:
                     self._handle_message(conn, handle, msg)
         finally:
@@ -998,17 +1048,109 @@ class Node:
         """A node_agent joined over TCP (the raylet-registers-with-GCS path,
         ``GcsNodeManager`` analog)."""
         node_id = msg["node_id"]
-        self.add_node_state(node_id, msg["resources"], msg.get("tpu_ids"))
+        self.add_node_state(node_id, msg["resources"], msg.get("tpu_ids"),
+                            slice_id=msg.get("slice_id"))
         with self.lock:
             ns = self.nodes[node_id]
             ns.agent_conn = conn
             ns.agent_send_lock = self._conn_lock(conn)
             ns.fetch_addr = tuple(msg["fetch_addr"]) if msg.get("fetch_addr") else None
+            ns.syncer_addr = tuple(msg["syncer_addr"]) if msg.get("syncer_addr") else None
             self._wake_scheduler()
         logger.info("node %s joined with %s", node_id, msg["resources"])
         self.publish("node_change", {"node_id": node_id, "alive": True,
                                      "resources": msg["resources"]})
+        self._broadcast_syncer_peers()
         return node_id
+
+    # ------------------------------------------------------------------
+    # P2P resource/health mesh (head side of _private/syncer.py)
+    # ------------------------------------------------------------------
+    def _broadcast_syncer_peers(self) -> None:
+        """Ship the mesh directory to every agent (on membership change).
+        The directory is the union of alive syncer-capable nodes; agents
+        prune their stores to it."""
+        with self.lock:
+            peers = {nid: list(ns.syncer_addr)
+                     for nid, ns in self.nodes.items()
+                     if ns.alive and ns.syncer_addr}
+            agents = [ns for ns in self.nodes.values()
+                      if ns.alive and ns.agent_conn is not None]
+        if not peers:
+            return
+        for ns in agents:
+            try:
+                ns.agent_send({"type": "syncer_peers", "peers": peers})
+            except (OSError, ValueError):
+                pass  # its conn-close path will reap it
+
+    def mark_slice_draining(self, slice_id: str, draining: bool = True) -> None:
+        """Deliberate slice-atomic termination in progress: member deaths
+        of a draining slice are expected, not 'degraded'.  The mark
+        self-clears when the last member is removed."""
+        with self.lock:
+            if draining:
+                self._draining_slices.add(slice_id)
+            else:
+                self._draining_slices.discard(slice_id)
+
+    def _on_syncer_report(self, msg: dict) -> None:
+        """Fold one agent's converged mesh view.
+
+        Version-gated exactly like the agents' own merges: any snapshot
+        strictly newer than what the head has folded counts as a
+        heartbeat for THAT node (its author was alive at snap ts) — so a
+        node whose direct link to the head is broken stays alive and
+        fresh through its peers' reports, and the head is no longer the
+        sole fan-in for liveness.  Death rumors (connection refused — the
+        peer's listener is gone) and suspect quorums (>= SUSPECT_QUORUM
+        distinct observers of an unresponsive peer) remove nodes ahead of
+        the missed-pong timeout; both are double-checked against the
+        head's own recent direct contact so a one-sided partition can't
+        kill a node the head still hears from."""
+        from ray_tpu._private.syncer import SUSPECT_QUORUM
+
+        now = time.time()
+        period = self.cfg.health_check_period_s
+        to_remove: Dict[str, Tuple[str, dict]] = {}  # nid -> (why, data);
+        # dict, not list: a paused-then-killed node sits in BOTH the
+        # deaths and suspects tables — remove it once
+        with self.lock:
+            for nid, snap in (msg.get("snaps") or {}).items():
+                ns = self.nodes.get(nid)
+                if ns is None or not ns.alive:
+                    continue
+                version = int(snap.get("version", 0))
+                if version <= self._syncer_versions.get(nid, 0):
+                    continue
+                self._syncer_versions[nid] = version
+                ts = min(float(snap.get("ts", now)), now)
+                if ts > ns.last_heartbeat:
+                    ns.last_heartbeat = ts
+                if snap.get("stats") and ns.agent_conn is not None:
+                    ns.host_stats = snap["stats"]
+            for nid, death in (msg.get("deaths") or {}).items():
+                ns = self.nodes.get(nid)
+                if (ns is not None and ns.alive
+                        and now - ns.last_heartbeat > period):
+                    to_remove[nid] = ("peer-detected node death", {
+                        "observer": death.get("by"),
+                        "detect_latency_s": round(now - death.get("ts", now), 3),
+                    })
+            for nid, observers in (msg.get("suspects") or {}).items():
+                ns = self.nodes.get(nid)
+                if (nid not in to_remove and ns is not None and ns.alive
+                        and len(observers) >= SUSPECT_QUORUM
+                        and now - ns.last_heartbeat > 2 * period):
+                    to_remove[nid] = ("peer-quorum node unresponsive", {
+                        "observers": sorted(observers)[:8],
+                        "quorum": len(observers),
+                    })
+        for nid, (why, data) in to_remove.items():
+            logger.warning("syncer: removing node %s (%s)", nid, why)
+            events_mod.emit("syncer", why, severity="ERROR", entity_id=nid,
+                            **data)
+            self.remove_node_state(nid)
 
     def _on_remote_worker_exited(self, msg: dict) -> None:
         wid = bytes.fromhex(msg["worker_id"])
@@ -3257,11 +3399,13 @@ class Node:
         err = RayActorError(f"Actor {art.info.class_name} was killed before creation")
         for spec in failed_specs:
             self._seal_error_returns(spec, err)
-        if w is not None and w.proc is not None:
-            try:
-                w.proc.kill()
-            except Exception:
-                pass
+        if w is not None:
+            # _kill_worker, not w.proc.kill(): a REMOTE actor's worker has
+            # no head-side proc — the raw kill silently no-op'd, leaving a
+            # zombie worker running on its agent AND its bundle capacity
+            # held forever (a gang restart on live nodes then wedges: the
+            # old gang's CPUs never return to the node pool)
+            self._kill_worker(w, reason=f"actor {art.info.class_name} killed")
 
     # ------------------------------------------------------------------
     # placement groups (GcsPlacementGroupManager + bundle policies analog)
@@ -3333,7 +3477,13 @@ class Node:
                 if ok:
                     return [(b, n) for b in info.bundles]
             if strategy == "STRICT_PACK":
-                return None
+                # Gang lease at slice granularity: when no single node
+                # holds the gang, the pack unit widens to one FAILURE
+                # DOMAIN — all bundles land within one slice (hosts
+                # sharing a slice_id), leased atomically or not at all
+                # (the TPU pod-slice semantics; a bundle-per-host gang
+                # across a 16-host slice is exactly this shape).
+                return self._try_pack_in_slice(info, alive, scratch)
         used_nodes = set()
         for b in info.bundles:
             cands = [n for n in alive if _fits(b, scratch[n.node_id])]
@@ -3348,6 +3498,38 @@ class Node:
             used_nodes.add(n.node_id)
             placement.append((b, n))
         return placement
+
+    def _try_pack_in_slice(self, info: PlacementGroupInfo, alive, scratch):
+        """STRICT_PACK fallback: fit ALL bundles within one slice.
+
+        Slices are tried smallest-member-count first (tightest failure
+        domain that can hold the gang); within a slice, bundles first-fit
+        across members sorted by id (rank i of an N-bundle/N-host gang
+        lands on host i — the deterministic rank→host mapping a
+        collective mesh wants).  All-or-nothing per slice: a slice with a
+        dead member that can't absorb the gang is skipped whole."""
+        by_slice: Dict[str, list] = {}
+        for n in alive:
+            if n.slice_id is not None:
+                by_slice.setdefault(n.slice_id, []).append(n)
+        for _, members in sorted(by_slice.items(),
+                                 key=lambda kv: (len(kv[1]), kv[0])):
+            members = sorted(members, key=lambda n: n.node_id)
+            avail = {n.node_id: dict(scratch[n.node_id]) for n in members}
+            placement = []
+            ok = True
+            for b in info.bundles:
+                for n in members:
+                    if _fits(b, avail[n.node_id]):
+                        _acquire(b, avail[n.node_id])
+                        placement.append((b, n))
+                        break
+                else:
+                    ok = False
+                    break
+            if ok:
+                return placement
+        return None
 
     def remove_placement_group(self, pg_id: bytes) -> None:
         with self.lock:
@@ -3406,6 +3588,30 @@ class Node:
             if what == "placement_groups":
                 return (rows(self.gcs.placement_groups.values()),
                         len(self.gcs.placement_groups))
+        if what == "slices":
+            # failure-domain view: one row per slice, the unit the
+            # autoscaler provisions/replaces atomically
+            with self.lock:
+                by_slice: Dict[str, dict] = {}
+                for ns in self.nodes.values():
+                    if ns.slice_id is None:
+                        continue
+                    row = by_slice.setdefault(ns.slice_id, {
+                        "slice_id": ns.slice_id, "members": [],
+                        "alive_members": 0, "dead_members": 0,
+                        "draining": ns.slice_id in self._draining_slices,
+                    })
+                    row["members"].append(ns.node_id)
+                    row["alive_members" if ns.alive else "dead_members"] += 1
+                out = []
+                for sid in sorted(by_slice):
+                    row = by_slice[sid]
+                    row["members"].sort()
+                    row["degraded"] = (row["dead_members"] > 0
+                                       and row["alive_members"] > 0
+                                       and not row["draining"])
+                    out.append(row)
+            return out[:limit], len(out)
         if what == "objects":
             return (self.registry.list_objects(limit),
                     self.registry.stats()["num_objects"])

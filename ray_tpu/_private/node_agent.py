@@ -10,8 +10,13 @@ with the GCS and serves its node): the agent
 - serves object pulls from this node's private shm namespace through an
   :class:`~ray_tpu._private.object_transfer.ObjectServer`,
 - reports pre-registration worker deaths (the head cannot poll a remote
-  process), and
-- unlinks local segments when the head evicts them.
+  process),
+- unlinks local segments when the head evicts them, and
+- gossips its resource view + liveness to peer agents through the
+  :mod:`~ray_tpu._private.syncer` P2P mesh (on by default), shipping the
+  converged view back to the head each tick so the head is no longer the
+  sole fan-in for every heartbeat and peer-observed death reaches it
+  faster than a missed-pong timeout.
 
 Run via ``python -m ray_tpu._private.node_agent --address host:port
 --authkey <hex>`` or through ``ray_tpu start`` / ``cluster_utils.Cluster``.
@@ -23,6 +28,7 @@ import argparse
 import logging
 import os
 import pickle
+import random
 import subprocess
 import sys
 import threading
@@ -52,12 +58,14 @@ class NodeAgent:
         node_id: Optional[str] = None,
         shm_dir: Optional[str] = None,
         host: str = "127.0.0.1",
+        slice_id: Optional[str] = None,
     ):
         from ray_tpu._private import shm as shm_mod
         from ray_tpu._private.object_transfer import ObjectServer, configure
         from ray_tpu._private.resource_spec import autodetect_resources
 
         self.node_id = node_id or f"node-{os.urandom(4).hex()}"
+        self.slice_id = slice_id or os.environ.get("RAY_TPU_SLICE_ID") or None
         self.authkey = authkey
         host_s, port_s = address.rsplit(":", 1)
         self.head_addr = (host_s, int(port_s))
@@ -78,9 +86,28 @@ class NodeAgent:
         self.object_server = ObjectServer(host, authkey)
 
         total, tpu_ids = autodetect_resources(num_cpus, num_tpus, resources)
+        self.resources = total
         self.procs: Dict[str, subprocess.Popen] = {}  # worker_id hex -> proc
         self._lock = threading.Lock()
         self._shutdown = False
+        # chaos message-drop window (devtools.chaos `drop` op): while
+        # active, outbound control messages are dropped with probability
+        # ``frac`` — the head's direct view of this agent goes dark while
+        # the P2P mesh keeps carrying its state
+        self._drop: Optional[dict] = None
+
+        # P2P resource/health mesh: on by default whenever this process
+        # exists at all (an agent IS the multi-node case)
+        self.syncer = None
+        from ray_tpu._private import syncer as syncer_mod
+
+        if syncer_mod.ENABLED:
+            self.syncer = syncer_mod.ResourceSyncer(
+                self.node_id, authkey,
+                state_fn=self._syncer_state,
+                report_fn=self._syncer_report,
+                host=host,
+            )
 
         from ray_tpu._private import wire
 
@@ -93,7 +120,21 @@ class NodeAgent:
             "resources": total,
             "tpu_ids": tpu_ids,
             "fetch_addr": tuple(self.object_server.addr),
+            "slice_id": self.slice_id,
+            "syncer_addr": tuple(self.syncer.addr) if self.syncer else None,
         })
+        if self.syncer is not None:
+            self.syncer.start()
+
+        # agent events (syncer suspicions, chaos windows) ship to the
+        # head's event table like any worker's — without this pusher an
+        # agent's flight-recorder ring would be invisible to `ray_tpu
+        # events` / doctor
+        from ray_tpu._private.events import EventsPusher
+
+        self.events_pusher = EventsPusher(
+            self._send, origin=self.node_id,
+            closed_fn=lambda: self._shutdown).start()
 
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
                                          name="agent-monitor")
@@ -122,8 +163,35 @@ class NodeAgent:
 
     # -- plumbing ---------------------------------------------------------
     def _send(self, msg: dict) -> None:
+        drop = self._drop
+        if drop is not None:
+            if time.time() >= drop["until"]:
+                self._drop = None
+            elif drop["rng"].random() < drop["frac"]:
+                return  # chaos: this control message is lost on the floor
         with self._send_lock:
             self.conn.send(msg)
+
+    # -- P2P mesh ---------------------------------------------------------
+    def _syncer_state(self) -> dict:
+        """This node's own versioned snapshot payload (gossiped each tick)."""
+        from ray_tpu._private.resource_spec import host_stats
+
+        return {
+            "resources": dict(self.resources),
+            "stats": host_stats(),
+            "slice_id": self.slice_id,
+            "workers": len(self.procs),
+        }
+
+    def _syncer_report(self, view: dict) -> None:
+        """Ship the converged mesh view to the head (one frame per tick;
+        rides the same control connection as metrics_report)."""
+        try:
+            self._send({"type": "syncer_report", "origin": self.node_id,
+                        **view})
+        except (OSError, ValueError):
+            pass  # head gone or conn tearing down; gossip continues
 
     # -- head message loop ------------------------------------------------
     def serve_forever(self) -> None:
@@ -163,6 +231,22 @@ class NodeAgent:
             ).start()
         elif mtype == "shutdown":
             self._shutdown = True
+        elif mtype == "syncer_peers":
+            # head-maintained mesh directory (rebroadcast on membership
+            # change); the syncer prunes its store to it
+            if self.syncer is not None:
+                self.syncer.set_peers({
+                    nid: tuple(addr)
+                    for nid, addr in (msg.get("peers") or {}).items()})
+        elif mtype == "chaos_drop":
+            # devtools.chaos fault injection: drop outbound control
+            # messages for a window (seeded — reproducible schedules)
+            frac = float(msg.get("frac", 1.0))
+            dur = float(msg.get("duration_s", 5.0))
+            self._drop = {"frac": frac, "until": time.time() + dur,
+                          "rng": random.Random(msg.get("seed"))}
+            logger.warning("chaos: dropping %d%% of outbound messages for "
+                           "%.1fs", int(frac * 100), dur)
         elif mtype == "ping":
             # heartbeat reply doubles as the per-node metrics report
             # (reporter_agent analog): live host utilization rides every
@@ -282,6 +366,12 @@ class NodeAgent:
         from ray_tpu._private import shm as shm_mod
 
         self._shutdown = True
+        if self.syncer is not None:
+            self.syncer.stop()
+        try:
+            self.events_pusher.stop()
+        except Exception:
+            pass
         with self._lock:
             procs = list(self.procs.values())
             self.procs.clear()
@@ -311,6 +401,9 @@ def main() -> None:
                    help='extra custom resources as JSON, e.g. \'{"special": 1}\'')
     p.add_argument("--node-id", default=None)
     p.add_argument("--shm-dir", default=None)
+    p.add_argument("--slice-id", default=None,
+                   help="failure-domain id: hosts of one TPU slice share it "
+                        "and are provisioned/replaced as one unit")
     args = p.parse_args()
     authkey = bytes.fromhex(args.authkey or os.environ["RAY_TPU_AUTHKEY"])
     logging.basicConfig(level=os.environ.get("RAY_TPU_LOG_LEVEL", "INFO"))
@@ -320,7 +413,7 @@ def main() -> None:
         args.address, authkey,
         num_cpus=args.num_cpus, num_tpus=args.num_tpus,
         resources=json.loads(args.resources) if args.resources else None,
-        node_id=args.node_id, shm_dir=args.shm_dir,
+        node_id=args.node_id, shm_dir=args.shm_dir, slice_id=args.slice_id,
     )
     agent.serve_forever()
 

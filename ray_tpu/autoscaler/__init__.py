@@ -1,14 +1,31 @@
-"""ray_tpu.autoscaler — demand-driven cluster scaling.
+"""ray_tpu.autoscaler — demand- and trend-driven cluster scaling.
 
 Analog of ``python/ray/autoscaler``: ``StandardAutoscaler`` reconcile loop
 (``_private/autoscaler.py:167``) over pluggable ``NodeProvider``s
 (``autoscaler/node_provider.py:13``), including a local provider (real
-node_agent subprocesses) and a GCP TPU provider skeleton mirroring the
-reference's ``GCPTPUNode`` (``_private/gcp/node.py:187``).
+node_agent subprocesses, with multi-host emulated TPU slices) and a GCP
+TPU provider mirroring the reference's ``GCPTPUNode``
+(``_private/gcp/node.py:187``).  ``TrendAutoscaler`` adds TSDB-trend
+decisions (scale before doctor flags an incident) and slice-atomic
+replacement of degraded slices (``policy.py``).
 """
 
-from ray_tpu.autoscaler.autoscaler import Monitor, StandardAutoscaler
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalingConfig,
+    Monitor,
+    StandardAutoscaler,
+)
 from ray_tpu.autoscaler.node_provider import NodeProvider
 from ray_tpu.autoscaler.local_node_provider import LocalNodeProvider
+from ray_tpu.autoscaler.policy import (
+    Decision,
+    TrendAutoscaler,
+    TrendPolicy,
+    TrendPolicyConfig,
+)
 
-__all__ = ["StandardAutoscaler", "Monitor", "NodeProvider", "LocalNodeProvider"]
+__all__ = [
+    "AutoscalingConfig", "StandardAutoscaler", "Monitor", "NodeProvider",
+    "LocalNodeProvider",
+    "TrendAutoscaler", "TrendPolicy", "TrendPolicyConfig", "Decision",
+]

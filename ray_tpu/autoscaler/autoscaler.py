@@ -16,6 +16,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ray_tpu._private import events as _events
 from ray_tpu.autoscaler.node_provider import NodeProvider
 
 logger = logging.getLogger(__name__)
@@ -78,10 +79,45 @@ class StandardAutoscaler:
                 unmet.append(req)
         return unmet
 
+    def _slice_members(self, provider_node_id: str) -> List[str]:
+        """The cluster node ids behind one provider node (a TPU slice's
+        member hosts; ``[provider_node_id]`` for single-host providers).
+
+        When the provider can't map its node to member hosts (GCP: the
+        TPU API knows VMs, not our node ids), fall back to the HEAD's
+        slice index — hosts join tagged with ``slice_id`` set to the
+        provider node name (``--slice-id`` / RAY_TPU_SLICE_ID in the
+        startup script).  Without this, a multi-host slice's provider id
+        has no head-side NodeState, every member check returns 'idle',
+        and idle scale-down deletes a live slice out from under its gang."""
+        members_fn = getattr(self.provider, "slice_members", None)
+        if members_fn is not None:
+            try:
+                members = list(members_fn(provider_node_id))
+                if members and members != [provider_node_id]:
+                    return members
+            except Exception:
+                pass
+        with self.head.lock:
+            tagged = [ns.node_id for ns in self.head.nodes.values()
+                      if ns.slice_id == provider_node_id]
+        return tagged or [provider_node_id]
+
     def _node_is_idle(self, node_id: str) -> bool:
+        """Idle means EVERY member host of the provider node is idle.
+
+        A slice is one failure domain AND one lease unit: scale-down may
+        terminate the whole slice or nothing — it must never shrink a
+        slice below its gang size.  Reasoning per-host here (the old
+        behavior) would have called a slice 'idle' whenever its id had no
+        head-side NodeState (the slice id is not a host id!) and killed
+        all N hosts under a running gang."""
+        return all(self._member_is_idle(m) for m in self._slice_members(node_id))
+
+    def _member_is_idle(self, member_id: str) -> bool:
         head = self.head
         with head.lock:
-            ns = head.nodes.get(node_id)
+            ns = head.nodes.get(member_id)
             if ns is None or not ns.alive:
                 return True
             if ns.ready_queue:
@@ -100,9 +136,13 @@ class StandardAutoscaler:
         unmet = self.pending_demand()
         to_launch = 0
         if unmet:
+            # one provider node may be a whole slice: its capacity is
+            # slice_hosts x one host's resources, or the bin-pack
+            # over-launches slices by up to slice_hosts x
+            hosts = max(1, int(cfg.worker_node.get("slice_hosts", 1)))
             node_res = {
-                "CPU": float(cfg.worker_node.get("num_cpus", 1)),
-                "TPU": float(cfg.worker_node.get("num_tpus", 0)),
+                "CPU": float(cfg.worker_node.get("num_cpus", 1)) * hosts,
+                "TPU": float(cfg.worker_node.get("num_tpus", 0)) * hosts,
             }
             cap: Dict[str, float] = {}
             for req in unmet:
@@ -119,9 +159,15 @@ class StandardAutoscaler:
         if to_launch > 0:
             logger.info("autoscaler: launching %d worker node(s) for %d unmet "
                         "demands", to_launch, len(unmet))
-            self.provider.create_node(dict(cfg.worker_node), to_launch)
+            created = self.provider.create_node(dict(cfg.worker_node), to_launch)
+            _events.emit("autoscaler", "scale up: launched nodes",
+                         count=to_launch, nodes=list(created or ()),
+                         unmet_demands=len(unmet), reason="pending_demand")
 
-        # scale down: nodes idle past the timeout (never below min_workers)
+        # scale down: nodes idle past the timeout (never below min_workers).
+        # A multi-host slice terminates as ONE unit — and is marked
+        # draining at the head first so its member deaths read as a
+        # deliberate scale-down, not a degraded slice.
         now = time.time()
         removable = len(nodes) - cfg.min_workers
         for nid in nodes:
@@ -131,7 +177,13 @@ class StandardAutoscaler:
             first = self._idle_since.setdefault(nid, now)
             if removable > 0 and now - first >= cfg.idle_timeout_s:
                 logger.info("autoscaler: terminating idle node %s", nid)
+                members = self._slice_members(nid)
+                if len(members) > 1 and hasattr(self.head, "mark_slice_draining"):
+                    self.head.mark_slice_draining(nid)
                 self.provider.terminate_node(nid)
+                _events.emit("autoscaler", "scale down: terminated idle node",
+                             entity_id=nid, idle_s=round(now - first, 1),
+                             member_hosts=len(members))
                 self._idle_since.pop(nid, None)
                 removable -= 1
 

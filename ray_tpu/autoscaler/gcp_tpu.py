@@ -64,7 +64,13 @@ class GCPTPUNodeProvider(NodeProvider):
         return n.get("state") == "READY"
 
     def create_node(self, node_config: Dict, count: int = 1) -> List[str]:
-        created = []
+        """All-or-nothing batch: if the i-th slice creation fails (quota,
+        capacity), the i−1 already-created slices of THIS batch are
+        deleted and the error propagates — a partial provision would
+        read as fleet capacity that can't actually hold the demand that
+        triggered the launch.  The failed name itself is also deleted
+        best-effort (the TPU API can leave a half-created node behind)."""
+        created: List[str] = []
         for _ in range(count):
             self._counter += 1
             name = f"ray-tpu-{self.cluster_name}-{self._counter}"
@@ -75,8 +81,17 @@ class GCPTPUNodeProvider(NodeProvider):
             ]
             script = self.provider_config.get("startup_script")
             if script:
+                # member hosts join the head tagged with this provider
+                # node as their slice_id — the autoscaler's head-side
+                # slice index (idle reasoning, repair) keys on it
+                script = f"export RAY_TPU_SLICE_ID={name}\n{script}"
                 args += ["--metadata", f"startup-script={script}"]
-            self._gcloud(*args)
+            try:
+                self._gcloud(*args)
+            except subprocess.CalledProcessError:
+                for partial in (*created, name):  # rollback, newest last
+                    self.terminate_node(partial)
+                raise
             created.append(name)
         return created
 

@@ -4,6 +4,12 @@ The testing role of the reference's ``FakeMultiNodeProvider``
 (``autoscaler/_private/fake_multi_node/node_provider.py:237``) — but the
 nodes are *real* processes joining over TCP with private shm namespaces,
 so the whole autoscaler loop runs against the production join path.
+
+Slice mode (``provider_config={"slice_hosts": N}`` or per-call
+``node_config["slice_hosts"]``): one provider node is a whole emulated
+TPU pod slice — N agent processes sharing a ``slice_id``, provisioned
+and terminated as ONE unit.  A spawn failure mid-slice rolls back the
+hosts already started (a half slice can never hold a gang) and raises.
 """
 
 from __future__ import annotations
@@ -13,7 +19,7 @@ import os
 import subprocess
 import sys
 import tempfile
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from ray_tpu.autoscaler.node_provider import NodeProvider
 
@@ -23,44 +29,100 @@ class LocalNodeProvider(NodeProvider):
         super().__init__(provider_config, cluster_name)
         self.head = head_node
         self._counter = itertools.count(1)
-        self.procs: Dict[str, subprocess.Popen] = {}
+        self.procs: Dict[str, subprocess.Popen] = {}  # host node id -> proc
+        self.slices: Dict[str, List[str]] = {}        # slice id -> host ids
         self._dirs: List[str] = []
 
     def non_terminated_nodes(self) -> List[str]:
-        return [nid for nid, p in self.procs.items() if p.poll() is None]
+        plain = [nid for nid, p in self.procs.items()
+                 if p.poll() is None and not self._slice_of(nid)]
+        # a slice counts as non-terminated while ANY member lives: a
+        # degraded slice still holds fleet capacity (and is exactly what
+        # replace_slice exists for) — it vanishes only when terminated
+        slices = [sid for sid, members in self.slices.items()
+                  if any(self.procs[m].poll() is None
+                         for m in members if m in self.procs)]
+        return plain + slices
+
+    def _slice_of(self, host_id: str) -> Optional[str]:
+        for sid, members in self.slices.items():
+            if host_id in members:
+                return sid
+        return None
 
     def is_running(self, node_id: str) -> bool:
-        p = self.procs.get(node_id)
-        return p is not None and p.poll() is None
+        members = self.slice_members(node_id)
+        return bool(members) and all(
+            m in self.procs and self.procs[m].poll() is None
+            for m in members)
+
+    def slice_members(self, node_id: str) -> List[str]:
+        return list(self.slices.get(node_id, [node_id]))
 
     def create_node(self, node_config: Dict, count: int = 1) -> List[str]:
         out = []
-        host, port = self.head.tcp_address
+        hosts = int(node_config.get(
+            "slice_hosts", self.provider_config.get("slice_hosts", 1)))
         for _ in range(count):
-            node_id = f"auto-{self.cluster_name}-{next(self._counter)}"
-            shm_sub = tempfile.mkdtemp(prefix=f"rtpu-{node_id}-", dir="/dev/shm")
-            self._dirs.append(shm_sub)
-            env = dict(os.environ)
-            env["RAY_TPU_AUTHKEY"] = self.head.authkey.hex()
-            cmd = [
-                sys.executable, "-m", "ray_tpu._private.node_agent",
-                "--address", f"{host}:{port}",
-                "--node-id", node_id,
-                "--num-cpus", str(int(node_config.get("num_cpus", 1))),
-                "--num-tpus", str(int(node_config.get("num_tpus", 0))),
-                "--shm-dir", shm_sub,
-            ]
-            self.procs[node_id] = subprocess.Popen(cmd, env=env)
-            out.append(node_id)
+            n = next(self._counter)
+            if hosts <= 1:
+                node_id = f"auto-{self.cluster_name}-{n}"
+                self.procs[node_id] = self._spawn_agent(node_id, node_config)
+                out.append(node_id)
+                continue
+            # one provider node = one slice of `hosts` agents that live
+            # and die together
+            slice_id = f"slice-{self.cluster_name}-{n}"
+            members: List[str] = []
+            try:
+                for h in range(hosts):
+                    host_id = f"{slice_id}-h{h}"
+                    self.procs[host_id] = self._spawn_agent(
+                        host_id, node_config, slice_id=slice_id)
+                    members.append(host_id)
+            except OSError:
+                # partial provision: a half slice can never hold the
+                # gang — roll the started hosts back and surface the
+                # failure instead of leaking a useless fragment
+                for host_id in members:
+                    self._kill_host(host_id)
+                raise
+            self.slices[slice_id] = members
+            out.append(slice_id)
         return out
 
-    def terminate_node(self, node_id: str) -> None:
-        p = self.procs.pop(node_id, None)
+    def _spawn_agent(self, node_id: str, node_config: Dict,
+                     slice_id: Optional[str] = None) -> subprocess.Popen:
+        host, port = self.head.tcp_address
+        shm_sub = tempfile.mkdtemp(prefix=f"rtpu-{node_id}-", dir="/dev/shm")
+        self._dirs.append(shm_sub)
+        env = dict(os.environ)
+        env["RAY_TPU_AUTHKEY"] = self.head.authkey.hex()
+        cmd = [
+            sys.executable, "-m", "ray_tpu._private.node_agent",
+            "--address", f"{host}:{port}",
+            "--node-id", node_id,
+            "--num-cpus", str(int(node_config.get("num_cpus", 1))),
+            "--num-tpus", str(int(node_config.get("num_tpus", 0))),
+            "--shm-dir", shm_sub,
+        ]
+        if slice_id:
+            cmd += ["--slice-id", slice_id]
+        return subprocess.Popen(cmd, env=env)
+
+    def _kill_host(self, host_id: str) -> None:
+        p = self.procs.pop(host_id, None)
         if p is not None:
             try:
                 p.kill()
             except Exception:
                 pass
+
+    def terminate_node(self, node_id: str) -> None:
+        # slice-atomic: ALL member hosts die together, never a subset
+        for host_id in self.slice_members(node_id):
+            self._kill_host(host_id)
+        self.slices.pop(node_id, None)
 
     def shutdown(self) -> None:
         super().shutdown()

@@ -52,6 +52,7 @@ class Cluster:
         resources: Optional[Dict[str, float]] = None,
         env: Optional[Dict[str, str]] = None,
         wait: bool = True,
+        slice_id: Optional[str] = None,
     ) -> str:
         node = global_worker.node
         node_id = f"node-{next(self._node_counter)}"
@@ -59,7 +60,8 @@ class Cluster:
             total = dict(resources or {})
             total["CPU"] = float(num_cpus)
             total["TPU"] = float(num_tpus)
-            node.add_node_state(node_id, total, tpu_ids=list(range(num_tpus)), env=env)
+            node.add_node_state(node_id, total, tpu_ids=list(range(num_tpus)),
+                                env=env, slice_id=slice_id)
             self.node_ids.append(node_id)
             return node_id
 
@@ -80,6 +82,8 @@ class Cluster:
             "--num-tpus", str(num_tpus),
             "--shm-dir", shm_sub,
         ]
+        if slice_id:
+            cmd += ["--slice-id", slice_id]
         if resources:
             import json
 

@@ -207,6 +207,10 @@ class LintConfig:
     clientbound_sender_modules: Tuple[str, ...] = (
         "ray_tpu/_private/node.py",
         "ray_tpu/dashboard/dashboard.py",
+        # the chaos harness runs IN the head process and injects faults
+        # over the agents' control connections (agent_send) — its frames
+        # go head -> agent, same direction as node.py's
+        "ray_tpu/devtools/chaos/harness.py",
     )
     # the codec rebuilds frames from protobuf — its dict literals are not
     # send sites, and its tables must not count as senders

@@ -63,6 +63,13 @@ def list_nodes(limit: int = 1000) -> List[dict]:
     return _list("nodes", limit)
 
 
+def list_slices(limit: int = 1000) -> List[dict]:
+    """One row per TPU slice (failure domain): members, alive/dead
+    counts, draining flag, and whether it is currently degraded (dead
+    member, not draining — what doctor's ``slice_degraded`` watches)."""
+    return _list("slices", limit)
+
+
 def list_tasks(limit: int = 1000) -> List[dict]:
     return _list("tasks", limit)
 

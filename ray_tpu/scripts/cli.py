@@ -407,6 +407,28 @@ def cmd_top(args) -> None:
         pass
 
 
+def cmd_slices(args) -> None:
+    """Failure-domain view: one line per TPU slice with member health,
+    draining state and the degraded flag doctor watches."""
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    rows = state.list_slices(limit=args.limit)
+    if args.json:
+        print(json.dumps(rows, indent=2, default=repr))
+        return
+    if not rows:
+        print("no slices (no node joined with a slice id)")
+        return
+    print(f"{'SLICE':<28} {'HOSTS':>5} {'ALIVE':>5} {'DEAD':>4} STATE")
+    for r in rows:
+        state_s = ("DEGRADED" if r["degraded"]
+                   else "draining" if r["draining"]
+                   else "healthy" if r["dead_members"] == 0 else "dead")
+        print(f"{r['slice_id']:<28} {len(r['members']):>5} "
+              f"{r['alive_members']:>5} {r['dead_members']:>4} {state_s}")
+
+
 def cmd_memory(args) -> None:
     """Object-ownership audit (``ray memory`` analog): bytes by owner and
     pin reason, per-object rows, orphan flags."""
@@ -595,7 +617,7 @@ def main(argv=None) -> None:
     s = sub.add_parser("list", help="state API tables")
     s.add_argument("what", choices=["actors", "tasks", "nodes", "objects",
                                     "workers", "placement_groups", "jobs",
-                                    "traces"])
+                                    "traces", "slices"])
     s.add_argument("--limit", type=int, default=100)
     s.set_defaults(fn=cmd_list)
 
@@ -688,6 +710,14 @@ def main(argv=None) -> None:
                    help="per-object rows to show (aggregates cover all)")
     s.add_argument("--json", action="store_true")
     s.set_defaults(fn=cmd_memory)
+
+    s = sub.add_parser(
+        "slices",
+        help="TPU slice failure domains: member health, draining, "
+             "degraded flags")
+    s.add_argument("--limit", type=int, default=100)
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_slices)
 
     s = sub.add_parser(
         "metrics", help="metrics TSDB: directory, or query one series")

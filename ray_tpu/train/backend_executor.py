@@ -29,16 +29,21 @@ class BackendExecutor:
         self,
         backend_config: BackendConfig,
         scaling_config: ScalingConfig,
+        prior_gang_starts: int = 0,
     ):
         self.backend_config = backend_config
         self.scaling_config = scaling_config
         self.backend: Backend = backend_config.backend_cls()
         self.worker_group: Optional[WorkerGroup] = None
         self._finished: List[bool] = []
+        # fit() builds a FRESH executor per whole-gang restart: the prior
+        # start count must ride along or every incarnation reads as the
+        # first and the flight recorder never shows "gang restarted"
+        self._gang_starts = prior_gang_starts
 
     def start(self) -> None:
         sc = self.scaling_config
-        self._gang_starts = getattr(self, "_gang_starts", 0) + 1
+        self._gang_starts += 1
         self.worker_group = WorkerGroup(
             sc.num_workers, sc.worker_resources, sc.placement_strategy
         )

@@ -101,7 +101,8 @@ class DataParallelTrainer(BaseTrainer):
         failures = 0
 
         while True:
-            executor = BackendExecutor(self.backend_config, self.scaling_config)
+            executor = BackendExecutor(self.backend_config, self.scaling_config,
+                                       prior_gang_starts=failures)
             try:
                 executor.start()
                 executor.start_training(

@@ -249,6 +249,66 @@ def _rule_slow_node_skew(events, tasks):
         "the dashboard (CPU steal, thermal, noisy neighbor) or drain it")
 
 
+def _rule_slice_degraded(events, tasks):
+    """A slice with a dead/paused member and NO replacement in flight.
+
+    A slice is one failure domain: one dead host wedges any STRICT gang
+    leased on it, and per-host healing can't restore the lease — the only
+    remedy is slice-atomic replacement.  The head emits ``slice
+    degraded`` when a member dies unexpectedly (deliberate scale-downs
+    mark the slice draining first and stay silent); the autoscaler emits
+    ``slice replacement started`` / ``replaced`` / ``failed`` as it
+    heals.  A degraded slice whose LAST degradation has no completed
+    replacement at or after it — and no replacement in flight (a
+    ``started`` not superseded by a later ``failed``) — is an open
+    incident; a FAILED replacement re-opens it (the slice is still
+    degraded; suppressing on 'started' alone would keep doctor silent
+    forever under e.g. persistent quota exhaustion)."""
+    degraded = _rows(events, "node", "slice degraded")
+    if not degraded:
+        return None
+
+    def _last_ts(source, message):
+        out: Dict[str, float] = {}
+        for r in _rows(events, source, message):
+            sid = r.get("entity_id")
+            out[sid] = max(out.get(sid, 0.0), float(r.get("ts") or 0.0))
+        return out
+
+    replaced = _last_ts("autoscaler", "slice replaced")
+    started = _last_ts("autoscaler", "slice replacement started")
+    failed = _last_ts("autoscaler", "slice replacement failed")
+    last_degraded: Dict[str, dict] = {}
+    for r in degraded:
+        sid = r.get("entity_id")
+        if (sid not in last_degraded
+                or float(r.get("ts") or 0.0)
+                >= float(last_degraded[sid].get("ts") or 0.0)):
+            last_degraded[sid] = r
+
+    def _open(sid, row):
+        ts = float(row.get("ts") or 0.0)
+        if replaced.get(sid, -1.0) >= ts:
+            return False  # repair landed
+        in_flight = (started.get(sid, -1.0) >= ts
+                     and failed.get(sid, -1.0) < started.get(sid, -1.0))
+        return not in_flight
+
+    open_rows = [r for sid, r in sorted(last_degraded.items())
+                 if _open(sid, r)]
+    if not open_rows:
+        return None
+    sids = ", ".join(str(r.get("entity_id")) for r in open_rows)
+    return _finding(
+        "slice_degraded", "ERROR",
+        f"slice(s) {sids} hold dead member(s) with no replacement in "
+        f"flight — any STRICT gang on them is wedged",
+        open_rows,
+        "replace the slice atomically (TrendAutoscaler.repair_slices / "
+        "provider.replace_slice, create-before-terminate); per-host "
+        "replacement cannot restore the gang lease")
+
+
 # ---------------------------------------------------------------------------
 # trend rules (each: series_map -> finding | None).  series_map is
 # {metric_name: [{"tags": {...}, "points": [[ts, value], ...]}, ...]} —
@@ -396,6 +456,7 @@ def diagnose_trends(series_map: Dict[str, list]) -> List[dict]:
 
 RULES = (
     _rule_oom_kills,
+    _rule_slice_degraded,
     _rule_gang_restart,
     _rule_stuck_channel,
     _rule_backpressure_stall,

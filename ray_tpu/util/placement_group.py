@@ -2,7 +2,10 @@
 
 ``placement_group()`` (reference ``placement_group.py:128``) reserves gangs
 of resource bundles; strategies STRICT_PACK/PACK/SPREAD/STRICT_SPREAD map to
-the head's bundle policies.  For TPU pod slices, a STRICT_PACK bundle per
+the head's bundle policies.  STRICT_PACK is the gang lease: all bundles on
+one node, or — when no single node holds them — all within ONE slice
+(hosts sharing a ``slice_id`` failure domain), leased atomically with a
+deterministic rank→host mapping.  For TPU pod slices, a STRICT_PACK bundle per
 host with ``TPU`` resources is the gang-scheduling primitive (SURVEY §7
 phase 2: a slice = bundles that must be leased atomically and die together).
 """

@@ -42,6 +42,10 @@ verb = args[3]
 rest = args[4:]
 if verb == "create":
     name = rest[0]
+    if name in s.get("fail_create", []):
+        # injected quota/capacity failure for THIS node name
+        save(s)
+        sys.exit(1)
     s["nodes"][name] = {"name": f"projects/p/zones/z/nodes/{name}",
                         "state": "READY"}
     out = s["nodes"][name]
@@ -127,3 +131,61 @@ def test_autoscaler_scales_tpu_slices(fake_gcloud, ray_start_regular):
         node.pending_tasks.clear()
     scaler.update()  # demand gone + idle_timeout 0 -> scale back down
     assert prov.non_terminated_nodes() == []
+
+
+def _calls(state_path):
+    return json.loads(state_path.read_text())["calls"]
+
+
+def test_replace_slice_creates_before_terminating(fake_gcloud):
+    """Slice-atomic replacement ordering: the replacement slice is
+    provisioned FIRST; only once it exists is the degraded slice deleted
+    — fleet capacity never dips below N-1 healthy slices."""
+    prov = _provider()
+    old = prov.create_node({}, count=1)[0]
+    new = prov.replace_slice(old)
+    assert new != old
+    assert prov.non_terminated_nodes() == [new]
+
+    ops = [(c[3], c[4]) for c in _calls(fake_gcloud)
+           if c[3] in ("create", "delete")]
+    create_new = ops.index(("create", new))
+    delete_old = ops.index(("delete", old))
+    assert create_new < delete_old, ops
+
+
+def test_replace_slice_failure_leaves_old_slice_untouched(fake_gcloud):
+    """If the replacement can't be provisioned (quota), the old slice is
+    left exactly as it was and the error propagates — never fewer slices
+    than we started with."""
+    prov = _provider()
+    old = prov.create_node({}, count=1)[0]
+    state = json.loads(fake_gcloud.read_text())
+    state["fail_create"] = [f"ray-tpu-t-{prov._counter + 1}"]
+    fake_gcloud.write_text(json.dumps(state))
+
+    with pytest.raises(subprocess.CalledProcessError):
+        prov.replace_slice(old)
+    assert prov.non_terminated_nodes() == [old]
+    assert ("delete", old) not in [
+        (c[3], c[4]) for c in _calls(fake_gcloud) if len(c) > 4]
+
+
+def test_partial_provision_rolls_back_whole_batch(fake_gcloud):
+    """All-or-nothing batch create: when the 2nd of 3 slices fails, the
+    1st is deleted (and the failed name cleaned up best-effort), the
+    error propagates, and nothing leaks as phantom fleet capacity."""
+    prov = _provider()
+    state = {"nodes": {}, "calls": [], "fail_create": ["ray-tpu-t-2"]}
+    fake_gcloud.write_text(json.dumps(state))
+
+    with pytest.raises(subprocess.CalledProcessError):
+        prov.create_node({}, count=3)
+    assert prov.non_terminated_nodes() == []
+
+    ops = [(c[3], c[4]) for c in _calls(fake_gcloud)
+           if c[3] in ("create", "delete")]
+    assert ("create", "ray-tpu-t-1") in ops
+    assert ("delete", "ray-tpu-t-1") in ops          # rollback
+    assert ("delete", "ray-tpu-t-2") in ops          # half-created victim
+    assert ("create", "ray-tpu-t-3") not in ops      # stopped at failure

@@ -1,0 +1,295 @@
+"""P2P resource/health sync mesh (``_private/syncer.py``).
+
+Unit half: the versioned-snapshot store's merge invariants (only newer
+versions apply, death rumors keep the first observation and are erased by
+resurrection, suspicions union per observer) and the signed framed
+transport.  Mesh half: real in-process syncers converging over sockets.
+Cluster half: the mesh is ON by default for agent-joined clusters, a
+SIGSTOPPED agent is removed by peer suspect quorum well before the
+missed-pong timeout, and a node whose head link goes lossy SURVIVES the
+heartbeat timeout because its peers' reports keep vouching for it — the
+head is no longer the sole fan-in.
+"""
+
+import os
+import signal
+import socket
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.syncer import (
+    ResourceSyncer,
+    SyncerStore,
+    recv_frame,
+    send_frame,
+)
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu._private.worker import global_worker
+
+AUTHKEY = b"test-authkey"
+
+
+# ---------------------------------------------------------------------------
+# store invariants
+# ---------------------------------------------------------------------------
+
+def test_store_version_gating():
+    a = SyncerStore("a")
+    a.local_update({"x": 1})
+    a.local_update({"x": 2})
+    assert a.get("a")["version"] == 2
+
+    b = SyncerStore("b")
+    # b folds a's v2; a stale v1 replay must not regress it
+    applied = b.merge({"a": dict(a.get("a"))})
+    assert applied == 1
+    stale = dict(a.get("a"))
+    stale["version"] = 1
+    stale["x"] = 99
+    assert b.merge({"a": stale}) == 0
+    assert b.get("a")["x"] == 2
+
+    # nobody but the node itself authors its own snapshot
+    forged = {"node_id": "b", "version": 100, "ts": time.time()}
+    b.merge({"b": forged})
+    assert b.get("b") is None  # b never local_update'd
+
+
+def test_death_rumor_first_observer_wins_and_resurrection_erases():
+    s = SyncerStore("w")
+    t0 = time.time()
+    assert s.mark_dead("x", by="a", ts=t0 + 5)
+    # an EARLIER observation replaces (it is the detection-latency truth)
+    assert s.mark_dead("x", by="b", ts=t0 + 1)
+    # a later observation is not news
+    assert not s.mark_dead("x", by="c", ts=t0 + 9)
+    _, deaths, _ = s.snapshot()
+    assert deaths["x"]["by"] == "b"
+
+    # a snapshot AUTHORED after the rumor proves resurrection
+    s.merge({"x": {"node_id": "x", "version": 7, "ts": t0 + 30}})
+    _, deaths, _ = s.snapshot()
+    assert "x" not in deaths
+    # ...but a snapshot older than the rumor does not
+    s.mark_dead("x", by="a", ts=t0 + 60)
+    s.merge(None, deaths={"x": {"ts": t0 + 60, "by": "a"}})
+    _, deaths, _ = s.snapshot()
+    assert "x" in deaths
+
+
+def test_suspect_union_and_clear_on_progress():
+    s = SyncerStore("w")
+    s.mark_suspect("x", by="a", ts=1.0)
+    s.merge(None, suspects={"x": {"b": 2.0, "a": 0.5}})
+    _, _, suspects = s.snapshot()
+    assert set(suspects["x"]) == {"a", "b"}
+    assert suspects["x"]["a"] == 1.0  # freshest per observer kept
+
+    # the suspect answered someone: a NEWER snapshot clears the suspicion
+    s.merge({"x": {"node_id": "x", "version": 3, "ts": time.time()}})
+    _, _, suspects = s.snapshot()
+    assert "x" not in suspects
+
+
+def test_store_prune_to_membership():
+    s = SyncerStore("w")
+    s.merge({"x": {"node_id": "x", "version": 1, "ts": 1.0}})
+    s.mark_dead("y", by="w")
+    s.mark_suspect("z", by="w")
+    s.local_update()
+    s.prune({"x"})
+    snaps, deaths, suspects = s.snapshot()
+    assert set(snaps) == {"w", "x"}  # own entry always kept
+    assert not deaths and not suspects
+
+
+# ---------------------------------------------------------------------------
+# transport
+# ---------------------------------------------------------------------------
+
+def test_frame_signature_rejects_tamper_and_wrong_key():
+    a, b = socket.socketpair()
+    try:
+        send_frame(a, AUTHKEY, {"type": "syncer_sync", "n": 1})
+        assert recv_frame(b, AUTHKEY)["n"] == 1
+
+        send_frame(a, b"wrong-key", {"type": "syncer_sync"})
+        with pytest.raises(OSError, match="authentication"):
+            recv_frame(b, AUTHKEY)
+    finally:
+        a.close()
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# in-process mesh (no cluster)
+# ---------------------------------------------------------------------------
+
+def _mesh(n, tick_s=0.05, **kw):
+    syncers = [
+        ResourceSyncer(f"m{i}", AUTHKEY, state_fn=lambda i=i: {"i": i},
+                       tick_s=tick_s, seed=i, **kw).start()
+        for i in range(n)
+    ]
+    directory = {s.node_id: s.addr for s in syncers}
+    for s in syncers:
+        s.set_peers(directory)
+    return syncers
+
+
+def _stop_all(syncers):
+    for s in syncers:
+        s.stop()
+
+
+def test_mesh_converges_to_full_view():
+    syncers = _mesh(8)
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            views = [set(s.store.snapshot()[0]) for s in syncers]
+            if all(len(v) == 8 for v in views):
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail(f"mesh never converged: {[len(v) for v in views]}")
+    finally:
+        _stop_all(syncers)
+
+
+def test_dead_peer_detected_by_refused_dials_and_rumor_gossips():
+    syncers = _mesh(4)
+    try:
+        victim = syncers[0]
+        deadline = time.time() + 20
+        while time.time() < deadline:  # converge first
+            if all(len(s.store.snapshot()[0]) == 4 for s in syncers):
+                break
+            time.sleep(0.05)
+        victim.stop()  # closes the listener: dials now get ECONNREFUSED
+        # first-observer-wins: the rumor spreads AND converges — every
+        # store ends with the single EARLIEST observation time (two
+        # observers may record a death within the same tick; gossip
+        # settles them onto the earlier one)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            deaths = [s.store.snapshot()[1] for s in syncers[1:]]
+            ts = {round(d["m0"]["ts"], 6) for d in deaths if "m0" in d}
+            if all("m0" in d for d in deaths) and len(ts) == 1:
+                break
+            time.sleep(0.05)
+        else:
+            pytest.fail(f"death rumor never converged: {deaths}")
+    finally:
+        _stop_all(syncers)
+
+
+# ---------------------------------------------------------------------------
+# real agent clusters (the mesh as deployed)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def mesh_cluster():
+    cluster = Cluster(initialize_head=True,
+                      head_node_args={"num_cpus": 1, "num_tpus": 0},
+                      real_processes=True)
+    yield cluster
+    cluster.shutdown()
+
+
+def _wait(pred, timeout, what):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def test_mesh_on_by_default_and_head_folds_reports(mesh_cluster):
+    """Agents register syncer listeners without any opt-in, and the head
+    folds their converged views (version-gated) — the mesh is the default
+    resource/health plane for emulated multi-node clusters."""
+    n1 = mesh_cluster.add_node(num_cpus=1, slice_id="sl-a")
+    n2 = mesh_cluster.add_node(num_cpus=1)
+    node = global_worker.node
+    with node.lock:
+        assert node.nodes[n1].syncer_addr is not None
+        assert node.nodes[n2].syncer_addr is not None
+        assert node.nodes[n1].slice_id == "sl-a"
+
+    _wait(lambda: set(node._syncer_versions) >= {n1, n2},
+          30, "mesh reports folding at the head")
+    v1 = node._syncer_versions[n1]
+    _wait(lambda: node._syncer_versions[n1] > v1,
+          30, "version advance (liveness through the mesh)")
+
+
+def test_sigstop_removed_by_suspect_quorum_before_pong_timeout(mesh_cluster):
+    """A paused host keeps its TCP sockets open, so only timeout paths can
+    see it.  Peer suspect quorum must beat the head's own 15s missed-pong
+    window — peer-observed death reaches the head faster."""
+    nodes = [mesh_cluster.add_node(num_cpus=1) for _ in range(3)]
+    node = global_worker.node
+    _wait(lambda: set(node._syncer_versions) >= set(nodes),
+          30, "mesh convergence before the pause")
+
+    victim = nodes[0]
+    pid = mesh_cluster.agents[victim].pid
+    os.kill(pid, signal.SIGSTOP)
+    t0 = time.time()
+    try:
+        _wait(lambda: not node.nodes[victim].alive, 13,
+              "suspect-quorum removal")
+        elapsed = time.time() - t0
+    finally:
+        os.kill(pid, signal.SIGCONT)
+    timeout_s = node.cfg.health_check_timeout_s
+    assert elapsed < timeout_s, (
+        f"removal took {elapsed:.1f}s — not faster than the "
+        f"{timeout_s:.0f}s heartbeat timeout path")
+    from ray_tpu.experimental.state import api as state
+
+    evs = state.list_events(limit=5000)
+    assert any(e.get("source") == "syncer"
+               and e.get("entity_id") == victim
+               and "unresponsive" in e.get("message", "")
+               for e in evs), "no syncer suspect/removal event at the head"
+
+
+def test_lossy_head_link_survives_via_peer_reports(mesh_cluster):
+    """Drop 100% of one agent's outbound control messages for longer than
+    the heartbeat timeout: its pongs and reports vanish, but its gossip
+    keeps flowing P2P, and its PEERS' reports carry its advancing
+    snapshots to the head — so the head keeps it alive.  Exactly the
+    'head is not the sole fan-in' claim."""
+    n1 = mesh_cluster.add_node(num_cpus=1)
+    n2 = mesh_cluster.add_node(num_cpus=1)
+    node = global_worker.node
+    _wait(lambda: set(node._syncer_versions) >= {n1, n2},
+          30, "mesh convergence before the drop")
+
+    old_timeout = node.cfg.health_check_timeout_s
+    node.cfg.health_check_timeout_s = 4.0
+    try:
+        from ray_tpu.devtools.chaos import ChaosMonkey
+
+        cm = ChaosMonkey(procs=mesh_cluster.agents)
+        cm.drop_messages(n1, frac=1.0, duration_s=10.0)
+        # ride out > 2x the (shrunk) timeout inside the drop window
+        time.sleep(9.0)
+        with node.lock:
+            assert node.nodes[n1].alive, (
+                "node died during the drop window — the mesh failed to "
+                "vouch for it")
+    finally:
+        node.cfg.health_check_timeout_s = old_timeout
+    # chaos injections are on the audit trail
+    from ray_tpu.experimental.state import api as state
+
+    evs = state.list_events(limit=5000)
+    assert any(e.get("source") == "chaos" and e.get("entity_id") == n1
+               for e in evs)
