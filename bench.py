@@ -28,18 +28,13 @@ N_WINDOWS = 3
 # N_WINDOWS windows and reports the best (steady-state, hiccup-free).
 BATCH = 6
 
-PEAK_BF16 = {
-    "v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
-    "v4": 275e12, "v5p": 459e12, "v6 lite": 918e12, "v6e": 918e12,
-}
-
-
-def peak_flops(device_kind: str) -> float:
-    kind = device_kind.lower()
-    for k, v in PEAK_BF16.items():
-        if k in kind:
-            return v
-    return 197e12
+# MFU arithmetic lives in ray_tpu.util.flops (shared with the live step
+# profiler — live per-step MFU and this end-of-run number must be the
+# same formula, or the doctor's mfu_regression rule compares apples to
+# oranges); re-exported here so external tooling reading bench.py keeps
+# working
+from ray_tpu.util.flops import PEAK_FLOPS_BF16 as PEAK_BF16  # noqa: E402
+from ray_tpu.util.flops import peak_flops  # noqa: E402,F401
 
 
 def train_loop(config=None):
@@ -84,15 +79,16 @@ def train_loop(config=None):
     dt = best_dt
     assert loss == loss, "NaN loss in benchmark"
 
+    from ray_tpu.util import flops as flops_mod
+
     n_params = gpt2.num_params(
         jax.eval_shape(lambda k: gpt2.init(cfg, k), jax.random.PRNGKey(0))
     )
     out = {
         "tokens_per_sec": B * T * n_steps / dt,
         "device_kind": jax.devices()[0].device_kind,
-        # 6ND matmuls + 12*L*D*T attention, fwd+bwd folded into constants;
-        # model FLOPs only (no remat credit)
-        "flops_per_token": 6 * n_params + 12 * cfg.n_layers * cfg.d_model * T,
+        # shared 6ND + 12*L*D*T model (util/flops.py); model FLOPs only
+        "flops_per_token": flops_mod.model_flops_per_token(cfg, n_params),
         "loss": loss,
         "done": True,
     }
@@ -185,6 +181,10 @@ def run_decode_bench(family: str = "gpt2") -> dict:
             out = self.engine.generate(prompt, self.n_new)
             return len(out), time.perf_counter() - t0
 
+        def perf(self):
+            return self.engine.perf_stats()
+
+    perf = {}
     try:
         llm = LLM.remote()
         n_new = ray_tpu.get(llm.warm.remote(), timeout=900)
@@ -195,12 +195,16 @@ def run_decode_bench(family: str = "gpt2") -> dict:
         t0 = time.perf_counter()
         outs = ray_tpu.get([llm.gen.remote(p) for p in prompts], timeout=1800)
         wall = time.perf_counter() - t0
+        try:
+            perf = ray_tpu.get(llm.perf.remote(), timeout=60)
+        except Exception:
+            perf = {}  # attribution is additive; never sink the row
     finally:
         ray_tpu.shutdown()  # a hung engine must not keep the chip claimed
     lats = sorted(dt for _, dt in outs)
     total_tokens = sum(n for n, _ in outs)
     prefix = "decode" if family == "gpt2" else f"decode_{family}"
-    return {
+    out = {
         f"{prefix}_tokens_per_sec": round(total_tokens / wall, 1),
         f"{prefix}_req_p50_ms": round(lats[len(lats) // 2] * 1e3, 1),
         f"{prefix}_req_p99_ms": round(
@@ -208,6 +212,24 @@ def run_decode_bench(family: str = "gpt2") -> dict:
         f"{prefix}_reqs": n_reqs,
         f"{prefix}_new_tokens_per_req": n_new,
     }
+    if perf:
+        # decode-tail attribution (serve/llm.py tick meter + TTFT/ITL
+        # reservoirs): the number ROADMAP item 3 acts on — how much of
+        # the decode-tick excess the co-scheduled prefills explain
+        ttft, itl = perf.get("ttft") or {}, perf.get("itl") or {}
+        out.update({
+            f"{prefix}_ttft_p50_ms": round((ttft.get("p50_s") or 0) * 1e3, 2),
+            f"{prefix}_ttft_p99_ms": round((ttft.get("p99_s") or 0) * 1e3, 2),
+            f"{prefix}_itl_p50_ms": round((itl.get("p50_s") or 0) * 1e3, 3),
+            f"{prefix}_itl_p99_ms": round((itl.get("p99_s") or 0) * 1e3, 3),
+            f"{prefix}_prefill_interference_frac":
+                perf.get("interference_frac", 0.0),
+            f"{prefix}_tick_excess_billed_to_prefill":
+                perf.get("excess_billed_to_prefill", 0.0),
+            f"{prefix}_interleaved_ticks":
+                (perf.get("ticks") or {}).get("interleaved", 0),
+        })
+    return out
 
 
 def _ingest_loop(config=None):
@@ -928,6 +950,197 @@ def run_metric_query_bench() -> dict:
     }}
 
 
+def _bench_model_setup():
+    """Shared model/step setup for the perf-observability rows: the same
+    gpt2 shape the headline row trains, with a compiled train step and a
+    synthetic batch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.models import gpt2
+    from ray_tpu.util import flops as flops_mod
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = gpt2.GPT2Config.gpt2_small() if on_tpu else gpt2.GPT2Config.tiny()
+    B = BATCH if on_tpu else 4
+    T = cfg.max_seq_len
+    optimizer = gpt2.make_optimizer(lr=3e-4)
+    state = jax.jit(lambda k: gpt2.init_state(cfg, k, optimizer))(
+        jax.random.PRNGKey(0))
+    train_step = jax.jit(gpt2.make_train_step(cfg, optimizer),
+                         donate_argnums=(0,))
+    rng = np.random.default_rng(0)
+    batch = {
+        "inputs": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (B, T), np.int32)),
+        "targets": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (B, T), np.int32)),
+    }
+    n_params = gpt2.num_params(
+        jax.eval_shape(lambda k: gpt2.init(cfg, k), jax.random.PRNGKey(0)))
+    fpt = flops_mod.model_flops_per_token(cfg, n_params)
+    return on_tpu, cfg, B, T, state, train_step, batch, fpt
+
+
+def run_step_phase_breakdown() -> dict:
+    """step_phase_breakdown row: the measured per-step phase split and
+    live MFU of the StepProfiler-instrumented train-step path, plus the
+    agreement between the live (per-step) MFU and the end-of-run bench
+    formula on the SAME run — the baseline artifact the MFU-plateau work
+    acts on.  Phases must sum exactly to the profiled step wall."""
+    import time
+
+    import jax
+
+    from ray_tpu.util import flops as flops_mod
+    from ray_tpu.util.perf import StepProfiler
+
+    on_tpu, cfg, B, T, state, train_step, batch, fpt = _bench_model_setup()
+    prof = StepProfiler(flops_per_token=fpt, tokens_per_step=B * T)
+    step_fn = prof.wrap_jit(train_step, name="train_step")
+    # warmup/compile OUTSIDE the profiled window (bench measures steady
+    # state; the compile still lands in the compile table)
+    for _ in range(3):
+        state, metrics = step_fn(state, batch)
+    float(metrics["loss"])
+    n_steps = N_STEPS if on_tpu else 6
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        with prof.step():
+            state, metrics = step_fn(state, batch)
+            with prof.phase("compute"):
+                loss = float(metrics["loss"])  # per-step device sync
+    wall = time.perf_counter() - t0
+    assert loss == loss, "NaN loss in step_phase_breakdown"
+    device_kind = jax.devices()[0].device_kind
+    bench_mfu = flops_mod.mfu(B * T * n_steps / wall, fpt, device_kind)
+    summary = prof.summary()
+    live_mfu = summary["mfu"]["mean"]
+    phase_sum = sum(p["s"] for p in summary["phases"].values())
+    agreement = live_mfu / bench_mfu if bench_mfu else float("nan")
+    return {"step_phase_breakdown": {
+        "steps": summary["steps"],
+        "device": device_kind,
+        "phases_s": {k: p["s"] for k, p in summary["phases"].items()},
+        "phase_fracs": {k: p["frac"] for k, p in summary["phases"].items()},
+        "phase_sum_equals_wall":
+            abs(phase_sum - summary["wall_s"]) < 1e-6,
+        "live_mfu": round(live_mfu, 4) if live_mfu is not None else None,
+        "bench_mfu": round(bench_mfu, 4),
+        "mfu_agreement": round(agreement, 4),
+        "agrees_within_5pct": abs(1.0 - agreement) <= 0.05,
+        "compiles": summary["compiles"],
+        "hbm": summary["hbm"],
+    }}
+
+
+def run_perf_observability_overhead() -> dict:
+    """perf_observability_overhead row: the instrumentation's cost on
+    the two hot paths it rides, measured DIRECTLY (PR 4/5 style — window
+    A/B noise on a busy box swamps sub-percent effects):
+
+    - train step: an instrumented no-op loop (step scope + one phase
+      scope + a wrapped-jit cache hit) minus the same loop bare, against
+      the real measured train-step wall;
+    - decode tick: the tick meter's ``record()`` body against the real
+      measured engine tick wall.
+
+    Gate: < 1%% on both."""
+    import statistics
+    import time
+
+    import jax.numpy as jnp
+
+    from ray_tpu.serve.llm import GenerationEngine, _TickMeter, make_config
+    from ray_tpu.util.perf import StepProfiler
+
+    on_tpu, cfg, B, T, state, train_step, batch, fpt = _bench_model_setup()
+    for _ in range(3):
+        state, metrics = train_step(state, batch)
+    float(metrics["loss"])
+    walls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(5):
+            state, metrics = train_step(state, batch)
+        float(metrics["loss"])
+        walls.append((time.perf_counter() - t0) / 5)
+    step_wall_s = statistics.median(walls)
+
+    import jax
+
+    # DEFAULT config (hbm_every=1): the gate must cover what
+    # jax_utils.step_profiler installs for users, per-step device-memory
+    # sample included
+    prof = StepProfiler(flops_per_token=fpt, tokens_per_step=B * T)
+    tiny = jax.jit(lambda x: x + 1)
+    z = jnp.zeros(())
+    tiny(z)  # compile once: the probe measures the HIT path
+    wrapped = prof.wrap_jit(tiny, name="overhead_probe")
+    N = 2000
+
+    def probe(instrumented: bool) -> float:
+        t0 = time.perf_counter()
+        if instrumented:
+            for _ in range(N):
+                with prof.step():
+                    with prof.phase("ingest"):
+                        pass
+                    wrapped(z)
+        else:
+            for _ in range(N):
+                tiny(z)
+        return (time.perf_counter() - t0) / N
+
+    # order-alternating pairs: the jit-dispatch baseline drifts with
+    # allocator state, and the probe subtracts it
+    costs = []
+    for i in range(6):
+        order = [True, False] if i % 2 == 0 else [False, True]
+        res = {}
+        for v in order:
+            res[v] = probe(v)
+        costs.append(res[True] - res[False])
+    step_cost_s = max(0.0, statistics.median(costs))
+    step_pct = 100.0 * step_cost_s / step_wall_s
+
+    # decode tick: real tick wall from a short engine run, meter cost
+    # timed directly
+    engine = GenerationEngine(
+        make_config("gpt2", "small" if on_tpu else "tiny"),
+        n_slots=4, max_new_tokens=32 if on_tpu else 8,
+        decode_chunk_steps=8 if on_tpu else 4,
+        prefill_buckets=(32,)).start()
+    try:
+        engine.generate([1, 2, 3], 8)
+        futs = [engine.submit([1, 2, 3, 4], None) for _ in range(8)]
+        for f in futs:
+            f.result(timeout=300)
+    finally:
+        engine.stop()
+    ticks = engine._ticks
+    n_ticks = sum(ticks.ticks.values())
+    tick_wall_s = (sum(ticks.tick_s.values()) / n_ticks) if n_ticks else 0.0
+    meter = _TickMeter("overhead-probe")
+    M = 20000
+    t0 = time.perf_counter()
+    for i in range(M):
+        meter.record(0.01, 0.001 if i % 3 == 0 else 0.0, i % 3, 3)
+    meter_cost_s = (time.perf_counter() - t0) / M
+    tick_pct = (100.0 * meter_cost_s / tick_wall_s) if tick_wall_s else 0.0
+
+    return {"perf_observability_overhead": {
+        "train_step_wall_ms": round(step_wall_s * 1e3, 3),
+        "step_instrumentation_us": round(step_cost_s * 1e6, 2),
+        "train_step_overhead_pct": round(step_pct, 4),
+        "decode_tick_wall_ms": round(tick_wall_s * 1e3, 3),
+        "tick_meter_us": round(meter_cost_s * 1e6, 3),
+        "decode_tick_overhead_pct": round(tick_pct, 4),
+        "overhead_ok": step_pct < 1.0 and tick_pct < 1.0,
+    }}
+
+
 def run_observability_overhead() -> dict:
     """observability_overhead row: task throughput with events+metrics
     enabled vs disabled (median of 10 order-alternating paired windows).
@@ -1219,6 +1432,16 @@ def main() -> None:
     except Exception as e:
         decode_out["metric_query_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
+        decode_out.update(run_step_phase_breakdown())
+    except Exception as e:
+        decode_out["step_phase_breakdown_error"] = \
+            f"{type(e).__name__}: {e}"[:200]
+    try:
+        decode_out.update(run_perf_observability_overhead())
+    except Exception as e:
+        decode_out["perf_observability_error"] = \
+            f"{type(e).__name__}: {e}"[:200]
+    try:
         decode_out.update(run_raylint_bench())
     except Exception as e:
         decode_out["raylint_error"] = f"{type(e).__name__}: {e}"[:200]
@@ -1231,9 +1454,12 @@ def main() -> None:
     except Exception as e:
         decode_out["slice_recovery_error"] = f"{type(e).__name__}: {e}"[:200]
 
+    from ray_tpu.util import flops as flops_mod
+
     tps = trainer_out["tokens_per_sec"]
     raw_tps = raw_out["tokens_per_sec"]
-    mfu = tps * trainer_out["flops_per_token"] / peak_flops(trainer_out["device_kind"])
+    mfu = flops_mod.mfu(tps, trainer_out["flops_per_token"],
+                        trainer_out["device_kind"])
     overhead_pct = (raw_tps - tps) / raw_tps * 100.0
 
     print(json.dumps({

@@ -43,6 +43,10 @@ KNOWN_SOURCES = (
     # (autoscaler/policy.py) — doctor and the timeline correlate cause
     # (chaos) with symptom (syncer/node) and remedy (autoscaler)
     "syncer", "chaos", "autoscaler",
+    # device-time performance attribution (util/perf.py + serve/llm.py):
+    # step-phase spans, jit compile events, prefill-interference meters
+    # — what `ray_tpu perf` and the doctor's perf rules read
+    "perf",
 )
 
 # Kill switch for the whole observability layer (events + hot-path metric

@@ -1449,6 +1449,10 @@ class Node:
         elif mtype == "top_snapshot":
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
                                "value": self._top_snapshot()})
+        elif mtype == "perf_summary":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self._perf_summary(
+                                   window_s=msg.get("window_s", 1800.0))})
         elif mtype == "events_report":
             self.events.add(msg["origin"], msg["events"])
             self.traces.add(msg["origin"], msg["events"])
@@ -4101,6 +4105,189 @@ class Node:
             "total_pinned_bytes": audit["total_bytes"],
             "orphan_bytes": audit["orphan_bytes"],
             "tsdb": self.tsdb.stats(),
+            # device-memory watermark rows (util/perf.py gauges pushed
+            # by train workers / serve engines; host-RSS kind on CPU)
+            "hbm": self._hbm_rows(),
+        }
+
+    def _merged_metrics_snapshot(self) -> dict:
+        """Head registry + worker-pushed registries, one snapshot (the
+        dashboard's /metrics merge, reused by perf/top aggregation)."""
+        from ray_tpu.util import metrics as metrics_mod
+
+        return metrics_mod.merge_snapshots(
+            metrics_mod.registry().snapshot(),
+            self.worker_metrics_registry.snapshot())
+
+    def _hbm_rows(self, merged: Optional[dict] = None) -> List[dict]:
+        """Device-memory gauge rows from the merged registry: one row
+        per (device, kind, origin) with in-use/limit/peak joined."""
+        if merged is None:
+            merged = self._merged_metrics_snapshot()
+        rows: Dict[tuple, dict] = {}
+        for name, field in (("ray_tpu_hbm_bytes_in_use", "bytes_in_use"),
+                            ("ray_tpu_hbm_bytes_limit", "bytes_limit"),
+                            ("ray_tpu_hbm_peak_bytes_in_use",
+                             "peak_bytes_in_use")):
+            m = merged.get(name)
+            if not m:
+                continue
+            for key, v in m.get("values", {}).items():
+                if not isinstance(v, (int, float)):
+                    continue
+                row = rows.setdefault(tuple(key), {"tags": dict(key)})
+                row[field] = v
+        return [rows[k] for k in sorted(rows)]
+
+    @staticmethod
+    def _merged_histogram_summary(merged: dict, name: str) -> Optional[dict]:
+        """Count/mean + bucket-estimated p50/p99 for one merged-registry
+        histogram, label series with identical bounds folded together
+        (percentiles from cumulative bucket edges — coarse but honest:
+        the estimate is an upper bound at bucket resolution, and a
+        percentile whose mass lands in the +inf overflow bucket reports
+        None rather than clamping to the last bound, which would be a
+        FALSE upper bound on exactly the tail this layer explains;
+        ``last_bound`` lets renderers say "> last_bound")."""
+        m = merged.get(name)
+        if not m or m.get("type") != "histogram":
+            return None
+        bounds: Optional[list] = None
+        agg: Optional[list] = None
+        total = 0
+        total_sum = 0.0
+        for v in m.get("values", {}).values():
+            if not isinstance(v, dict):
+                continue
+            b = list(v.get("buckets") or [])
+            vb = list(v.get("bounds") or [])
+            if bounds is None:
+                bounds, agg = vb, [0] * len(b)
+            if vb != bounds or len(b) != len(agg):
+                continue  # foreign bounds: skip rather than mis-fold
+            agg = [a + x for a, x in zip(agg, b)]
+            total += int(v.get("count") or 0)
+            total_sum += float(v.get("sum") or 0.0)
+        if not total or not bounds:
+            return None
+
+        def pct(q: float):
+            target = q * total
+            acc = 0
+            for i, c in enumerate(agg):
+                acc += c
+                if acc >= target:
+                    return bounds[i] if i < len(bounds) else None
+            return None
+
+        return {"count": total, "mean_s": round(total_sum / total, 6),
+                "p50_est_s": pct(0.5), "p99_est_s": pct(0.99),
+                "last_bound_s": bounds[-1]}
+
+    def _perf_summary(self, window_s: float = 1800.0) -> dict:
+        """Head-side aggregate behind ``ray_tpu perf`` / ``/api/perf``:
+        the step-phase breakdown + compile table folded from the
+        ``perf`` event source (cluster table + the head's own ring), the
+        MFU trend from the TSDB, HBM watermarks and decode TTFT/ITL
+        histograms from the merged registry, and each serve engine's
+        latest prefill-interference meter state."""
+        from ray_tpu.util import tsdb as tsdb_mod
+
+        rows = self._list_state("events", 100_000, {"source": "perf"})
+        steps = 0
+        wall = 0.0
+        tokens = 0
+        phase_totals: Dict[str, float] = {}
+        last_mfu: Dict[str, float] = {}
+        compiles: Dict[tuple, dict] = {}
+        interference: Dict[str, dict] = {}
+        for r in rows:
+            d = r.get("data") or {}
+            msg = r.get("message")
+            if msg == "step phases":
+                steps += 1
+                wall += float(d.get("wall_s") or r.get("span_dur") or 0.0)
+                tokens += int(d.get("tokens") or 0)
+                for k, v in (d.get("phases") or {}).items():
+                    phase_totals[k] = phase_totals.get(k, 0.0) + float(v)
+                if d.get("mfu") is not None:
+                    # origin-qualified: two gangs both have a rank0, and
+                    # bare entity ids would show one job's MFU as the
+                    # other's
+                    who = (f"{r.get('origin') or 'head'}:"
+                           f"{r.get('entity_id')}")
+                    last_mfu[who] = float(d["mfu"])
+            elif msg == "jit compile":
+                key = (str(r.get("origin") or "head"), str(d.get("fn", "?")))
+                e = compiles.setdefault(key, {
+                    "origin": key[0], "fn": key[1], "compiles": 0,
+                    "compile_s": 0.0, "n_sigs": 0, "hits": 0, "misses": 0})
+                e["compiles"] += 1
+                e["compile_s"] += float(r.get("span_dur") or 0.0)
+                # hits/misses/n_sigs ride every compile event cumulatively
+                e["n_sigs"] = max(e["n_sigs"], int(d.get("n_sigs") or 0))
+                e["hits"] = max(e["hits"], int(d.get("hits") or 0))
+                e["misses"] = max(e["misses"], int(d.get("misses") or 0))
+            elif msg == "prefill interference":
+                eid = f"{r.get('origin') or 'head'}:{r.get('entity_id')}"
+                prev = interference.get(eid)
+                if prev is None or float(r.get("ts") or 0.0) >= float(
+                        prev.get("ts") or 0.0):
+                    interference[eid] = r
+        merged = self._merged_metrics_snapshot()
+
+        def counter_by_origin_fn(name: str) -> Dict[tuple, float]:
+            out: Dict[tuple, float] = {}
+            for key, v in (merged.get(name) or {}).get("values",
+                                                       {}).items():
+                if isinstance(v, (int, float)):
+                    d = dict(key)
+                    out[(d.get("origin", "head"), d.get("fn", "?"))] = v
+            return out
+
+        # hit/miss counts ride compile EVENTS only at compile time — a
+        # steady-state fn that compiled once then served 100k hits would
+        # read hits≈0 forever off events alone.  The live registry
+        # counters keep counting, so they win where present.
+        live_hits = counter_by_origin_fn("ray_tpu_jit_cache_hits_total")
+        live_misses = counter_by_origin_fn("ray_tpu_jit_cache_misses_total")
+        for key, e in compiles.items():
+            if key in live_hits:
+                e["hits"] = int(live_hits[key])
+            if key in live_misses:
+                e["misses"] = int(live_misses[key])
+        mfu_series: List[dict] = []
+        if tsdb_mod.ENABLED:
+            try:
+                mfu_series = self.tsdb.query(
+                    "ray_tpu_train_step_mfu",
+                    window_s=window_s).get("series", [])
+            except Exception:
+                mfu_series = []
+        phases_out = {
+            k: {"s": round(v, 6),
+                "frac": round(v / wall, 4) if wall > 0 else 0.0}
+            for k, v in sorted(phase_totals.items(), key=lambda kv: -kv[1])}
+        for e in compiles.values():
+            e["compile_s"] = round(e["compile_s"], 6)
+        return {
+            "ts": time.time(),
+            "window_s": window_s,
+            "steps": {"count": steps, "wall_s": round(wall, 6),
+                      "tokens": tokens, "phases": phases_out,
+                      "last_mfu": last_mfu},
+            "mfu_trend": mfu_series,
+            "compiles": sorted(compiles.values(),
+                               key=lambda e: -e["compile_s"]),
+            "hbm": self._hbm_rows(merged),
+            "decode": {
+                "ttft": self._merged_histogram_summary(
+                    merged, "ray_tpu_llm_ttft_s"),
+                "itl": self._merged_histogram_summary(
+                    merged, "ray_tpu_llm_itl_s"),
+                "interference": {eid: dict(r.get("data") or {})
+                                 for eid, r in sorted(interference.items())},
+            },
         }
 
     def _state_snapshot(self) -> dict:

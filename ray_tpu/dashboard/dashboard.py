@@ -90,6 +90,7 @@ _INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
  /api/metrics/query?name=&amp;window=&amp;step=,
  <a href="/api/memory">/api/memory</a> (ownership audit),
  <a href="/api/top">/api/top</a>,
+ <a href="/api/perf">/api/perf</a> (step phases/MFU/compiles/HBM),
  /api/grafana_dashboard,
  /api/profile?duration=3[&amp;worker_id=][&amp;format=collapsed], /metrics</div>
 <script>
@@ -354,6 +355,14 @@ class Dashboard:
             return
         if path == "/api/top":
             self._send(req, json.dumps(_jsonable(self.node._top_snapshot())))
+            return
+        if path == "/api/perf":
+            # performance observability aggregate (`ray_tpu perf` over
+            # HTTP): step-phase breakdown, MFU trend, compile table,
+            # HBM watermark, decode TTFT/ITL + prefill interference
+            window = float(qs.get("window", ["1800"])[0])
+            self._send(req, json.dumps(_jsonable(
+                self.node._perf_summary(window_s=window))))
             return
         if path.startswith("/api/logs/"):
             # tail one log stream as plain text (reference log viewer:
@@ -697,13 +706,11 @@ class Dashboard:
         """Head registry + worker-reported metrics, with runtime gauges
         refreshed at scrape time (metric_defs.cc analog).  The gauge
         refresh lives on the Node so the TSDB sample loop and this scrape
-        path can never disagree about what the runtime gauges mean."""
-        node = self.node
-        node.refresh_runtime_gauges()
-        return metrics_mod.merge_snapshots(
-            metrics_mod.registry().snapshot(),
-            node.worker_metrics_registry.snapshot(),
-        )
+        path can never disagree about what the runtime gauges mean; the
+        merge itself is the Node's too (`_merged_metrics_snapshot` — one
+        merge path for /metrics, perf_summary, and top)."""
+        self.node.refresh_runtime_gauges()
+        return self.node._merged_metrics_snapshot()
 
     def _metrics_text(self) -> str:
         return metrics_mod.prometheus_text(self._merged_snapshot())

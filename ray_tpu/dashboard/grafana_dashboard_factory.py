@@ -37,6 +37,15 @@ CORE_METRICS: Dict[str, tuple] = {
     "ray_tpu_llm_slot_admission_latency_s": ("histogram", "decode-slot admission latency"),
     "ray_tpu_train_step_time_s": ("histogram", "train step time"),
     "ray_tpu_data_ingest_wait_s_total": ("counter", "train ingest-wait seconds"),
+    # perf observability (util/perf.py + serve/llm.py decode attribution)
+    "ray_tpu_train_phase_seconds": ("histogram", "step-phase wall seconds"),
+    "ray_tpu_train_step_mfu": ("gauge", "live per-step MFU"),
+    "ray_tpu_jit_cache_misses_total": ("counter", "jit compiles (cache misses)"),
+    "ray_tpu_hbm_bytes_in_use": ("gauge", "device memory in use"),
+    "ray_tpu_llm_ttft_s": ("histogram", "LLM time-to-first-token"),
+    "ray_tpu_llm_itl_s": ("histogram", "LLM inter-token latency"),
+    "ray_tpu_llm_prefill_interference_s_total":
+        ("counter", "decode-tick seconds billed to prefill"),
 }
 
 _PANEL_W = 12  # two panels per 24-unit grafana row

@@ -192,5 +192,17 @@ def memory_summary(limit: int = 200) -> dict:
 
 def top_snapshot() -> dict:
     """One frame of ``ray_tpu top``: nodes with host stats, workers with
-    sampled RSS/CPU/fds and pinned bytes, task-state and store summaries."""
+    sampled RSS/CPU/fds and pinned bytes, task-state and store summaries,
+    and device-memory (HBM) watermark rows."""
     return _client().request({"type": "top_snapshot"})["value"]
+
+
+def perf_summary(window_s: float = 1800.0) -> dict:
+    """Performance-observability aggregate (``ray_tpu perf`` backend):
+    the step-phase breakdown (phases sum exactly to profiled step wall),
+    per-rank live MFU + the TSDB MFU trend over the trailing window, the
+    jit compile-cache table per shape signature, HBM watermarks, and the
+    decode attribution block (TTFT/ITL histograms, per-engine
+    prefill-interference meters)."""
+    return _client().request(
+        {"type": "perf_summary", "window_s": window_s})["value"]

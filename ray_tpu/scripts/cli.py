@@ -18,6 +18,7 @@ Reference: ``python/ray/scripts/scripts.py`` (cluster lifecycle) and
     top [--interval S --iterations N --sort K] live nodes/workers resource view
     memory [--limit N --json]                  object-ownership audit (`ray memory`)
     metrics [NAME] [--window S --step S]       TSDB directory / time-series query
+    perf [--window S --json]                   step-phase breakdown, MFU, compiles, HBM
     profile [--duration N --worker-id HEX]     sampling profile via the dashboard
     serve-status                               serve deployments + autoscaling
     lint [--rule R4 --json --update-baseline]  raylint static-analysis gate
@@ -331,6 +332,25 @@ def _fmt_bytes(n) -> str:
     return f"{n:.1f}TiB"
 
 
+def _render_hbm_rows(hbm) -> list:
+    """Device-memory watermark table lines (shared by ``top`` and
+    ``perf`` — one formatter, so the two surfaces can never disagree)."""
+    out = [f"{'DEVICE MEMORY':<30} {'IN-USE':>10} {'LIMIT':>10} "
+           f"{'PEAK':>10}"]
+    for row in hbm:
+        t = row.get("tags", {})
+        label = (f"{t.get('kind', '?')}/dev{t.get('device', '?')} "
+                 f"@{t.get('origin', 'head')}")
+        limit = row.get("bytes_limit")
+        peak = row.get("peak_bytes_in_use")
+        out.append(
+            f"{label[:29]:<30} "
+            f"{_fmt_bytes(row.get('bytes_in_use')):>10} "
+            f"{_fmt_bytes(limit) if limit is not None else '-':>10} "
+            f"{_fmt_bytes(peak) if peak is not None else '-':>10}")
+    return out
+
+
 def _render_top(snap: dict, sort: str) -> str:
     """One ``top`` frame as text (htop-style, data from the head's
     per-entity sampler + ownership audit)."""
@@ -374,6 +394,10 @@ def _render_top(snap: dict, sort: str) -> str:
             f"{f'{rss:.0f}MB' if rss is not None else '-':>9} "
             f"{int(w['open_fds']) if w.get('open_fds') is not None else '-':>5} "
             f"{_fmt_bytes(w.get('pinned_bytes')):>10}")
+    hbm = snap.get("hbm") or []
+    if hbm:
+        out.append("")
+        out.extend(_render_hbm_rows(hbm))
     owners = snap.get("owners") or []
     if owners:
         out.append("")
@@ -483,6 +507,96 @@ def cmd_metrics(args) -> None:
     result = state.query_metric(args.name, window_s=args.window,
                                 step_s=args.step, agg=args.agg)
     print(json.dumps(result, indent=2))
+
+
+def cmd_perf(args) -> None:
+    """Performance observability report: the step-phase breakdown
+    (phases sum exactly to the profiled step wall), live MFU per rank +
+    the TSDB trend, the jit compile-cache table, the HBM watermark, and
+    decode attribution (TTFT/ITL + prefill interference)."""
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    s = state.perf_summary(window_s=args.window)
+    if args.json:
+        print(json.dumps(s, indent=2, default=repr))
+        return
+    st = s["steps"]
+    out = [f"ray_tpu perf — {st['count']} profiled steps, "
+           f"wall {st['wall_s']:.3f}s, {st['tokens']} tokens"]
+    if st["phases"]:
+        out.append("")
+        out.append(f"{'PHASE':<12} {'SECONDS':>10} {'SHARE':>7}")
+        for name, p in st["phases"].items():
+            out.append(f"{name:<12} {p['s']:>10.4f} {p['frac'] * 100:>6.1f}%")
+        total = sum(p["s"] for p in st["phases"].values())
+        out.append(f"{'total':<12} {total:>10.4f} {'100.0%':>7}"
+                   f"  (phases sum to measured step wall)")
+    if st["last_mfu"]:
+        mfus = ", ".join(f"{k}={v:.4f}"
+                         for k, v in sorted(st["last_mfu"].items()))
+        out.append("")
+        out.append(f"live MFU: {mfus}")
+    for series in (s.get("mfu_trend") or [])[:4]:
+        pts = series.get("points") or []
+        if pts:
+            out.append(f"  trend {series.get('tags', {})}: {pts[0][1]:.4f} "
+                       f"-> {pts[-1][1]:.4f} over {len(pts)} samples")
+    comp = s.get("compiles") or []
+    if comp:
+        out.append("")
+        out.append(f"{'JIT FN':<24} {'ORIGIN':<10} {'COMPILES':>8} "
+                   f"{'SIGS':>5} {'HITS':>8} {'COMPILE-S':>10}")
+        for e in comp[:12]:
+            out.append(f"{e['fn'][:23]:<24} {e['origin'][:9]:<10} "
+                       f"{e['compiles']:>8} {e['n_sigs']:>5} "
+                       f"{e['hits']:>8} {e['compile_s']:>10.3f}")
+    hbm = s.get("hbm") or []
+    if hbm:
+        out.append("")
+        out.extend(_render_hbm_rows(hbm))
+
+    def _pct(h, key, digits):
+        # a percentile whose mass fell in the +inf overflow bucket has
+        # no honest upper bound — render "> last_bound" instead
+        v = h.get(key)
+        if v is not None:
+            return f"<={v * 1e3:.{digits}f}ms"
+        return f">{(h.get('last_bound_s') or 0) * 1e3:.{digits}f}ms"
+
+    dec = s.get("decode") or {}
+    ttft, itl = dec.get("ttft"), dec.get("itl")
+    interference = dec.get("interference") or {}
+    if ttft or itl or interference:
+        out.append("")
+        out.append("decode attribution:")
+        if ttft:
+            out.append(
+                f"  TTFT: {ttft['count']} samples, "
+                f"mean {ttft['mean_s'] * 1e3:.1f}ms, "
+                f"p50{_pct(ttft, 'p50_est_s', 1)} "
+                f"p99{_pct(ttft, 'p99_est_s', 1)}")
+        if itl:
+            out.append(
+                f"  ITL:  {itl['count']} samples, "
+                f"mean {itl['mean_s'] * 1e3:.2f}ms, "
+                f"p50{_pct(itl, 'p50_est_s', 2)} "
+                f"p99{_pct(itl, 'p99_est_s', 2)}")
+        for eid, m in interference.items():
+            billed = m.get("excess_billed_to_prefill")
+            billed_s = (f"{billed * 100:.0f}% of tick excess billed to "
+                        f"prefill" if billed is not None
+                        else "excess share n/a: no decode-only baseline")
+            out.append(
+                f"  {eid}: interference {m.get('interference_s', 0):.3f}s "
+                f"({(m.get('interference_frac') or 0) * 100:.1f}% of "
+                f"decode tick time; {billed_s}) over "
+                f"{m.get('interleaved_ticks')} interleaved ticks")
+    if not (st["count"] or comp or hbm or ttft or itl or interference):
+        out.append("(no perf data recorded — run a StepProfiler-"
+                   "instrumented train loop or serve LLM traffic; see "
+                   "README 'Performance observability')")
+    print("\n".join(out))
 
 
 def cmd_profile(args) -> None:
@@ -644,7 +758,8 @@ def main(argv=None) -> None:
     s.add_argument("--source", default=None,
                    help="filter: scheduler|object_store|streaming|serve|"
                         "train|actor|worker_pool|node|collective|"
-                        "serve_llm|compiled_dag|trace")
+                        "serve_llm|compiled_dag|trace|syncer|chaos|"
+                        "autoscaler|perf")
     s.add_argument("--severity", default=None,
                    help="filter: DEBUG|INFO|WARNING|ERROR")
     s.add_argument("--limit", type=int, default=200)
@@ -727,6 +842,16 @@ def main(argv=None) -> None:
     s.add_argument("--agg", choices=["last", "max", "min", "sum", "avg",
                                      "count"], default=None)
     s.set_defaults(fn=cmd_metrics)
+
+    s = sub.add_parser(
+        "perf",
+        help="performance observability: step-phase breakdown, live "
+             "MFU + trend, compile-cache table, HBM watermark, decode "
+             "TTFT/ITL + prefill interference")
+    s.add_argument("--window", type=float, default=1800.0,
+                   help="MFU-trend window seconds (TSDB query)")
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_perf)
 
     s = sub.add_parser(
         "profile", help="sampling profile of the head or a worker")

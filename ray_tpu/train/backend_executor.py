@@ -68,6 +68,35 @@ class BackendExecutor:
 
         return self.worker_group.execute(node_of_self)
 
+    def perf_summaries(self) -> List[Optional[dict]]:
+        """Per-rank step-profiler summaries (None for ranks whose train
+        fn never installed one): phase totals, live MFU, compile table,
+        last HBM sample — the device-time attribution artifact collected
+        off the gang after (or during) a run.  Also emits one ``perf``
+        flight-recorder event with the gang-level aggregate so the
+        doctor and the timeline see a run's final numbers even when
+        nobody polls the executor."""
+        if self.worker_group is None:
+            return []
+
+        def _local():
+            from ray_tpu.util import perf as _perf
+
+            return _perf.local_summary()
+
+        summaries = self.worker_group.execute(_local)
+        ranks = [s for s in summaries if s]
+        if ranks:
+            mfus = [s["mfu"]["mean"] for s in ranks
+                    if (s.get("mfu") or {}).get("mean") is not None]
+            _events.emit(
+                "perf", "gang perf summary", severity="INFO",
+                world_size=len(summaries),
+                profiled_ranks=len(ranks),
+                steps=sum(s.get("steps", 0) for s in ranks),
+                mean_mfu=round(sum(mfus) / len(mfus), 5) if mfus else None)
+        return summaries
+
     def start_training(
         self,
         train_fn: Callable,

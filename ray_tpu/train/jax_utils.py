@@ -21,18 +21,59 @@ from ray_tpu.air import session
 
 
 def allreduce_grads(grads: Any, group_name: Optional[str] = None) -> Any:
-    """Mean-all-reduce a grad pytree across the training gang (one round)."""
+    """Mean-all-reduce a grad pytree across the training gang (one round).
+
+    When the hosting process has an active :class:`~ray_tpu.util.perf
+    .StepProfiler` with a step open, the collective round bills to the
+    ``collective`` phase of that step — the gang's sync share shows up
+    in the step-phase breakdown without the train fn instrumenting
+    anything itself."""
     import jax
     from jax.flatten_util import ravel_pytree
 
     from ray_tpu.util import collective
+    from ray_tpu.util import perf as _perf
 
+    import contextlib
     import os
 
     group = group_name or os.environ.get("RAY_TRAIN_COLLECTIVE_GROUP", "default")
     flat, unravel = ravel_pytree(grads)
-    summed = collective.allreduce(np.asarray(flat), group_name=group, op="mean")
+    prof = _perf.active_profiler()
+    scope = prof.phase("collective") if prof is not None \
+        else contextlib.nullcontext()
+    with scope:
+        summed = collective.allreduce(
+            np.asarray(flat), group_name=group, op="mean")
     return unravel(jax.numpy.asarray(summed))
+
+
+def step_profiler(*, cfg: Any = None, n_params: Optional[int] = None,
+                  tokens_per_step: Optional[int] = None,
+                  rank: Optional[int] = None, **kwargs):
+    """Build + install a :class:`~ray_tpu.util.perf.StepProfiler` for
+    this train worker, with the FLOPs model derived from a model config
+    (``util/flops.py`` — the same arithmetic bench.py uses, so live MFU
+    and bench MFU agree by construction)::
+
+        prof = jax_utils.step_profiler(cfg=cfg, n_params=n_params,
+                                       tokens_per_step=B * T)
+        train_step = prof.wrap_jit(train_step)
+        for ...:
+            with prof.step():
+                ...
+    """
+    from ray_tpu.util import flops as flops_mod
+    from ray_tpu.util import perf as _perf
+
+    fpt = kwargs.pop("flops_per_token", None)
+    if fpt is None and cfg is not None and n_params is not None:
+        fpt = flops_mod.model_flops_per_token(cfg, n_params)
+    if rank is None:
+        rank = session.get_world_rank()
+    return _perf.StepProfiler(
+        flops_per_token=fpt, tokens_per_step=tokens_per_step,
+        rank=rank, **kwargs).install()
 
 
 def shard_batch(batch: Any, *, rank: Optional[int] = None, world_size: Optional[int] = None) -> Any:

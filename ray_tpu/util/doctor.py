@@ -50,6 +50,18 @@ STORE_GROWTH_MIN_MB = 64.0
 QUEUE_CLIMB_MIN_DEPTH = 1.0  # queue never drained below this AND
 QUEUE_CLIMB_RATIO = 2.0      # ended >= this multiple of where it started
 
+# -- perf-rule thresholds (over the util/perf.py step profiler's and
+# serve/llm.py tick meter's `perf` events — signals only device-time
+# attribution can express) ---------------------------------------------------
+RECOMPILE_STORM_SIGS = 5     # distinct shape signatures for ONE jit fn
+                             # (multi-bucket prefill legitimately holds 4)
+INGEST_FRACTION = 0.30       # ingest-wait share of step wall to flag
+INGEST_MIN_STEPS = 5         # profiled steps before the share is trusted
+PREFILL_INTERFERENCE_FRAC = 0.20  # interference share of decode tick time
+PREFILL_MIN_TICKS = 20       # interleaved ticks before the share is trusted
+MFU_DROP_FRAC = 0.10         # trailing-window MFU drop vs the earlier mean
+MFU_MIN_LEVEL = 0.02         # earlier-mean floor (CPU dev noise guard)
+
 
 def _finding(rule: str, severity: str, summary: str,
              evidence: Sequence[dict], remedy: str) -> dict:
@@ -309,6 +321,108 @@ def _rule_slice_degraded(events, tasks):
         "replacement cannot restore the gang lease")
 
 
+def _rule_recompile_storm(events, tasks):
+    """One jit function accumulating many distinct shape signatures is a
+    recompile storm: every new shape pays seconds of XLA compile on the
+    hot path (the classic cause: un-bucketed dynamic batch/sequence
+    shapes).  The step profiler's compile events carry ``n_sigs`` per
+    function, so the storm is a counter, not a guess."""
+    rows = _rows(events, "perf", "jit compile")
+    worst: Dict[str, dict] = {}
+    for r in rows:
+        d = r.get("data") or {}
+        fn = str(d.get("fn", "?"))
+        if fn not in worst or (d.get("n_sigs") or 0) > (
+                (worst[fn].get("data") or {}).get("n_sigs") or 0):
+            worst[fn] = r
+    storms = [r for r in worst.values()
+              if ((r.get("data") or {}).get("n_sigs") or 0)
+              >= RECOMPILE_STORM_SIGS]
+    if not storms:
+        return None
+    names = ", ".join(
+        f"{(r.get('data') or {}).get('fn')} "
+        f"({(r.get('data') or {}).get('n_sigs')} signatures)"
+        for r in storms)
+    return _finding(
+        "recompile_storm", "WARNING",
+        f"jit recompile storm: {names} — every new shape signature pays "
+        f"a fresh XLA compile on the hot path",
+        storms,
+        "bucket the dynamic dimensions (pad batch/sequence to a fixed "
+        "set of shapes) or hoist the varying value out of the traced "
+        "arguments; see the signatures in the evidence rows")
+
+
+def _rule_ingest_bound(events, tasks):
+    """Training that spends a large share of every step waiting on data
+    is ingest-bound — the chip idles while the input pipeline catches
+    up.  Only the step profiler's phase attribution can say this: a
+    step-time histogram alone cannot split waiting from computing."""
+    rows = _rows(events, "perf", "step phases")
+    if len(rows) < INGEST_MIN_STEPS:
+        return None
+    wall = ingest = 0.0
+    for r in rows:
+        d = r.get("data") or {}
+        phases = d.get("phases") or {}
+        wall += float(d.get("wall_s") or r.get("span_dur") or 0.0)
+        ingest += float(phases.get("ingest") or 0.0)
+    if wall <= 0:
+        return None
+    frac = ingest / wall
+    if frac < INGEST_FRACTION:
+        return None
+    ev = [{"steps": len(rows), "ingest_s": round(ingest, 4),
+           "wall_s": round(wall, 4), "ingest_frac": round(frac, 4)}]
+    return _finding(
+        "ingest_bound", "WARNING",
+        f"training is ingest-bound: {frac * 100:.0f}% of step wall "
+        f"({ingest:.2f}s of {wall:.2f}s over {len(rows)} steps) waits "
+        f"on data",
+        ev,
+        "the input pipeline can't keep up: raise streaming parallelism "
+        "/ prefetch_blocks, move transforms off the train host, or "
+        "shard the source wider")
+
+
+def _rule_prefill_interference(events, tasks):
+    """Decode ticks co-scheduled with prefill chunks run long — the
+    serve engine's tick meter bills that excess to the prefills.  A high
+    billed share IS the decode-tail explanation (gpt2 p99/p50=1.39x):
+    bound it with chunked prefill or an interleave budget."""
+    rows = _rows(events, "perf", "prefill interference")
+    # latest meter state per (origin, engine): engine ids are per-process
+    # (pids collide across hosts), so the shipping origin must qualify
+    # the key or one replica's healthy meter shadows another's pathology
+    latest: Dict[tuple, dict] = {}
+    for r in rows:
+        eid = (str(r.get("origin") or "head"), str(r.get("entity_id")))
+        if eid not in latest or float(r.get("ts") or 0.0) >= float(
+                latest[eid].get("ts") or 0.0):
+            latest[eid] = r
+    flagged = []
+    for r in latest.values():
+        d = r.get("data") or {}
+        if (d.get("interleaved_ticks") or 0) >= PREFILL_MIN_TICKS \
+                and (d.get("interference_frac") or 0.0) \
+                >= PREFILL_INTERFERENCE_FRAC:
+            flagged.append(r)
+    if not flagged:
+        return None
+    worst = max((r.get("data") or {}).get("interference_frac", 0.0)
+                for r in flagged)
+    return _finding(
+        "prefill_interference", "WARNING",
+        f"prefill chunks are billed {worst * 100:.0f}% of decode tick "
+        f"time on {len(flagged)} engine(s) — the decode tail is "
+        f"prefill interference, not decode variance",
+        flagged,
+        "bound the interleave: chunk prefills smaller, cap admissions "
+        "per tick, or disaggregate prefill onto its own replica "
+        "(serve.llm.prefill_decode_graph)")
+
+
 # ---------------------------------------------------------------------------
 # trend rules (each: series_map -> finding | None).  series_map is
 # {metric_name: [{"tags": {...}, "points": [[ts, value], ...]}, ...]} —
@@ -427,10 +541,51 @@ def _trend_rule_queue_climb(series_map):
     return None
 
 
+def _trend_rule_mfu_regression(series_map):
+    """Live MFU sagging against its own trailing history: the step
+    profiler's per-step MFU gauge makes "the run got slower" a measured
+    regression instead of an end-of-run surprise.  Compares the trailing
+    quarter of the window against the earlier mean — a sustained drop,
+    not a single slow step."""
+    worst = None
+    for s in series_map.get("ray_tpu_train_step_mfu", ()):
+        pts = s.get("points") or []
+        if len(pts) < 2 * TREND_MIN_POINTS:
+            continue
+        half = pts[:len(pts) // 2]
+        tail = pts[-max(3, len(pts) // 4):]
+        earlier = sum(p[1] for p in half) / len(half)
+        trailing = sum(p[1] for p in tail) / len(tail)
+        if earlier < MFU_MIN_LEVEL:
+            continue
+        drop = 1.0 - trailing / earlier
+        if drop < MFU_DROP_FRAC:
+            continue
+        row = {"tags": s.get("tags", {}),
+               "earlier_mfu": round(earlier, 4),
+               "trailing_mfu": round(trailing, 4),
+               "drop_frac": round(drop, 4),
+               "window_points": len(pts)}
+        if worst is None or drop > worst["drop_frac"]:
+            worst = row
+    if worst is None:
+        return None
+    return _finding(
+        "mfu_regression", "WARNING",
+        f"live MFU regressed {worst['drop_frac'] * 100:.0f}%: "
+        f"{worst['earlier_mfu']:.3f} -> {worst['trailing_mfu']:.3f} "
+        f"over the trailing window",
+        [worst],
+        "something slowed the step mid-run: check `ray_tpu perf` for a "
+        "phase that grew (ingest? collective? a recompile storm?), HBM "
+        "pressure, or a straggler rank")
+
+
 TREND_RULES = (
     _trend_rule_rss_growth,
     _trend_rule_store_leak,
     _trend_rule_queue_climb,
+    _trend_rule_mfu_regression,
 )
 
 # metric names the live doctor pulls from the TSDB for the trend pass
@@ -439,6 +594,7 @@ TREND_METRICS = (
     "ray_tpu_object_store_bytes",
     "ray_tpu_arena_bytes_used",
     "ray_tpu_sched_queue_depth",
+    "ray_tpu_train_step_mfu",
 )
 
 
@@ -465,6 +621,9 @@ RULES = (
     _rule_router_saturation,
     _rule_worker_churn,
     _rule_slow_node_skew,
+    _rule_recompile_storm,
+    _rule_ingest_bound,
+    _rule_prefill_interference,
 )
 
 
@@ -525,7 +684,9 @@ def render(findings: List[dict]) -> str:
                              "data", "name", "slow", "fast", "ratio",
                              "tags", "metric", "slope_mb_per_min",
                              "growth_mb", "monotone_frac", "min_depth",
-                             "start_depth", "end_depth", "slope_per_min")}
+                             "start_depth", "end_depth", "slope_per_min",
+                             "steps", "ingest_s", "wall_s", "ingest_frac",
+                             "earlier_mfu", "trailing_mfu", "drop_frac")}
             out.append(f"  evidence: {desc}")
         if f["count"] > 3:
             out.append(f"  ... {f['count'] - 3} more evidence row(s)")

@@ -1,0 +1,99 @@
+"""Analytical FLOPs / roofline model shared by bench and the live profiler.
+
+One home for the MFU arithmetic: ``bench.py`` computed
+``flops_per_token``/``peak_flops`` privately and once per run, which made
+a LIVE per-step MFU impossible to compare against the end-of-run number
+(any drift between two copies of the formula would make an "MFU
+regressed" doctor rule meaningless).  Everything here is pure host-side
+arithmetic — no jax import unless the XLA cross-check is asked for.
+
+Conventions (unchanged from bench.py's originals):
+
+- ``transformer_flops_per_token`` counts MODEL FLOPs only — ``6N``
+  matmul fwd+bwd plus the ``12·L·D·T`` attention term; remat
+  recomputation is never credited.
+- ``peak_flops`` is the bf16 peak of the chip generation, keyed by
+  substring of ``device.device_kind``; unknown kinds (CPU dev boxes
+  included) fall back to the v5e number so ratios stay comparable
+  across environments.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+# bf16 peak FLOP/s per chip generation (marketing peaks; MFU denominators)
+PEAK_FLOPS_BF16 = {
+    "v5 lite": 197e12, "v5litepod": 197e12, "v5e": 197e12,
+    "v4": 275e12, "v5p": 459e12, "v6 lite": 918e12, "v6e": 918e12,
+}
+
+DEFAULT_PEAK_FLOPS = 197e12
+
+
+def peak_flops(device_kind: str) -> float:
+    """bf16 peak FLOP/s for a device kind string (``jax.devices()[0]
+    .device_kind``); unknown kinds fall back to the v5e peak."""
+    kind = (device_kind or "").lower()
+    for k, v in PEAK_FLOPS_BF16.items():
+        if k in kind:
+            return v
+    return DEFAULT_PEAK_FLOPS
+
+
+def transformer_flops_per_token(n_params: int, n_layers: int,
+                                d_model: int, seq_len: int) -> float:
+    """Training FLOPs per token for a decoder transformer: ``6N`` matmul
+    (fwd 2N + bwd 4N) + ``12·L·D·T`` attention score/value math, fwd+bwd
+    folded into the constants.  Model FLOPs only (no remat credit)."""
+    return 6.0 * n_params + 12.0 * n_layers * d_model * seq_len
+
+
+def model_flops_per_token(cfg: Any, n_params: int) -> float:
+    """``transformer_flops_per_token`` off a model config (anything with
+    ``n_layers``/``d_model``/``max_seq_len`` — gpt2/llama/bert configs
+    qualify).  ``n_params`` comes from the caller (``models.*.num_params``
+    over an ``eval_shape`` pytree is free) so this agrees EXACTLY with
+    the bench formula rather than re-estimating the count analytically."""
+    return transformer_flops_per_token(
+        int(n_params), int(cfg.n_layers), int(cfg.d_model),
+        int(cfg.max_seq_len))
+
+
+def decode_flops_per_token(n_params: int) -> float:
+    """Inference decode FLOPs per generated token: the ``2N`` forward
+    matmul cost (attention-over-cache is bandwidth-, not FLOP-bound at
+    decode shapes, so the matmul term is the roofline numerator)."""
+    return 2.0 * n_params
+
+
+def mfu(tokens_per_sec: float, flops_per_token: float,
+        device_kind: str = "", peak: Optional[float] = None) -> float:
+    """Model FLOPs utilization: achieved model FLOP/s over the chip's
+    bf16 peak.  ``peak`` overrides the device-kind lookup (tests, CPU
+    dev boxes with a synthetic denominator)."""
+    denom = peak if peak else peak_flops(device_kind)
+    if denom <= 0:
+        return 0.0
+    return tokens_per_sec * flops_per_token / denom
+
+
+def xla_cost_analysis_flops(jitted_fn, *args, **kwargs) -> Optional[float]:
+    """XLA's own FLOP count for one call of a jitted function, via
+    ``lower(...).compile().cost_analysis()`` — the cross-check that keeps
+    the analytical model honest (the two should agree within the remat /
+    non-matmul-op noise).  Returns None wherever the backend doesn't
+    expose cost analysis (never raises: this is a diagnostic, and a
+    backend quirk must not take down a bench or doctor run)."""
+    try:
+        lowered = jitted_fn.lower(*args, **kwargs)
+        compiled = lowered.compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not ca:
+            return None
+        f = ca.get("flops")
+        return float(f) if f else None
+    except Exception:
+        return None

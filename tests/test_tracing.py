@@ -177,6 +177,20 @@ def test_doctor_healthy_run_is_clean():
         {"source": "train", "message": "gang started", "severity": "INFO"},
         {"source": "compiled_dag", "message": "channel wait",
          "severity": "DEBUG", "span_dur": 60.0, "data": {"op": "recv"}},
+        # healthy perf plane (PR 11 rules must stay silent on these):
+        # bucketed compiles below the storm threshold, low ingest share,
+        # mild prefill interference
+        {"source": "perf", "message": "jit compile", "severity": "DEBUG",
+         "span_dur": 0.4, "data": {"fn": "prefill", "n_sigs": 4,
+                                   "misses": 4, "hits": 900}},
+        {"source": "perf", "message": "step phases", "severity": "DEBUG",
+         "entity_id": "rank0", "span_dur": 0.1,
+         "data": {"wall_s": 0.1, "mfu": 0.4,
+                  "phases": {"ingest": 0.01, "compute": 0.09}}},
+        {"source": "perf", "message": "prefill interference",
+         "severity": "DEBUG", "entity_id": "engine-1",
+         "data": {"interference_s": 0.5, "interference_frac": 0.05,
+                  "interleaved_ticks": 400, "decode_only_ticks": 5000}},
     ]
     tasks = [{"name": "t", "node_id": "n1", "exec_start": 0.0,
               "exec_end": 0.01}] * 20
