@@ -467,7 +467,9 @@ def run_serve_bench() -> dict:
         for t in warmers:
             t.join()
 
-        n_threads, per_thread = (64, 12) if has_tpu else (8, 10)
+        # 64 clients on CPU too: the serve_ingress row is defined at 64
+        # concurrent keep-alive clients (ROADMAP item 2's bar)
+        n_threads, per_thread = (64, 12) if has_tpu else (64, 6)
         lats: list = []
         lats_lock = threading.Lock()
 
@@ -497,16 +499,161 @@ def run_serve_bench() -> dict:
         if has_tpu:
             bert_handle = serve.get_deployment_handle("Bert")
             rtt_ms = ray_tpu.get(bert_handle.sync_rtt_ms.remote(), timeout=120)
+        mode = ray_tpu.get(
+            serve.api._get_client().proxy.ingress_stats.remote(),
+            timeout=30)["mode"]
         out = {
             "serve_bert_rps": round(n / wall, 1),
             "serve_req_p50_ms": round(lats[n // 2] * 1e3, 1),
             "serve_req_p99_ms": round(lats[min(n - 1, int(n * 0.99))] * 1e3, 1),
             "serve_concurrent_clients": n_threads,
             "serve_req_p50_light_ms": round(light[len(light) // 2] * 1e3, 1),
+            # the ROADMAP item 2 row: same measurement, named for the
+            # asyncio ingress trajectory (≥600 rps BERT @ 64 clients bar)
+            "serve_ingress_rps": round(n / wall, 1),
+            "serve_ingress_p50_ms": round(lats[n // 2] * 1e3, 1),
+            "serve_ingress_p99_ms": round(
+                lats[min(n - 1, int(n * 0.99))] * 1e3, 1),
+            "serve_ingress_mode": mode,
         }
         if rtt_ms is not None:
             out["tunnel_sync_rtt_ms"] = round(rtt_ms, 1)
         return out
+    finally:
+        try:
+            serve.shutdown()
+        except Exception:
+            pass
+        ray_tpu.shutdown()
+
+
+def run_serve_chaos_bench() -> dict:
+    """Serve chaos soak row: 64 keep-alive clients soak the asyncio
+    ingress while a replica is SIGKILLed mid-run.  Reports p99 before /
+    during / after the incident, the retried-request count (in-flight
+    requests re-assigned off the corpse), time-to-recovery (replacement
+    RUNNING), and time-to-drain for a graceful scale-down."""
+    import http.client
+    import threading
+    import time
+
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.devtools.chaos import ChaosMonkey
+
+    ray_tpu.init(num_cpus=8, num_tpus=0)
+    client = serve.start(serve.HTTPOptions(host="127.0.0.1", port=0))
+    try:
+        @serve.deployment(num_replicas=2, max_concurrent_queries=64,
+                          max_queued_requests=512,
+                          ray_actor_options={"max_concurrency": 64})
+        class Soak:
+            def __call__(self, request=None):
+                time.sleep(0.02)
+                return "ok"
+
+        serve.run(Soak.bind(), port=0, timeout_s=120)
+        host, port = serve.get_http_address()
+        lats: list = []
+        lock = threading.Lock()
+        t_end = time.perf_counter() + 10.0
+
+        def client_loop():
+            conn = http.client.HTTPConnection(host, port, timeout=120)
+            try:
+                while time.perf_counter() < t_end:
+                    t0 = time.perf_counter()
+                    conn.request("GET", "/Soak",
+                                 headers={"X-Serve-Deadline-S": "30"})
+                    resp = conn.getresponse()
+                    resp.read()
+                    if resp.status == 200:
+                        with lock:
+                            lats.append((time.perf_counter(),
+                                         time.perf_counter() - t0))
+                    elif resp.status == 503:
+                        time.sleep(0.1)
+            except Exception:  # noqa: BLE001 — a client dropped mid-kill
+                # window loses its samples, not the bench
+                pass
+            finally:
+                conn.close()
+
+        stats0 = ray_tpu.get(client.proxy.ingress_stats.remote(), timeout=30)
+        threads = [threading.Thread(target=client_loop) for _ in range(64)]
+        for t in threads:
+            t.start()
+        time.sleep(3.0)
+        t_kill = time.perf_counter()
+        rec = ChaosMonkey().kill_serve_replica("Soak",
+                                               controller=client.controller)
+        # recovered = the corpse left the routing set AND 2 live replicas
+        # are back (status right after the kill still lists it RUNNING)
+        recovery_s = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            info = ray_tpu.get(
+                client.controller.get_routing_info.remote("Soak"),
+                timeout=30)
+            tags = {t for t, _ in info["replicas"]}
+            if rec["target"] not in tags and len(tags) >= 2:
+                recovery_s = time.perf_counter() - t_kill
+                break
+            time.sleep(0.2)
+        for t in threads:
+            t.join(timeout=120)
+        stats1 = ray_tpu.get(client.proxy.ingress_stats.remote(), timeout=30)
+
+        def p99(vals):
+            vals = sorted(vals)
+            return (vals[min(len(vals) - 1, int(len(vals) * 0.99))]
+                    if vals else 0.0)
+
+        win = max(recovery_s or 2.0, 2.0)
+        before = [l for ts, l in lats if ts < t_kill]
+        during = [l for ts, l in lats if 0 <= ts - t_kill <= win]
+        after = [l for ts, l in lats if ts - t_kill > win]
+
+        # graceful-drain timing: one slow request in flight, then a
+        # scale-down — time until its replica reports drained
+        @serve.deployment(name="DrainProbe", num_replicas=1)
+        class DrainProbe:
+            def __call__(self, request=None):
+                time.sleep(1.0)
+                return "done"
+
+        serve.run(DrainProbe.bind(), port=0, timeout_s=120)
+        probe = serve.get_deployment_handle("DrainProbe")
+        ref = probe.remote()
+        time.sleep(0.3)
+        t_drain0 = time.perf_counter()
+        serve.delete("DrainProbe")
+        drain_s = None
+        from ray_tpu.experimental.state import api as state
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            rows = [e for e in state.list_events(limit=50_000)
+                    if e.get("source") == "serve"
+                    and e.get("message") == "replica drained"
+                    and (e.get("data") or {}).get("deployment")
+                    == "DrainProbe"]
+            if rows:
+                drain_s = time.perf_counter() - t_drain0
+                break
+            time.sleep(0.2)
+        ray_tpu.get(ref, timeout=30)  # the in-flight request completed
+
+        return {
+            "serve_chaos_p99_before_ms": round(p99(before) * 1e3, 1),
+            "serve_chaos_p99_during_ms": round(p99(during) * 1e3, 1),
+            "serve_chaos_p99_after_ms": round(p99(after) * 1e3, 1),
+            "serve_chaos_retried": stats1["retries"] - stats0["retries"],
+            "serve_chaos_shed": stats1["shed"] - stats0["shed"],
+            "serve_chaos_recovery_s": round(recovery_s, 2)
+            if recovery_s is not None else None,
+            "serve_chaos_time_to_drain_s": round(drain_s, 2)
+            if drain_s is not None else None,
+        }
     finally:
         try:
             serve.shutdown()
@@ -1403,6 +1550,10 @@ def main() -> None:
         decode_out.update(run_serve_bench())
     except Exception as e:
         decode_out["serve_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        decode_out.update(run_serve_chaos_bench())
+    except Exception as e:
+        decode_out["serve_chaos_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         decode_out.update(run_rl_bench())
     except Exception as e:

@@ -38,6 +38,14 @@ KNOWN_SOURCES = (
     "scheduler", "node", "actor", "worker_pool", "object_store",
     "streaming", "serve", "serve_llm", "train", "collective",
     "compiled_dag", "trace",
+    # "serve" carries the ingress fault-tolerance signal set doctor's
+    # ingress_shedding / drain_stuck rules read: `ingress shedding
+    # started`/`stopped` (router watermark + proxy in-flight cap, with
+    # hysteresis so an episode is two events, not one per refused
+    # request), `replica draining`/`drained`/`drain timeout`, `request
+    # retried after replica death`, `routing refresh failed`, and
+    # `deployment scaled`; shed/retry volume rides the
+    # ray_tpu_serve_shed_total counter and ingress_stats()
     # slice failure domain: P2P mesh observations (_private/syncer.py),
     # fault injections (devtools/chaos), scale/replace decisions
     # (autoscaler/policy.py) — doctor and the timeline correlate cause

@@ -2201,10 +2201,15 @@ class Node:
                 d["attempt"] += 1
                 d["oids"] = []
             if not _resubmit:
-                self.gcs.tasks[spec["task_id"]] = TaskInfo(
-                    task_id=spec["task_id"], name=spec.get("name", "task"),
-                    trace_ctx=spec.get("trace_ctx"),
-                )
+                # under gcs.lock too: flush/snapshot/prune iterate this
+                # dict under gcs.lock alone, and an insert racing those
+                # iterations is a "dictionary changed size" crash in the
+                # gcs-flush thread (seen under a 1k-client serve soak)
+                with self.gcs.lock:
+                    self.gcs.tasks[spec["task_id"]] = TaskInfo(
+                        task_id=spec["task_id"], name=spec.get("name", "task"),
+                        trace_ctx=spec.get("trace_ctx"),
+                    )
                 track = (
                     not spec.get("actor_id")
                     and len(self.lineage) < self.cfg.max_lineage_entries
@@ -3157,10 +3162,13 @@ class Node:
                 err = RayActorError(f"Actor is dead: {cause}")
                 threading.Thread(target=self._seal_error_returns, args=(spec, err), daemon=True).start()
                 return
-            self.gcs.tasks[spec["task_id"]] = TaskInfo(
-                task_id=spec["task_id"], name=spec.get("name", "actor_task"),
-                trace_ctx=spec.get("trace_ctx"),
-            )
+            with self.gcs.lock:  # see submit_task: iterators hold only
+                # gcs.lock, so inserts must too
+                self.gcs.tasks[spec["task_id"]] = TaskInfo(
+                    task_id=spec["task_id"],
+                    name=spec.get("name", "actor_task"),
+                    trace_ctx=spec.get("trace_ctx"),
+                )
             art.queue.append(spec)
             # direct dispatch on the submitting connection's reader thread;
             # the scheduler is only needed while the actor isn't placed yet

@@ -177,6 +177,28 @@ class TrendPolicy:
                         evidence=worst)
 
 
+def serve_replica_scaler(controller=None) -> Callable[[str, int], None]:
+    """A ``replica_scaler`` bound to the serve controller's
+    ``scale_deployment`` RPC — the glue that lets a TrendAutoscaler act
+    on router-backlog slope (``scale_up_replicas`` decisions) by growing
+    the deployment's replica goal.  Clamping to autoscaling bounds
+    happens controller-side, so this scaler and the controller's own
+    demand autoscaler can coexist without fighting."""
+    import ray_tpu
+
+    def scale(deployment: str, delta: int) -> None:
+        nonlocal controller
+        if controller is None:
+            from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        ray_tpu.get(
+            controller.scale_deployment.remote(deployment, delta=delta),
+            timeout=30)
+
+    return scale
+
+
 class TrendAutoscaler(StandardAutoscaler):
     """StandardAutoscaler + TSDB-trend decisions + slice repair.
 

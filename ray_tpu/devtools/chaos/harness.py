@@ -18,6 +18,12 @@ head's tables to resolve slice membership).  Four ops:
 ``slow``
     Duty-cycled SIGSTOP/SIGCONT for ``duration_s`` — a straggler host
     (doctor's slow_node_skew food).
+``kill_replica``
+    SIGKILL one serve replica's worker process (resolved through the
+    serve controller's routing table + the replica's own pid) — the
+    serving-failure-domain injection: the ingress must retry idempotent
+    in-flight requests to a live replica and the controller must
+    replace the dead one, with zero client-visible 500s.
 
 Every injection lands in the flight recorder under source ``chaos`` with
 the op, target, slice and seed, so a post-mortem reads "what did the
@@ -43,7 +49,7 @@ class Injection:
     member of ``slice_id`` when ``target`` is None."""
 
     at_s: float
-    op: str  # sigkill | pause | drop | slow
+    op: str  # sigkill | pause | drop | slow | kill_replica
     target: Optional[str] = None
     slice_id: Optional[str] = None
     duration_s: float = 5.0
@@ -191,6 +197,39 @@ class ChaosMonkey:
         self._spawn(cycle)
         return rec
 
+    def kill_serve_replica(self, deployment: str,
+                           controller=None,
+                           replica_tag: Optional[str] = None) -> dict:
+        """SIGKILL one replica of a serve deployment (seeded-random among
+        RUNNING replicas unless ``replica_tag`` pins one).  The pid comes
+        from the replica itself (``stats()``), so this works for local
+        and emulated-multihost replicas alike — the worker process just
+        dies, exactly like a preempted host."""
+        import ray_tpu
+        from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+        if controller is None:
+            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        info = ray_tpu.get(
+            controller.get_routing_info.remote(deployment), timeout=10)
+        if not info or not info["replicas"]:
+            raise RuntimeError(
+                f"chaos: deployment {deployment!r} has no RUNNING replica")
+        replicas = sorted(info["replicas"], key=lambda rh: rh[0])
+        if replica_tag is not None:
+            cands = [rh for rh in replicas if rh[0] == replica_tag]
+            if not cands:
+                raise RuntimeError(
+                    f"chaos: no RUNNING replica {replica_tag!r}")
+            tag, handle = cands[0]
+        else:
+            tag, handle = self._rng.choice(replicas)
+        stats = ray_tpu.get(handle.stats.remote(), timeout=10)
+        pid = int(stats["pid"])
+        os.kill(pid, signal.SIGKILL)
+        return self._record("kill_replica", tag, pid=pid,
+                            deployment=deployment)
+
     def _slice_of(self, node_id: str) -> Optional[str]:
         with self.node.lock:
             ns = self.node.nodes.get(node_id)
@@ -218,6 +257,10 @@ class ChaosMonkey:
                                 op=inj.op, error=str(e)[:200])
 
     def inject(self, inj: Injection) -> dict:
+        if inj.op == "kill_replica":
+            # target names the DEPLOYMENT; the replica is seeded-random
+            return self.kill_serve_replica(
+                inj.target, replica_tag=inj.params.get("replica_tag"))
         target = inj.target or self.pick(inj.slice_id)
         if inj.op == "sigkill":
             return self.sigkill(target, slice_id=inj.slice_id)

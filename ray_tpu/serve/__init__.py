@@ -14,6 +14,7 @@ from ray_tpu.serve.api import (
     deployment,
     get_deployment_handle,
     get_http_address,
+    ingress,
     run,
     shutdown,
     start,
@@ -21,17 +22,24 @@ from ray_tpu.serve.api import (
 )
 from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.exceptions import (
+    BackPressureError,
+    RayServeException,
+    ReplicaDrainingError,
+)
 from ray_tpu.serve.handle import DeploymentHandle
-from ray_tpu.serve._private.http_util import Request, StreamingResponse
+from ray_tpu.serve._private.http_util import Request, Response, StreamingResponse
 
 __all__ = [
     "StreamingResponse",
+    "Response",
     "deployment",
     "Deployment",
     "DeploymentConfig",
     "AutoscalingConfig",
     "batch",
     "Application",
+    "ingress",
     "run",
     "start",
     "delete",
@@ -42,4 +50,7 @@ __all__ = [
     "DeploymentHandle",
     "HTTPOptions",
     "Request",
+    "RayServeException",
+    "BackPressureError",
+    "ReplicaDrainingError",
 ]

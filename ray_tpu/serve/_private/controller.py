@@ -23,6 +23,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import math
 
+from ray_tpu._private import events as _events
 from ray_tpu.serve.config import (
     MAX_CONSECUTIVE_START_FAILURES,
     DeploymentConfig,
@@ -50,6 +51,9 @@ class _DeploymentState:
         self.name = name
         self.goal = goal  # serialized_def/init_args/init_kwargs/config/route_prefix
         self.replicas: List[_Replica] = []
+        # replicas out of the routing set, finishing in-flight requests
+        # before termination (visible as DRAINING in get_status)
+        self.draining: List[_Replica] = []
         self.version = 1
         self.deleting = False
         self.consecutive_failures = 0  # replica deaths with no RUNNING between
@@ -160,6 +164,8 @@ class ServeController:
             return {
                 "version": state.version,
                 "max_concurrent_queries": state.config.max_concurrent_queries,
+                "max_queued_requests": state.config.max_queued_requests,
+                "request_timeout_s": state.config.request_timeout_s,
                 "replicas": [
                     (r.tag, r.handle)
                     for r in state.replicas
@@ -184,6 +190,8 @@ class ServeController:
                 counts: Dict[str, int] = {}
                 for r in state.replicas:
                     counts[r.state] = counts.get(r.state, 0) + 1
+                if state.draining:
+                    counts[ReplicaState.DRAINING] = len(state.draining)
                 running = counts.get(ReplicaState.RUNNING, 0)
                 goal_n = state.config.num_replicas
                 if state.unhealthy_reason is not None:
@@ -349,6 +357,35 @@ class ServeController:
                 for rid, (c, ts) in state.handle_metrics.items()
             }
 
+    def scale_deployment(self, name: str, delta: int = 0,
+                         num_replicas: Optional[int] = None) -> Optional[int]:
+        """Externally-driven replica scaling — the hook the trend
+        autoscaler's ``replica_scaler`` calls when router-backlog slope
+        says capacity must arrive before the queue becomes an incident.
+        Clamped to the deployment's autoscaling bounds (when configured)
+        so an external scaler and the demand autoscaler can coexist.
+        Returns the new goal, or None for an unknown deployment."""
+        with self._lock:
+            state = self._deployments.get(name)
+            if state is None or state.deleting:
+                return None
+            cur = state.config.num_replicas
+            target = num_replicas if num_replicas is not None else cur + int(delta)
+            auto = state.config.autoscaling_config
+            if auto is not None:
+                target = max(auto.min_replicas, min(auto.max_replicas, target))
+            target = max(0, target)
+            if target != cur:
+                _events.emit(
+                    "serve", "deployment scaled", severity="INFO",
+                    entity_id=name, prev=cur, goal=target)
+                logger.info("serve: external scale %s %d -> %d",
+                            name, cur, target)
+                state.config.num_replicas = target
+                self._reconcile(state)
+                self._bump(state)
+            return target
+
     def _autoscale_once(self, state: _DeploymentState, now: float) -> None:
         """One scaling decision for one deployment (lock held)."""
         cfg = state.config.autoscaling_config
@@ -444,28 +481,98 @@ class ServeController:
         logger.info("serve: starting replica %s", tag)
 
     def _stop_replica(self, state: _DeploymentState, replica: _Replica) -> None:
+        """Graceful replica termination: stop assigning, finish in-flight,
+        then terminate.  Three ordered moves (caller holds the lock):
+
+        1. out of the routing set + version bump — routers stop assigning
+           to it before it learns it is draining (so ReplicaDrainingError
+           is a race, not a steady state);
+        2. background drain: ``prepare_for_drain`` flips the replica's
+           accept flag, then ``drain_status`` is polled until in-flight
+           requests AND live streams hit zero or the graceful window
+           lapses (a timeout means accepted work WOULD have been lost —
+           doctor's drain_stuck food);
+        3. the user's shutdown hook, then ``kill``.
+
+        Scale-downs, code redeploys, autoscaler shrink and replica
+        replacement all route through here, so every deliberate
+        termination gets the same no-lost-requests story."""
         import ray_tpu
 
-        replica.state = ReplicaState.STOPPING
-        # Out of the routing set immediately (no new requests), then drain:
-        # queued requests ahead of prepare_for_shutdown still execute, the
-        # shutdown hook runs, and only then — or at the graceful timeout —
-        # the actor is killed.
+        replica.state = ReplicaState.DRAINING
         if replica in state.replicas:
             state.replicas.remove(replica)
+        state.draining.append(replica)
         self._bump(state)
         grace = state.config.graceful_shutdown_timeout_s
+        dep_name = state.name
 
         def drain():
+            from ray_tpu.exceptions import GetTimeoutError
+
+            t0 = time.monotonic()
+            deadline = t0 + grace
+            _events.emit(
+                "serve", "replica draining", severity="INFO",
+                entity_id=replica.tag, deployment=dep_name, grace_s=grace)
+            pending = None
+            died = None
+            try:
+                # a plain (serialized) replica queues this call behind the
+                # requests already executing/queued on it, so the full
+                # graceful window applies: when it answers, everything
+                # accepted before the drain has finished.  grace_s lets
+                # the replica keep serving stale-router racers inside the
+                # window (refusing only once a kill is imminent).
+                st = ray_tpu.get(
+                    replica.handle.prepare_for_drain.remote(
+                        grace_s=max(deadline - time.monotonic(), 0.1)),
+                    timeout=max(deadline - time.monotonic(), 0.1))
+                while (st.get("inflight", 0) > 0 or st.get("streams", 0) > 0):
+                    if time.monotonic() >= deadline:
+                        pending = st
+                        break
+                    time.sleep(0.1)
+                    st = ray_tpu.get(replica.handle.drain_status.remote(),
+                                     timeout=max(deadline - time.monotonic(),
+                                                 0.1))
+            except GetTimeoutError:
+                # never reached the replica inside the window — a request
+                # is still occupying its executor (the cut-off case)
+                pending = {"inflight": 1, "streams": 0, "confirmed": False}
+            except Exception as e:  # noqa: BLE001 — replica died mid-
+                # drain: NOT a clean drain (anything it was running is
+                # lost), but also not a cutoff we chose
+                died = f"{type(e).__name__}: {e}"[:200]
+            if died is not None:
+                _events.emit(
+                    "serve", "replica died while draining",
+                    severity="WARNING", entity_id=replica.tag,
+                    deployment=dep_name, error=died)
+            elif pending is None:
+                _events.emit(
+                    "serve", "replica drained", severity="INFO",
+                    entity_id=replica.tag, deployment=dep_name,
+                    wait_s=round(time.monotonic() - t0, 3))
+            else:
+                _events.emit(
+                    "serve", "replica drain timeout", severity="WARNING",
+                    entity_id=replica.tag, deployment=dep_name,
+                    inflight=pending.get("inflight", 0),
+                    streams=pending.get("streams", 0), grace_s=grace)
             try:
                 fut = replica.handle.prepare_for_shutdown.remote()
-                ray_tpu.get(fut, timeout=grace)
+                ray_tpu.get(fut, timeout=max(deadline - time.monotonic(), 1.0))
             except Exception:
                 pass
             try:
                 ray_tpu.kill(replica.handle)
             except Exception:
                 pass
+            replica.state = ReplicaState.DEAD
+            with self._lock:
+                if replica in state.draining:
+                    state.draining.remove(replica)
 
         threading.Thread(target=drain, daemon=True, name=f"drain-{replica.tag}").start()
 

@@ -56,6 +56,37 @@ class Request:
         return f"Request({self.method} {self.path}, {len(self.body)}B)"
 
 
+class Response:
+    """An explicit HTTP response from a deployment: status + headers +
+    body.  The starlette ``Response`` seat — what the ``@serve.ingress``
+    ASGI adapter returns, and what any deployment can return directly to
+    control the status code.  Picklable (crosses the replica->proxy actor
+    call)."""
+
+    def __init__(self, body: Any = b"", status_code: int = 200,
+                 headers: Optional[Dict[str, str]] = None,
+                 content_type: Optional[str] = None):
+        if isinstance(body, (bytes, bytearray)):
+            self.body = bytes(body)
+            default_ct = "application/octet-stream"
+        elif isinstance(body, str):
+            self.body = body.encode()
+            default_ct = "text/plain; charset=utf-8"
+        else:
+            self.body = json.dumps(body).encode()
+            default_ct = "application/json"
+        self.status_code = int(status_code)
+        self.headers = dict(headers or {})
+        if content_type is not None:
+            self.content_type = content_type
+        else:
+            self.content_type = self.headers.pop(
+                "content-type", self.headers.pop("Content-Type", default_ct))
+
+    def __repr__(self) -> str:
+        return f"Response({self.status_code}, {len(self.body)}B)"
+
+
 class StreamingResponse:
     """Return this from a deployment to stream the response body
     incrementally (the starlette StreamingResponse seat).  ``iterable``
@@ -84,3 +115,88 @@ def encode_response(result: Any) -> tuple:
     if isinstance(result, str):
         return result.encode(), "text/plain; charset=utf-8"
     return json.dumps(result).encode(), "application/json"
+
+
+def run_asgi_app(app, request: Request) -> Response:
+    """Run one request through an ASGI application and collect the reply.
+
+    The environment has no uvicorn, so this is the ASGI *server* half in
+    ~40 lines: build an ``http`` scope from our picklable Request, feed
+    the body through ``receive``, fold ``http.response.start`` /
+    ``http.response.body`` messages into a :class:`Response`.  Runs the
+    app on a private event loop (the replica executes requests on plain
+    threads) — what ``@serve.ingress`` calls per request.
+    """
+    import asyncio
+    from urllib.parse import urlencode
+
+    state: Dict[str, Any] = {"status": 500, "headers": [],
+                             "body": bytearray()}
+    fed = {"done": False}
+
+    async def receive():
+        if fed["done"]:
+            # the app asked again after consuming the body: a one-shot
+            # request has nothing more to say
+            return {"type": "http.disconnect"}
+        fed["done"] = True
+        return {"type": "http.request", "body": request.body or b"",
+                "more_body": False}
+
+    async def send(message):
+        t = message.get("type")
+        if t == "http.response.start":
+            state["status"] = int(message.get("status", 200))
+            state["headers"] = list(message.get("headers") or [])
+        elif t == "http.response.body":
+            state["body"] += message.get("body", b"")
+
+    scope = {
+        "type": "http",
+        "asgi": {"version": "3.0", "spec_version": "2.3"},
+        "http_version": "1.1",
+        "method": request.method,
+        "scheme": "http",
+        "path": request.path,
+        "raw_path": request.path.encode("latin-1"),
+        "query_string": urlencode(request.query_params).encode("latin-1"),
+        "root_path": "",
+        "headers": [(k.lower().encode("latin-1"), str(v).encode("latin-1"))
+                    for k, v in request.headers.items()],
+        "client": None,
+        "server": None,
+    }
+    asyncio.run(app(scope, receive, send))
+    headers = {}
+    for k, v in state["headers"]:
+        if isinstance(k, bytes):
+            k = k.decode("latin-1")
+        if isinstance(v, bytes):
+            v = v.decode("latin-1")
+        headers[k] = v
+    return Response(bytes(state["body"]), status_code=state["status"],
+                    headers=headers)
+
+
+def parse_http_head(head: bytes) -> tuple:
+    """Parse a raw request head (request line + header block, without the
+    terminating blank line) into ``(method, raw_path, version, headers)``
+    — the asyncio ingress's stand-in for http.server's parsing.  Header
+    names keep the sender's ORIGINAL case (deployment code reading
+    ``request.headers`` must see the same keys under both transports);
+    callers needing case-insensitive lookups lowercase their own view.
+    Raises ValueError on malformed input (the caller answers 400)."""
+    lines = head.split(b"\r\n")
+    try:
+        method, raw_path, version = lines[0].decode("latin-1").split(" ", 2)
+    except (UnicodeDecodeError, ValueError):
+        raise ValueError(f"malformed request line: {lines[0][:80]!r}")
+    headers: Dict[str, str] = {}
+    for line in lines[1:]:
+        if not line:
+            continue
+        k, sep, v = line.partition(b":")
+        if not sep:
+            raise ValueError(f"malformed header line: {line[:80]!r}")
+        headers[k.decode("latin-1").strip()] = v.decode("latin-1").strip()
+    return method, raw_path, version, headers

@@ -14,6 +14,8 @@ from typing import Any, Dict, Optional, Tuple
 
 import cloudpickle
 
+from ray_tpu.serve.exceptions import ReplicaDrainingError
+
 
 class ServeReplica:
     def __init__(
@@ -43,6 +45,9 @@ class ServeReplica:
 
         self._stats_lock = threading.Lock()
         self._num_requests = 0
+        self._inflight = 0
+        self._draining = False
+        self._drain_deadline: Optional[float] = None
         self._start_time = time.time()
         # live streaming responses: stream id -> iterator (the proxy pulls
         # batches of chunks with next_chunks until exhausted)
@@ -51,9 +56,28 @@ class ServeReplica:
 
     def handle_request(self, method_name: str, args: Tuple, kwargs: Dict) -> Any:
         """Run one request (``replica.py:250`` handle_request analog).
-        ``method_name='__call__'`` hits the callable itself."""
+        ``method_name='__call__'`` hits the callable itself.  During a
+        drain's graceful window, requests that raced past a stale routing
+        table still EXECUTE (the drain loop waits for them too — a handle
+        caller must not see an error on a request the pre-drain replica
+        would have served); only once the window has lapsed — when the
+        controller is about to kill the actor anyway — does the typed
+        refusal fire, so the caller gets a cleanly retryable error
+        instead of a mid-execution RayActorError."""
         with self._stats_lock:
+            if self._draining and (
+                    self._drain_deadline is None
+                    or time.monotonic() >= self._drain_deadline):
+                raise ReplicaDrainingError(self.replica_tag)
             self._num_requests += 1
+            self._inflight += 1
+        try:
+            return self._run_request(method_name, args, kwargs)
+        finally:
+            with self._stats_lock:
+                self._inflight -= 1
+
+    def _run_request(self, method_name: str, args: Tuple, kwargs: Dict) -> Any:
         if self._is_function:
             if method_name not in ("__call__", None):
                 raise AttributeError(
@@ -165,12 +189,46 @@ class ServeReplica:
         return "pong"
 
     def stats(self) -> Dict[str, Any]:
+        import os
+
+        with self._stats_lock:
+            inflight = self._inflight
+            draining = self._draining
         return {
             "deployment": self.deployment_name,
             "replica_tag": self.replica_tag,
             "num_requests": self._num_requests,
+            "inflight": inflight,
+            "draining": draining,
+            "pid": os.getpid(),
             "uptime_s": time.time() - self._start_time,
         }
+
+    # -- graceful draining ---------------------------------------------
+    def prepare_for_drain(self, grace_s: Optional[float] = None) -> Dict[str, Any]:
+        """Begin draining: the controller calls this AFTER pulling the
+        replica from the routing set, then polls :meth:`drain_status`
+        until in-flight work hits zero (or the graceful window lapses)
+        before killing the actor.  ``grace_s`` bounds the window in
+        which racing requests are still served (see handle_request);
+        None refuses new work immediately."""
+        with self._stats_lock:
+            self._draining = True
+            self._drain_deadline = (
+                time.monotonic() + grace_s if grace_s is not None else None)
+        return self.drain_status()
+
+    def drain_status(self) -> Dict[str, Any]:
+        """{"inflight": n, "streams": m, "draining": bool} — zero inflight
+        AND zero live streams means the replica is safe to terminate
+        without losing accepted work."""
+        with self._stats_lock:
+            inflight = self._inflight
+            draining = self._draining
+        with self._streams_lock:
+            streams = len(self._streams)
+        return {"inflight": inflight, "streams": streams,
+                "draining": draining}
 
     def prepare_for_shutdown(self) -> bool:
         """Graceful-shutdown hook: user callables may define ``__del__`` or

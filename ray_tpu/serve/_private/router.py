@@ -18,7 +18,14 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ray_tpu._private import events as _events
 from ray_tpu.util import tracing as _tracing
-from ray_tpu.serve.config import ROUTE_TABLE_TTL_S
+from ray_tpu.serve.config import (
+    REFRESH_BACKOFF_BASE_S,
+    REFRESH_BACKOFF_CAP_S,
+    ROUTE_TABLE_TTL_S,
+    ROUTING_PULL_TIMEOUT_S,
+    SHED_RETRY_AFTER_S,
+)
+from ray_tpu.serve.exceptions import BackPressureError
 
 # Lazy router metric singletons (tags: deployment).
 _ROUTER_METRICS = None
@@ -29,7 +36,7 @@ _STALL_EVENT_MIN_INTERVAL_S = 1.0
 def _router_metrics():
     global _ROUTER_METRICS
     if _ROUTER_METRICS is None:
-        from ray_tpu.util.metrics import Gauge, Histogram
+        from ray_tpu.util.metrics import Counter, Gauge, Histogram
 
         _ROUTER_METRICS = {
             "admission": Histogram(
@@ -40,6 +47,10 @@ def _router_metrics():
             "queue_len": Gauge(
                 "ray_tpu_serve_router_queue_len",
                 "requests waiting for a replica in this router",
+                tag_keys=("deployment",)),
+            "shed": Counter(
+                "ray_tpu_serve_shed_total",
+                "requests shed at the backlog watermark (503 + Retry-After)",
                 tag_keys=("deployment",)),
         }
     return _ROUTER_METRICS
@@ -71,6 +82,22 @@ class Router:
         # callers inside assign_request that have not been assigned a
         # replica yet — queued demand the autoscaler must see
         self._pending = 0
+        # load shedding: the controller-owned backlog watermark (-1 =
+        # unbounded) plus hysteresis state so doctor gets a clean
+        # started/stopped incident instead of one event per shed request
+        self._max_queued = -1
+        self._request_timeout = None  # deployment default deadline (s)
+        self._shedding = False
+        self._shed_count = 0
+        # routing-refresh failure backoff (the stale table keeps serving
+        # while the controller is unreachable)
+        self._refresh_failures = 0
+        self._next_refresh_attempt = 0.0
+        # replicas observed dead by a caller (RayActorError): filtered out
+        # of every routing snapshot until the controller itself stops
+        # listing them — a forced re-pull of a stale table must not
+        # resurrect a corpse for the retry that just evicted it
+        self._dead_tags: Dict[str, float] = {}
 
     def _ensure_listener(self) -> None:
         """LongPollClient analog (``long_poll.py:68``): a daemon thread
@@ -103,9 +130,20 @@ class Router:
     def _apply_routing_info(self, info: dict) -> None:
         with self._lock:
             self._last_refresh = time.monotonic()
+            self._refresh_failures = 0
+            self._next_refresh_attempt = 0.0
             self._version = info["version"]
             self._max_concurrent = info["max_concurrent_queries"]
-            self._replicas = info["replicas"]
+            self._max_queued = info.get("max_queued_requests", -1)
+            self._request_timeout = info.get("request_timeout_s")
+            listed = {tag for tag, _ in info["replicas"]}
+            # drop dead-tag memory once the controller agrees (its health
+            # loop removed the replica) — tags are uuid-unique, so there
+            # is no reuse to worry about
+            self._dead_tags = {t: ts for t, ts in self._dead_tags.items()
+                               if t in listed}
+            self._replicas = [(t, h) for t, h in info["replicas"]
+                              if t not in self._dead_tags]
             live = {tag for tag, _ in self._replicas}
             self._inflight = {
                 tag: refs for tag, refs in self._inflight.items() if tag in live
@@ -140,18 +178,53 @@ class Router:
             pass
 
     # ------------------------------------------------------------------
-    def _refresh(self, force: bool = False) -> None:
+    def _pull_routing_info(self):
+        """One controller round trip (split out so tests can inject
+        failures and the backoff logic stays testable)."""
         import ray_tpu
 
+        return ray_tpu.get(
+            self._controller.get_routing_info.remote(self._name),
+            timeout=ROUTING_PULL_TIMEOUT_S,
+        )
+
+    def _refresh(self, force: bool = False) -> None:
+        """TTL pull with bounded-backoff failure handling: a transient
+        controller stall must NOT poison routing.  On a failed pull the
+        stale routing table keeps serving and the next attempt backs off
+        ``base * 2^n`` up to the cap (MetricsPusher's retry shape) — a
+        `force` pull honors the backoff too, or a dead controller would
+        eat one ROUTING_PULL_TIMEOUT_S per request."""
         now = time.monotonic()
         if not force and now - self._last_refresh < ROUTE_TABLE_TTL_S:
             return
-        info = ray_tpu.get(
-            self._controller.get_routing_info.remote(self._name), timeout=30
-        )
+        if self._refresh_failures and now < self._next_refresh_attempt:
+            return  # backing off; the stale table keeps routing
+        try:
+            info = self._pull_routing_info()
+        except Exception as e:  # noqa: BLE001 — controller stall/restart:
+            # every failure mode gets the same stale-table-and-retry answer
+            with self._lock:
+                self._refresh_failures += 1
+                delay = min(
+                    REFRESH_BACKOFF_CAP_S,
+                    REFRESH_BACKOFF_BASE_S * (2 ** (self._refresh_failures - 1)),
+                )
+                self._next_refresh_attempt = time.monotonic() + delay
+                n_stale = len(self._replicas)
+            if _events.ENABLED:
+                _events.emit(
+                    "serve", "routing refresh failed",
+                    severity="WARNING", entity_id=self._name,
+                    failures=self._refresh_failures, retry_in_s=round(delay, 2),
+                    stale_replicas=n_stale,
+                    error=f"{type(e).__name__}: {e}"[:200])
+            return
         if info is None:
             with self._lock:
                 self._last_refresh = now
+                self._refresh_failures = 0
+                self._next_refresh_attempt = 0.0
                 self._replicas = []
             return
         self._apply_routing_info(info)
@@ -184,6 +257,45 @@ class Router:
             if tag is not None:
                 self._inflight.get(tag, {}).pop(oid, None)
 
+    @property
+    def request_timeout_s(self) -> Optional[float]:
+        """The deployment's default per-request deadline (config-owned;
+        None until the first routing refresh lands or when unset)."""
+        return self._request_timeout
+
+    def _shed_locked(self) -> None:
+        """Backlog at the watermark: refuse instead of queueing (lock
+        held).  Raises BackPressureError after recording the shed.  The
+        started/stopped episode pair is what doctor's ingress_shedding
+        rule reads — per-shed volume rides the counter metric, not one
+        event per refused request."""
+        self._shed_count += 1
+        if _events.ENABLED:
+            _router_metrics()["shed"].inc(tags={"deployment": self._name})
+            if not self._shedding:
+                _events.emit(
+                    "serve", "ingress shedding started",
+                    severity="WARNING", entity_id=self._name,
+                    queued=self._pending, max_queued=self._max_queued,
+                    replicas=len(self._replicas))
+        self._shedding = True
+        raise BackPressureError(self._name, self._pending, self._max_queued,
+                                retry_after_s=SHED_RETRY_AFTER_S)
+
+    def _maybe_stop_shedding_locked(self) -> None:
+        """Close the shedding episode once the backlog has drained to half
+        the watermark (hysteresis: flapping around the watermark must not
+        spray started/stopped pairs).  Lock held."""
+        if self._shedding and (
+                self._max_queued <= 0
+                or self._pending <= self._max_queued // 2):
+            self._shedding = False
+            if _events.ENABLED:
+                _events.emit(
+                    "serve", "ingress shedding stopped", severity="INFO",
+                    entity_id=self._name, queued=self._pending,
+                    shed_total=self._shed_count)
+
     def _pick(self) -> Optional[Tuple[str, Any]]:
         """Least-loaded replica under the cap, round-robin on ties (lock
         held).  None if every replica is saturated or none are RUNNING."""
@@ -210,16 +322,24 @@ class Router:
         kwargs: Dict,
         timeout: Optional[float] = 60.0,
         return_replica: bool = False,
+        deadline: Optional[float] = None,
     ):
         """Submit one request to a replica; returns the ObjectRef (or
         ``(ref, replica_handle)`` with ``return_replica`` — streaming
         responses need follow-up next_chunks calls on the SAME replica).
         Blocks while no replica is available (deployment still starting, or
-        all at max_concurrent_queries)."""
+        all at max_concurrent_queries) — up to the request's REMAINING
+        deadline when the caller passes one (``deadline`` is a
+        ``time.monotonic()`` timestamp and wins over ``timeout``: a
+        5s-budget request must not queue for the 60s default).  Raises
+        :class:`BackPressureError` instead of queueing when the queued
+        backlog has reached the deployment's ``max_queued_requests``."""
         import ray_tpu
         from ray_tpu.exceptions import GetTimeoutError
 
-        deadline = time.monotonic() + timeout if timeout is not None else None
+        if deadline is None:
+            deadline = (time.monotonic() + timeout
+                        if timeout is not None else None)
         t_arrival = time.perf_counter()
         stall_reported = False
         # traced callers (HTTP ingress root or a user trace() block): the
@@ -230,8 +350,13 @@ class Router:
         if _events.ENABLED:
             trace_ctx = _tracing.child_context(f"admission {self._name}")
         self._ensure_listener()
+        # refresh BEFORE the shed check so a just-raised watermark (or the
+        # very first call) sheds against current config, not defaults
+        self._refresh()
         force = False
         with self._lock:
+            if 0 < self._max_queued <= self._pending:
+                self._shed_locked()  # raises BackPressureError
             self._pending += 1  # queued demand, visible to the autoscaler
             self._set_queue_gauge()
         assigned = False
@@ -253,6 +378,7 @@ class Router:
                         tag, handle = picked
                         self._pending -= 1
                         self._set_queue_gauge()
+                        self._maybe_stop_shedding_locked()
                         assigned = True
                         if trace_ctx is not None:
                             token = _tracing.adopt(trace_ctx)
@@ -293,13 +419,21 @@ class Router:
                         severity="WARNING", entity_id=self._name,
                         pending=self._pending,
                         replicas=len(self._replicas))
-                if deadline is not None and time.monotonic() >= deadline:
-                    raise GetTimeoutError(
-                        f"no replica of {self._name!r} available within {timeout}s"
-                    )
+                if deadline is not None:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        raise GetTimeoutError(
+                            f"no replica of {self._name!r} available within "
+                            f"the request deadline "
+                            f"(waited {time.perf_counter() - t_arrival:.1f}s)"
+                        )
+                else:
+                    remaining = 0.5
                 if waitable:
-                    # our own backpressure: wait for one in-flight call to drain
-                    ray_tpu.wait(waitable, num_returns=1, timeout=0.5)
+                    # our own backpressure: wait for one in-flight call to
+                    # drain — never past the caller's remaining deadline
+                    ray_tpu.wait(waitable, num_returns=1,
+                                 timeout=min(0.5, max(remaining, 0.01)))
                 else:
                     # deployment still starting (or scaled to 0): poll membership
                     time.sleep(0.1)
@@ -309,6 +443,11 @@ class Router:
                 with self._lock:
                     self._pending -= 1
                     self._set_queue_gauge()
+                    # queued callers leaving via timeout also drain the
+                    # backlog — without this, an episode whose queue
+                    # expired (hung replicas, deleted deployment) would
+                    # stay an open doctor incident forever
+                    self._maybe_stop_shedding_locked()
 
     def on_replica_error(self, ref) -> None:
         """Caller observed a RayActorError from ``ref``: evict that replica
@@ -318,6 +457,7 @@ class Router:
         with self._lock:
             dead_tag = self._ref_tags.pop(oid, None)
             if dead_tag is not None:
+                self._dead_tags[dead_tag] = time.monotonic()
                 self._inflight.pop(dead_tag, None)
                 self._ref_tags = {
                     o: t for o, t in self._ref_tags.items() if t != dead_tag

@@ -56,6 +56,8 @@ class Deployment:
         ray_actor_options: Optional[Dict] = None,
         route_prefix: Optional[str] = "__unset__",
         autoscaling_config: Optional[Any] = "__unset__",
+        max_queued_requests: Optional[int] = None,
+        request_timeout_s: Optional[Any] = "__unset__",
     ) -> "Deployment":
         cfg = copy.deepcopy(self.config)
         if num_replicas is not None:
@@ -68,6 +70,10 @@ class Deployment:
             cfg.user_config = user_config
         if ray_actor_options is not None:
             cfg.ray_actor_options = dict(ray_actor_options)
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
+        if request_timeout_s != "__unset__":
+            cfg.request_timeout_s = request_timeout_s
         d = Deployment(
             self._func_or_class,
             name or self.name,
@@ -110,6 +116,8 @@ def deployment(
     ray_actor_options: Optional[Dict] = None,
     route_prefix: Optional[str] = "__auto__",
     autoscaling_config: Optional[Any] = None,
+    max_queued_requests: int = -1,
+    request_timeout_s: Optional[float] = None,
 ) -> Union[Deployment, Callable[[Callable], Deployment]]:
     """``@serve.deployment`` decorator (``api.py:251`` analog)."""
 
@@ -120,6 +128,8 @@ def deployment(
             user_config=user_config,
             ray_actor_options=dict(ray_actor_options or {}),
             autoscaling_config=_coerce_autoscaling(autoscaling_config),
+            max_queued_requests=max_queued_requests,
+            request_timeout_s=request_timeout_s,
         )
         return Deployment(
             func_or_class,
@@ -131,6 +141,58 @@ def deployment(
     if _func_or_class is not None:
         return make(_func_or_class)
     return make
+
+
+def ingress(app) -> Callable[[type], type]:
+    """Mount an ASGI application as a deployment class's HTTP surface
+    (``@serve.ingress(fastapi_app)`` analog, ``serve/api.py`` ingress).
+
+    The wrapped class's ``__call__`` feeds every routed HTTP request
+    through the ASGI protocol (scope/receive/send — see
+    ``http_util.run_asgi_app``) and returns the app's reply as a
+    :class:`Response`, so status codes and headers survive to the client.
+    The app sees the FULL request path in ``scope["path"]`` (with
+    ``root_path=""``) and can route on it; non-HTTP callers (plain
+    ``handle.remote(...)``) still reach the class's other methods
+    directly.
+
+    Usage::
+
+        @serve.deployment
+        @serve.ingress(asgi_app)
+        class MyApp:
+            def health(self):   # handle.health.remote() still works
+                return "ok"
+    """
+
+    def decorator(cls: type) -> type:
+        if not isinstance(cls, type):
+            raise TypeError(
+                "@serve.ingress decorates a class (put it UNDER "
+                "@serve.deployment); got " + repr(cls))
+
+        class ASGIIngressWrapper(cls):
+            __serve_asgi_app__ = staticmethod(app)
+
+            def __call__(self, request):
+                from ray_tpu.serve._private.http_util import (
+                    Request as _HttpRequest,
+                    run_asgi_app,
+                )
+
+                if not isinstance(request, _HttpRequest):
+                    raise TypeError(
+                        "@serve.ingress deployments serve HTTP requests; "
+                        "call named methods via handle.<method>.remote() "
+                        "for direct access")
+                return run_asgi_app(app, request)
+
+        ASGIIngressWrapper.__name__ = cls.__name__
+        ASGIIngressWrapper.__qualname__ = cls.__qualname__
+        ASGIIngressWrapper.__module__ = cls.__module__
+        return ASGIIngressWrapper
+
+    return decorator
 
 
 # ---------------------------------------------------------------------------
@@ -182,7 +244,12 @@ def start(http_options: Optional[HTTPOptions] = None, _http: bool = True) -> _Se
     http = None
     if _http:
         opts = http_options or HTTPOptions()
-        proxy = ray_tpu.remote(HTTPProxyActor).remote(opts.host, opts.port)
+        proxy = ray_tpu.remote(HTTPProxyActor).remote(
+            opts.host, opts.port,
+            async_ingress=opts.async_ingress,
+            num_exec_threads=opts.num_exec_threads,
+            max_inflight_requests=opts.max_inflight_requests,
+        )
         http = tuple(ray_tpu.get(proxy.ready.remote(), timeout=60))
     _client = _ServeClient(controller, proxy, http)
     return _client

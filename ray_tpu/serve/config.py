@@ -48,12 +48,27 @@ class DeploymentConfig:
     health_check_period_s: float = 2.0
     graceful_shutdown_timeout_s: float = 10.0
     autoscaling_config: Optional[AutoscalingConfig] = None
+    # Load-shedding watermark: a router whose queued (not-yet-assigned)
+    # backlog reaches this sheds with BackPressureError instead of queueing
+    # (HTTP: 503 + Retry-After).  -1 = unbounded (the pre-shedding
+    # behavior handle callers rely on); the reference's handle-API knob of
+    # the same name also defaults unbounded.
+    max_queued_requests: int = -1
+    # Default per-request deadline the HTTP ingress applies when the
+    # client sends no X-Serve-Deadline-S header.  None = the ingress
+    # default (INGRESS_DEFAULT_TIMEOUT_S).
+    request_timeout_s: Optional[float] = None
 
     def validate(self) -> None:
         if self.num_replicas < 0:
             raise ValueError("num_replicas must be >= 0")
         if self.max_concurrent_queries <= 0:
             raise ValueError("max_concurrent_queries must be > 0")
+        if self.max_queued_requests < -1 or self.max_queued_requests == 0:
+            raise ValueError(
+                "max_queued_requests must be -1 (unbounded) or > 0")
+        if self.request_timeout_s is not None and self.request_timeout_s <= 0:
+            raise ValueError("request_timeout_s must be > 0")
         if self.autoscaling_config is not None:
             self.autoscaling_config.validate()
 
@@ -66,6 +81,34 @@ ROUTE_TABLE_TTL_S = 1.0
 # deployment and marks it UNHEALTHY (deployment_state's backoff analog).
 MAX_CONSECUTIVE_START_FAILURES = 3
 
+# One controller pull on the routing path.  Deliberately short: a stalled
+# controller must cost a request at most this much before the router falls
+# back to its stale table and retries in the background.
+ROUTING_PULL_TIMEOUT_S = 5.0
+# Routing-refresh failure backoff (MetricsPusher-style bounded retry): the
+# stale table keeps serving while retries space out base * 2^n up to cap.
+REFRESH_BACKOFF_BASE_S = 0.2
+REFRESH_BACKOFF_CAP_S = 5.0
+
+# Ingress request defaults.  Every HTTP request carries a deadline: the
+# client's X-Serve-Deadline-S header, else the deployment's
+# request_timeout_s, else this.
+INGRESS_DEFAULT_TIMEOUT_S = 60.0
+# Replica-death retries per request (idempotent requests only); each retry
+# re-assigns to a live replica under the same deadline.
+INGRESS_MAX_RETRIES = 3
+# Retry-After value (seconds) sent with shedding 503s.
+SHED_RETRY_AFTER_S = 1.0
+
+
+def async_ingress_enabled() -> bool:
+    """The asyncio front door is the default; ``RAY_TPU_SERVE_ASYNC=0`` is
+    the escape hatch back to the stdlib ThreadingHTTPServer proxy."""
+    import os
+
+    return os.environ.get("RAY_TPU_SERVE_ASYNC", "1") not in (
+        "0", "false", "no")
+
 
 @dataclass
 class HTTPOptions:
@@ -74,6 +117,15 @@ class HTTPOptions:
     host: str = "127.0.0.1"
     port: int = 8000
     # port=0 binds an ephemeral port (test-friendly on shared machines)
+    # None -> follow RAY_TPU_SERVE_ASYNC (default on); False forces the
+    # legacy threaded proxy for this instance only
+    async_ingress: Optional[bool] = None
+    # request-executor threads for the asyncio ingress (blocking
+    # router/get work runs here; connections themselves cost no thread)
+    num_exec_threads: Optional[int] = None
+    # proxy-wide in-flight watermark: requests past it shed 503 straight
+    # from the event loop (None -> 2x exec threads)
+    max_inflight_requests: Optional[int] = None
 
 
 @dataclass
@@ -83,5 +135,7 @@ class ReplicaState:
 
     STARTING = "STARTING"
     RUNNING = "RUNNING"
+    # out of the routing set, finishing accepted work before termination
+    DRAINING = "DRAINING"
     STOPPING = "STOPPING"
     DEAD = "DEAD"

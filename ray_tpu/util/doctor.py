@@ -34,6 +34,8 @@ SPILL_COUNT = 3           # spills before "thrash"
 CHANNEL_WAIT_STUCK_S = 5.0  # one channel wait this long = stuck
 ROUTER_STALL_COUNT = 1    # saturated-router stalls (replicas > 0)
 WORKER_CHURN_COUNT = 3    # unexpected worker deaths
+DRAIN_STUCK_S = 15.0      # a drain still open this long after starting
+                          # (relative to the newest recorded event)
 SKEW_RATIO = 3.0          # slowest-node / fastest-node mean exec ratio
 SKEW_MIN_TASKS = 5        # per (task name, node) sample floor
 SKEW_MIN_DELTA_S = 0.05   # absolute mean gap floor (noise guard)
@@ -208,6 +210,89 @@ def _rule_router_saturation(events, tasks):
         rows,
         "replicas are saturated: raise num_replicas (or autoscaling "
         "max), raise max_concurrent_queries, or speed up the handler")
+
+
+def _rule_ingress_shedding(events, tasks):
+    """The serve ingress is ACTIVELY refusing work: a ``shedding
+    started`` episode (router backlog watermark or proxy in-flight cap)
+    with no later ``stopped`` for the same entity is an open overload
+    incident.  Shedding that started and stopped is the mechanism
+    working — degradation was graceful, demand receded, nothing to page
+    about — so doctor stays quiet once recovery lands."""
+    started = _rows(events, "serve", "ingress shedding started")
+    if not started:
+        return None
+    stopped = _rows(events, "serve", "ingress shedding stopped")
+    last_stop: Dict[str, float] = {}
+    for r in stopped:
+        eid = str(r.get("entity_id"))
+        last_stop[eid] = max(last_stop.get(eid, 0.0),
+                             float(r.get("ts") or 0.0))
+    open_rows: Dict[str, dict] = {}
+    for r in started:
+        eid = str(r.get("entity_id"))
+        ts = float(r.get("ts") or 0.0)
+        if ts > last_stop.get(eid, -1.0):
+            prev = open_rows.get(eid)
+            if prev is None or ts >= float(prev.get("ts") or 0.0):
+                open_rows[eid] = r
+    if not open_rows:
+        return None
+    who = ", ".join(sorted(open_rows))
+    return _finding(
+        "ingress_shedding", "WARNING",
+        f"serve ingress is shedding load on {who} — requests are being "
+        f"refused (503 + Retry-After) at the backlog watermark",
+        list(open_rows.values()),
+        "demand exceeds serving capacity: raise num_replicas (or the "
+        "autoscaling max), raise max_queued_requests if the backlog is a "
+        "burst, or speed up the handler; shedding that has stopped "
+        "clears this finding")
+
+
+def _rule_drain_stuck(events, tasks):
+    """A graceful replica drain that neither finished nor timed out long
+    after starting — in-flight requests (or live streams) are wedged on
+    a replica the controller wants gone.  Terminal events (``replica
+    drained`` / ``replica drain timeout``) close the incident; a drain
+    that TIMED OUT is also surfaced (accepted work was cut off at the
+    graceful window — the zero-lost-requests story has a hole)."""
+    starts = _rows(events, "serve", "replica draining")
+    if not starts:
+        return None
+    done = _rows(events, "serve", "replica drained")
+    timeouts = _rows(events, "serve", "replica drain timeout")
+    closed: Dict[str, float] = {}
+    for r in done + timeouts:
+        eid = str(r.get("entity_id"))
+        closed[eid] = max(closed.get(eid, 0.0), float(r.get("ts") or 0.0))
+    # "now" inside a recorded-event table is the newest row's timestamp
+    now = max((float(e.get("ts") or 0.0) for e in events), default=0.0)
+    stuck = []
+    for r in starts:
+        eid = str(r.get("entity_id"))
+        ts = float(r.get("ts") or 0.0)
+        if ts > closed.get(eid, -1.0) and now - ts >= DRAIN_STUCK_S:
+            stuck.append(r)
+    if not stuck and not timeouts:
+        return None
+    sev = "ERROR" if stuck else "WARNING"
+    summary = []
+    if stuck:
+        summary.append(
+            f"{len(stuck)} replica drain(s) open > {DRAIN_STUCK_S:.0f}s")
+    if timeouts:
+        summary.append(
+            f"{len(timeouts)} drain(s) hit the graceful window with "
+            "requests still in flight")
+    return _finding(
+        "drain_stuck", sev,
+        "graceful replica draining is not completing: "
+        + "; ".join(summary),
+        stuck + timeouts,
+        "a handler is outliving graceful_shutdown_timeout_s: shorten "
+        "request runtimes, raise the graceful window, or accept the "
+        "cutoff (the evidence rows carry the in-flight counts)")
 
 
 def _rule_worker_churn(events, tasks):
@@ -619,6 +704,8 @@ RULES = (
     _rule_split_starvation,
     _rule_spill_thrash,
     _rule_router_saturation,
+    _rule_ingress_shedding,
+    _rule_drain_stuck,
     _rule_worker_churn,
     _rule_slow_node_skew,
     _rule_recompile_storm,
