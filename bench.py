@@ -1097,6 +1097,211 @@ def run_metric_query_bench() -> dict:
     }}
 
 
+def run_proxy_overhead() -> dict:
+    """proxy_mode_overhead row: no-op task round-trip (p50/p99) and
+    1k-task throughput for an external client attached DIRECTLY
+    (client://) vs through the multi-tenant proxy's per-connection driver
+    (ray_tpu://).  Gate: the proxy's extra relay hop costs <= 25% of
+    direct-attach throughput."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.util.client import ProxyServer
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    node = global_worker.node
+    host, port = node.tcp_address
+    proxy = ProxyServer(f"tcp://{host}:{port}", node.authkey).start()
+
+    client_script = textwrap.dedent("""
+        import json, os, time
+        import ray_tpu
+
+        ray_tpu.init(os.environ["BENCH_ADDR"])
+
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        ray_tpu.get(noop.remote(), timeout=120)  # warm worker + fn ship
+        rtts = []
+        for _ in range(100):
+            t = time.perf_counter()
+            ray_tpu.get(noop.remote(), timeout=120)
+            rtts.append(time.perf_counter() - t)
+        t0 = time.perf_counter()
+        refs = [noop.remote() for _ in range(1000)]
+        ray_tpu.get(refs, timeout=300)
+        wall = time.perf_counter() - t0
+        rtts.sort()
+        print("RESULT " + json.dumps({
+            "rtt_p50_ms": round(rtts[50] * 1e3, 3),
+            "rtt_p99_ms": round(rtts[99] * 1e3, 3),
+            "throughput_tasks_per_s": round(1000 / wall, 1),
+        }), flush=True)
+    """)
+
+    def run_client(addr: str) -> dict:
+        env = dict(os.environ)
+        env["BENCH_ADDR"] = addr
+        env["RAY_TPU_AUTHKEY"] = node.authkey.hex()
+        env["JAX_PLATFORMS"] = "cpu"
+        p = subprocess.run(
+            [sys.executable, "-c", client_script], capture_output=True,
+            text=True, timeout=600, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in p.stdout.splitlines():
+            if line.startswith("RESULT "):
+                return _json.loads(line[len("RESULT "):])
+        raise RuntimeError(f"bench client failed: {p.stderr[-2000:]}")
+
+    try:
+        direct = run_client(f"client://{host}:{port}")
+        proxied = run_client(f"ray_tpu://{proxy.address[0]}:{proxy.address[1]}")
+    finally:
+        proxy.stop()
+        ray_tpu.shutdown()
+    overhead = (
+        (direct["throughput_tasks_per_s"] - proxied["throughput_tasks_per_s"])
+        / direct["throughput_tasks_per_s"])
+    return {"proxy_mode_overhead": {
+        "direct": direct,
+        "proxied": proxied,
+        "throughput_overhead_frac": round(overhead, 3),
+        "criterion": "proxied 1k-task throughput >= 75% of direct attach",
+        "passes": bool(overhead <= 0.25),
+    }}
+
+
+def run_tenant_kill_soak() -> dict:
+    """tenant_kill_soak row: two proxied tenants; tenant B runs a
+    continuous timed no-op loop while chaos SIGKILLs tenant A's driver
+    subprocess mid-soak.  Records B's task p50/p99 before/during/after
+    the kill — the isolation number the multi-tenancy scenario claims."""
+    import json as _json
+    import os
+    import subprocess
+    import sys
+    import textwrap
+    import time
+
+    import ray_tpu
+    from ray_tpu._private.worker import global_worker
+    from ray_tpu.devtools.chaos.harness import ChaosMonkey
+    from ray_tpu.util.client import ProxyServer
+
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    node = global_worker.node
+    host, port = node.tcp_address
+    proxy = ProxyServer(f"tcp://{host}:{port}", node.authkey).start()
+    addr = f"ray_tpu://{proxy.address[0]}:{proxy.address[1]}"
+    env = dict(os.environ)
+    env["BENCH_ADDR"] = addr
+    env["RAY_TPU_AUTHKEY"] = node.authkey.hex()
+    env["JAX_PLATFORMS"] = "cpu"
+    cwd = os.path.dirname(os.path.abspath(__file__))
+
+    victim = textwrap.dedent("""
+        import os, time
+        import ray_tpu
+        ray_tpu.init(os.environ["BENCH_ADDR"], namespace="soak-victim")
+
+        @ray_tpu.remote
+        class Holder:
+            def ping(self):
+                return "up"
+
+        h = Holder.options(name="victim-actor").remote()
+        ray_tpu.get(h.ping.remote(), timeout=120)
+        pins = [ray_tpu.put(bytes(64 * 1024)) for _ in range(8)]
+        print("VICTIM_READY", flush=True)
+        time.sleep(600)  # killed long before this
+    """)
+    soaker = textwrap.dedent("""
+        import json, os, time
+        import ray_tpu
+        ray_tpu.init(os.environ["BENCH_ADDR"], namespace="soak-b")
+
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        ray_tpu.get(noop.remote(), timeout=120)
+        end = time.time() + float(os.environ["SOAK_S"])
+        rows = []
+        while time.time() < end:
+            t = time.perf_counter()
+            ray_tpu.get(noop.remote(), timeout=120)
+            rows.append((time.time(), time.perf_counter() - t))
+        print("RESULT " + json.dumps(rows), flush=True)
+    """)
+
+    def pcts(vals):
+        if not vals:
+            return (None, None)
+        vals = sorted(vals)
+        return (round(vals[len(vals) // 2] * 1e3, 3),
+                round(vals[min(len(vals) - 1, int(len(vals) * 0.99))] * 1e3, 3))
+
+    soak_s = 9.0
+    vp = bp = None
+    try:
+        vp = subprocess.Popen([sys.executable, "-c", victim], env=env,
+                              cwd=cwd, stdout=subprocess.PIPE, text=True)
+        while True:
+            line = vp.stdout.readline()
+            if not line or "VICTIM_READY" in line:
+                break
+        env_b = dict(env)
+        env_b["SOAK_S"] = str(soak_s)
+        bp = subprocess.Popen([sys.executable, "-c", soaker], env=env_b,
+                              cwd=cwd, stdout=subprocess.PIPE, text=True)
+        time.sleep(soak_s / 3)
+        monkey = ChaosMonkey(node=node)
+        kill_ts = time.time()
+        rec = monkey.kill_tenant_driver(namespace="soak-victim")
+        out, _ = bp.communicate(timeout=soak_s + 120)
+        rows = None
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                rows = _json.loads(line[len("RESULT "):])
+        if rows is None:
+            raise RuntimeError("soaker produced no RESULT")
+        during_w = 2.0
+        before = [r[1] for r in rows if r[0] < kill_ts]
+        during = [r[1] for r in rows if kill_ts <= r[0] < kill_ts + during_w]
+        after = [r[1] for r in rows if r[0] >= kill_ts + during_w]
+        # the victim client itself only sleeps — its DRIVER is what died;
+        # the finally's kill cleans the orphaned client process up
+    finally:
+        for child in (vp, bp):
+            if child is not None:
+                try:
+                    child.kill()
+                except OSError:
+                    pass
+        proxy.stop()
+        ray_tpu.shutdown()
+    b50, b99 = pcts(before)
+    d50, d99 = pcts(during)
+    a50, a99 = pcts(after)
+    return {"tenant_kill_soak": {
+        "soak_s": soak_s,
+        "victim_pid": rec["pid"],
+        "tenant_b_tasks": len(rows),
+        "before_p50_ms": b50, "before_p99_ms": b99,
+        "during_p50_ms": d50, "during_p99_ms": d99,
+        "after_p50_ms": a50, "after_p99_ms": a99,
+        "criterion": "tenant B keeps completing tasks across the kill",
+        "passes": bool(during and after),
+    }}
+
+
 def _bench_model_setup():
     """Shared model/step setup for the perf-observability rows: the same
     gpt2 shape the headline row trains, with a compiled train step and a
@@ -1592,6 +1797,14 @@ def main() -> None:
     except Exception as e:
         decode_out["perf_observability_error"] = \
             f"{type(e).__name__}: {e}"[:200]
+    try:
+        decode_out.update(run_proxy_overhead())
+    except Exception as e:
+        decode_out["proxy_overhead_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
+        decode_out.update(run_tenant_kill_soak())
+    except Exception as e:
+        decode_out["tenant_kill_soak_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         decode_out.update(run_raylint_bench())
     except Exception as e:

@@ -35,6 +35,7 @@ def init(
     num_tpus: Optional[int] = None,
     resources: Optional[Dict[str, float]] = None,
     ignore_reinit_error: bool = True,
+    namespace: Optional[str] = None,
     _authkey: Optional[bytes] = None,
     _gcs_persistence_path: Optional[str] = None,
     **_kwargs,
@@ -50,6 +51,12 @@ def init(
     file a running head wrote), joins an existing cluster as an external
     driver — the ``ray.init(address=...)`` path.  The authkey comes from
     ``$RAY_TPU_AUTHKEY`` unless passed.
+
+    With ``address="ray_tpu://host:port"`` connects through the
+    multi-tenant client proxy (``ray_tpu.util.client``): the proxy spawns
+    an isolated driver subprocess for this connection, and named actors
+    default to this tenant's own ``namespace`` (its job id unless given).
+    ``namespace`` scopes named-actor registration/lookup in every mode.
     """
     from ray_tpu._private.client import CoreClient
     from ray_tpu._private.node import Node
@@ -66,6 +73,7 @@ def init(
                 return
             raise RuntimeError("ray_tpu.init() called twice")
         thin = False
+        proxied = False
         if address is not None:
             import json
             import os
@@ -77,6 +85,11 @@ def init(
                 # no shm with the cluster; object payloads ride the control
                 # socket both ways, everything else is already socket-based
                 address = "tcp://" + address[len("client://"):]
+            elif address.startswith("ray_tpu://"):
+                # multi-tenant proxy mode: thin-client object paths over a
+                # per-connection isolated driver the proxy owns
+                proxied = thin = True
+                address = "tcp://" + address[len("ray_tpu://"):]
             if address == "auto":
                 with open("/tmp/ray_tpu/last_session.json") as f:
                     sess = json.load(f)
@@ -94,7 +107,8 @@ def init(
 
             object_transfer.configure(authkey)
             node = None
-            client = CoreClient(address, authkey)
+            client = CoreClient(address, authkey,
+                                proxy_namespace=namespace, proxy=proxied)
             from ray_tpu._private import shm as _shm
 
             if not thin and _shm._SESSION_ENV not in os.environ:
@@ -109,9 +123,11 @@ def init(
             node = Node(num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
                         gcs_persistence_path=_gcs_persistence_path)
             client = CoreClient(node.address, node.authkey)
-        client.register_client()
+        ident = client.register_client(namespace=namespace)
         global_worker.mode = "driver"
         global_worker.thin_client = thin
+        global_worker.job_id = ident.get("job_id")
+        global_worker.namespace = ident.get("namespace") or namespace or "default"
         global_worker.node = node
         global_worker.client = client
         global_worker.node_id = node._head_node_id if node else "node-head"
@@ -122,8 +138,10 @@ def init(
             # the head's own ring.
             from ray_tpu._private import events as _events
 
+            origin = (f"tenant-{global_worker.job_id}" if proxied
+                      else f"driver-{_os.getpid()}")
             global_worker._events_pusher = _events.EventsPusher(
-                client.send, origin=f"driver-{_os.getpid()}",
+                client.send, origin=origin,
                 closed_fn=lambda: client.closed).start()
         atexit.register(shutdown)
 
@@ -136,6 +154,10 @@ def shutdown() -> None:
     with _init_lock:
         if not global_worker.connected:
             return
+        if global_worker.node is not None:
+            # the in-process driver's own disconnect must not run a tenant
+            # reap against the head it is about to tear down
+            global_worker.node._reap_on_disconnect = False
         pusher = getattr(global_worker, "_events_pusher", None)
         if pusher is not None:
             try:
@@ -153,6 +175,8 @@ def shutdown() -> None:
         global_worker.node = None
         global_worker.mode = None
         global_worker.thin_client = False
+        global_worker.job_id = None
+        global_worker.namespace = None
         global_worker.function_cache.clear()
         global_worker.registered_fn_ids.clear()
 

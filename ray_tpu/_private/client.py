@@ -20,30 +20,54 @@ from typing import Any, Dict, List, Optional, Tuple
 from ray_tpu._private.object_store import ObjectLocation
 
 
+def connect_control(address: str, authkey: bytes):
+    """Open a wire-wrapped control-plane connection.
+
+    Address is a unix-socket path or ``tcp://host:port`` (remote workers
+    joining the head's TCP control plane).  The handshake occasionally
+    loses a challenge race when several processes connect at once —
+    retry, it is not a credentials problem.  Shared by CoreClient and
+    the tenant driver relay (``util/client/driver.py``)."""
+    from multiprocessing import AuthenticationError
+
+    from ray_tpu._private import wire
+
+    if isinstance(address, str) and address.startswith("tcp://"):
+        host, _, port = address[len("tcp://"):].rpartition(":")
+        target, family = (host, int(port)), "AF_INET"
+    else:
+        target, family = address, "AF_UNIX"
+    for attempt in range(5):
+        try:
+            return wire.wrap(
+                MPClient(target, family=family, authkey=authkey))
+        except (AuthenticationError, OSError, EOFError):
+            if attempt == 4:
+                raise
+            time.sleep(0.05 * (attempt + 1))
+
+
 class CoreClient:
-    def __init__(self, address: str, authkey: bytes, worker_id: Optional[bytes] = None, node_id: str = ""):
-        from multiprocessing import AuthenticationError
-
-        # Address is a unix-socket path or "tcp://host:port" (remote
-        # workers joining the head's TCP control plane).
-        if isinstance(address, str) and address.startswith("tcp://"):
-            host, _, port = address[len("tcp://"):].rpartition(":")
-            target, family = (host, int(port)), "AF_INET"
-        else:
-            target, family = address, "AF_UNIX"
-        # The handshake occasionally loses a challenge race when several
-        # processes connect at once — retry, it is not a credentials problem.
-        from ray_tpu._private import wire
-
-        for attempt in range(5):
-            try:
-                self.conn = wire.wrap(
-                    MPClient(target, family=family, authkey=authkey))
-                break
-            except (AuthenticationError, OSError, EOFError):
-                if attempt == 4:
-                    raise
-                time.sleep(0.05 * (attempt + 1))
+    def __init__(self, address: str, authkey: bytes, worker_id: Optional[bytes] = None, node_id: str = "",
+                 proxy_namespace: Optional[str] = None, proxy: bool = False):
+        self.conn = connect_control(address, authkey)
+        if proxy:
+            # multi-tenant proxy mode (ray_tpu://): ask the proxy to spawn
+            # this connection's isolated driver subprocess, then the conn
+            # becomes a transparent pipe to the head.  Done BEFORE the
+            # recv loop starts — the handshake owns the socket.
+            self.conn.send({"type": "proxy_hello",
+                            "namespace": proxy_namespace})
+            reply = self.conn.recv()
+            mtype = reply.get("type")
+            if mtype == "proxy_ready":
+                pass  # this conn is now a pipe to our isolated driver
+            elif mtype == "proxy_error":
+                raise ConnectionError(
+                    f"proxy refused connection: {reply.get('error')}")
+            else:
+                raise ConnectionError(
+                    f"unexpected proxy handshake reply: {reply!r}")
         self.send_lock = threading.Lock()
         self._req_ids = itertools.count(1)
         self._pending: Dict[int, dict] = {}
@@ -197,8 +221,22 @@ class CoreClient:
         return reply
 
     # -- API ---------------------------------------------------------------
-    def register_client(self) -> None:
-        self.send({"type": "register_client"})
+    def register_client(self, namespace: Optional[str] = None,
+                        job_name: Optional[str] = None) -> dict:
+        """Register this driver and learn its identity: the head assigns a
+        job id and resolves the namespace (multi-tenancy attribution —
+        everything this connection creates is owned by that job).  In
+        proxy mode the per-connection driver subprocess enriches this
+        frame in flight with its own pid/namespace."""
+        import os as _os
+
+        reply = self.request({
+            "type": "register_client",
+            "namespace": namespace,
+            "job_name": job_name,
+            "pid": _os.getpid(),
+        }, timeout=60)
+        return reply["value"]
 
     def _pubsub_dispatch(self, msg: dict) -> None:
         q = self._pubsub_queue
@@ -325,8 +363,9 @@ class CoreClient:
     def remove_pg(self, pg_id: bytes) -> None:
         self.send({"type": "remove_pg", "pg_id": pg_id})
 
-    def get_actor_by_name(self, name: str):
-        return self.request({"type": "get_actor_by_name", "name": name})["value"]
+    def get_actor_by_name(self, name: str, namespace: Optional[str] = None):
+        return self.request({"type": "get_actor_by_name", "name": name,
+                             "namespace": namespace})["value"]
 
     def state_snapshot(self) -> dict:
         return self.request({"type": "state_snapshot"})["value"]

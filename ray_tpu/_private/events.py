@@ -55,6 +55,10 @@ KNOWN_SOURCES = (
     # step-phase spans, jit compile events, prefill-interference meters
     # — what `ray_tpu perf` and the doctor's perf rules read
     "perf",
+    # multi-tenancy lifecycle (util/client proxier + node.py tenant reap):
+    # tenant registered/driver spawned/driver died/reaped — what doctor's
+    # tenant_killed rule and the tenant-kill chaos scenario read
+    "client_proxy",
 )
 
 # Kill switch for the whole observability layer (events + hot-path metric

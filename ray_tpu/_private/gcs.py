@@ -35,6 +35,12 @@ class ActorInfo:
     max_task_retries: int = 0
     creation_spec: Optional[dict] = None  # kept for restart (lineage)
     death_cause: Optional[str] = None
+    # multi-tenancy: names are scoped per namespace; the owning job is the
+    # driver connection that created the actor, and non-detached actors are
+    # reaped when it disconnects (GcsActorManager OnJobFinished analog)
+    namespace: str = "default"
+    job_id: Optional[str] = None
+    lifetime: Optional[str] = None  # None | "detached"
 
 
 @dataclass
@@ -63,6 +69,9 @@ class TaskInfo:
     # distributed trace context (util.tracing): set when the submitter was
     # inside a trace() block; the timeline draws flow arrows from it
     trace_ctx: Optional[dict] = None
+    # submitting tenant (stamped from the spec): per-job attribution in
+    # the state API and `ray_tpu list tasks`
+    job_id: Optional[str] = None
 
 
 @dataclass
@@ -82,7 +91,10 @@ class GcsTables:
         self.lock = threading.RLock()
         self.kv: Dict[str, Dict[bytes, bytes]] = {}  # namespace -> key -> val
         self.actors: Dict[bytes, ActorInfo] = {}
-        self.named_actors: Dict[str, bytes] = {}
+        # (namespace, name) -> actor_id: two tenants can both own "svc"
+        # without colliding; lookups are namespace-scoped (reference
+        # GcsActorManager named_actors_ keyed the same way)
+        self.named_actors: Dict[tuple, bytes] = {}
         self.nodes: Dict[str, NodeInfo] = {}
         self.tasks: Dict[bytes, TaskInfo] = {}
         self.placement_groups: Dict[bytes, PlacementGroupInfo] = {}

@@ -405,13 +405,49 @@ class ActorRuntime:
     # in-flight method specs by task id; up to max_concurrency of them
     # (threaded/async actors — OutOfOrderActorSchedulingQueue analog)
     inflight: Dict[bytes, dict] = field(default_factory=dict)
+    # in-flight count per concurrency group (ConcurrencyGroupManager
+    # analog: each named group has its own dispatch window so a saturated
+    # default pool cannot starve e.g. health checks)
+    inflight_groups: Dict[str, int] = field(default_factory=dict)
     held: Dict[str, float] = field(default_factory=dict)
     tpu_ids: List[int] = field(default_factory=list)
     node_id: Optional[str] = None
+    # concurrency_groups pre-serialized for the spawn env (computed
+    # outside the node lock at creation; R4 keeps serialization out of
+    # locked regions)
+    groups_env: Optional[str] = None
 
     @property
     def max_concurrency(self) -> int:
         return int(self.info.creation_spec.get("max_concurrency") or 1)
+
+    @property
+    def concurrency_groups(self) -> Dict[str, int]:
+        return self.info.creation_spec.get("concurrency_groups") or {}
+
+
+@dataclass
+class ClientState:
+    """One registered driver connection (in-process driver, external
+    driver, thin client, or a proxied tenant driver).  The head attributes
+    everything the connection creates — actors, sealed objects, handle
+    pins — to its ``job_id``/``namespace`` so a disconnect can release
+    exactly what it owned (reference ``GcsJobManager`` + the proxier's
+    per-connection ``SpecificServer`` ownership)."""
+
+    job_id: str
+    namespace: str
+    conn: Any
+    pid: Optional[int] = None
+    proxied: bool = False
+    connected_at: float = field(default_factory=time.time)
+    # oids whose head-side entry holds an initial count on this client's
+    # behalf (puts, task/actor returns) — the client sends ONE remove_ref
+    # when its last local handle dies; if it never can (SIGKILL), the
+    # disconnect reap sends it instead
+    owned: set = field(default_factory=set)
+    # oids pinned via announced add_ref (deserialized borrows): oid -> n
+    pinned: Dict[bytes, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -613,6 +649,16 @@ class Node:
         self._dynamic_yields: Dict[bytes, dict] = {}
         # parked dynamic_yields long-polls: task_id -> [waiter, ...]
         self._dynamic_waiters: Dict[bytes, List[dict]] = {}
+        # multi-tenancy: registered driver connections and the job
+        # directory.  ``clients`` holds live connections only; ``_jobs``
+        # keeps (bounded) per-job metadata — namespace, pid, liveness —
+        # for audit rollups and `ray_tpu list tenants` after a driver dies.
+        self.clients: Dict[Any, ClientState] = {}
+        self._jobs: Dict[str, dict] = {}
+        self._job_counter = 0
+        # flipped off by ray_tpu.shutdown() so the in-process driver's own
+        # disconnect doesn't run a full tenant reap against a dying head
+        self._reap_on_disconnect = True
 
         total, tpus = autodetect_resources(num_cpus, num_tpus, resources)
         self._head_node_id = "node-head"
@@ -992,6 +1038,7 @@ class Node:
                     handle = self._on_register_worker(conn, msg)
                 elif mtype == "register_client":
                     is_client = True  # driver or external client connection
+                    self._on_register_client(conn, msg)
                 elif mtype == "register_node":
                     agent_node_id = self._on_register_node(conn, msg)
                 elif mtype == "worker_exited":
@@ -1042,7 +1089,102 @@ class Node:
                     logger.warning("node %s lost (agent connection closed)", agent_node_id)
                     self.remove_node_state(agent_node_id)
             elif is_client:
-                pass
+                self._on_client_disconnect(conn)
+
+    # ------------------------------------------------------------------
+    # driver/tenant connections (multi-tenancy half of GcsJobManager)
+    # ------------------------------------------------------------------
+    _MAX_JOB_RECORDS = 1024
+
+    def _on_register_client(self, conn: Connection, msg: dict) -> None:
+        """A driver registered: assign it a job id, record its namespace,
+        and reply with the identity (``get_runtime_context().job_id``).
+        Proxied tenant drivers arrive with ``proxied=True`` and the driver
+        subprocess's pid — the pid chaos kills and doctor explains."""
+        with self.lock:
+            self._job_counter += 1
+            job_id = f"job-{self._job_counter:04d}"
+            namespace = msg.get("namespace") or "default"
+            st = ClientState(
+                job_id=job_id, namespace=namespace, conn=conn,
+                pid=msg.get("pid"), proxied=bool(msg.get("proxied")))
+            self.clients[conn] = st
+            self._jobs[job_id] = {
+                "job_id": job_id, "namespace": namespace, "pid": st.pid,
+                "proxied": st.proxied, "alive": True,
+                "connected_at": st.connected_at, "job_name": msg.get("job_name"),
+            }
+            if len(self._jobs) > self._MAX_JOB_RECORDS:
+                # bounded directory: retire the oldest DEAD records first
+                for jid in [j for j, r in self._jobs.items()
+                            if not r["alive"]][:len(self._jobs) // 4]:
+                    del self._jobs[jid]
+        events_mod.emit(
+            "client_proxy",
+            f"tenant registered ({'proxied' if st.proxied else 'direct'})",
+            severity="DEBUG", entity_id=job_id, namespace=namespace,
+            pid=st.pid)
+        if msg.get("req_id") is not None:
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": {"job_id": job_id,
+                                         "namespace": namespace}})
+
+    def _on_client_disconnect(self, conn: Connection) -> None:
+        """A driver connection closed: release everything the job owned.
+        Non-detached actors it created are killed, its named entries leave
+        the namespace directory, and every object pin it held (initial
+        put/return counts + announced borrows) is dropped.  Detached
+        actors survive by design (reference Ray Client proxier semantics:
+        driver death reaps the SpecificServer and its job's state)."""
+        with self.lock:
+            st = self.clients.pop(conn, None)
+            if st is not None:
+                rec = self._jobs.get(st.job_id)
+                if rec is not None:
+                    rec["alive"] = False
+                    rec["disconnected_at"] = time.time()
+        if st is None or self._shutdown or not self._reap_on_disconnect:
+            return
+        with self.gcs.lock:
+            owned_actors = [a for a in self.gcs.actors.values()
+                            if a.job_id == st.job_id]
+        to_kill = [a for a in owned_actors
+                   if a.lifetime != "detached" and a.state != "DEAD"]
+        detached = sum(1 for a in owned_actors if a.lifetime == "detached")
+        if not to_kill and not st.owned and not st.pinned:
+            # nothing owned: a clean exit, not an incident (keeps doctor
+            # quiet for every CLI session and tidy driver shutdown)
+            events_mod.emit(
+                "client_proxy", "tenant disconnected", severity="DEBUG",
+                entity_id=st.job_id, namespace=st.namespace)
+            return
+        # the died/reaped event PAIR is the doctor's tenant_killed food:
+        # died opens the incident, reaped closes it (a crash between the
+        # two leaves an open ERROR — the reap really is wedged then)
+        events_mod.emit(
+            "client_proxy", "tenant driver died", severity="WARNING",
+            entity_id=st.job_id, namespace=st.namespace, pid=st.pid,
+            live_actors=len(to_kill))
+        for info in to_kill:
+            self.kill_actor(info.actor_id)
+        released = 0
+        for oid in list(st.owned):
+            self.registry.remove_ref(oid, reason="handle")
+            released += 1
+        for oid, n in list(st.pinned.items()):
+            self.registry.remove_ref(oid, n=n, reason="handle")
+            released += 1
+        st.owned.clear()
+        st.pinned.clear()
+        events_mod.emit(
+            "client_proxy", "tenant reaped", severity="INFO",
+            entity_id=st.job_id, namespace=st.namespace,
+            killed_actors=len(to_kill), detached_survivors=detached,
+            released_refs=released)
+        logger.info(
+            "tenant %s (namespace %s) disconnected: reaped %d actors, "
+            "released %d pins, %d detached survivors",
+            st.job_id, st.namespace, len(to_kill), released, detached)
 
     def _on_register_node(self, conn: Connection, msg: dict) -> str:
         """A node_agent joined over TCP (the raylet-registers-with-GCS path,
@@ -1176,6 +1318,9 @@ class Node:
         "is_actor_creation", "actor_id", "method_name",
         "num_returns", "return_ids", "trace_ctx", "dynamic_returns",
         "compiled_graph",
+        # tenant identity (runtime context + namespace-scoped lookups in
+        # the task) and concurrency-group routing at the worker's pools
+        "job_id", "namespace", "concurrency_group",
     )
 
     def _agent_node_or_head(self, node_id: str) -> str:
@@ -1245,7 +1390,11 @@ class Node:
         try:
             loc = store_blob(_Ref(msg["oid"]), msg["blob"],
                              is_error=msg.get("is_error", False))
-            self.seal_object(msg["oid"], loc, msg.get("contained", []))
+            client = self.clients.get(conn)
+            if client is not None:
+                client.owned.add(msg["oid"])
+            self.seal_object(msg["oid"], loc, msg.get("contained", []),
+                             client=client)
             value = True
         except Exception as e:  # noqa: BLE001 — ANY failure must reply,
             # or the client blocks on its 300 s request timeout
@@ -1286,16 +1435,23 @@ class Node:
 
     def _handle_message(self, conn: Connection, worker: Optional[WorkerHandle], msg: dict) -> None:
         mtype = msg["type"]
+        # driver connections own what they create: returns/puts/borrows are
+        # recorded on the ClientState so a disconnect releases exactly them
+        client = self.clients.get(conn) if worker is None else None
         if mtype == "submit_batch":
             # coalesced submissions from one client, in submission order
             for kind, spec in msg["batch"]:
+                if client is not None:
+                    client.owned.update(spec.get("return_ids", ()))
                 if kind == "task":
                     self.submit_task(spec)
                 else:
                     self.submit_actor_task(spec)
         elif mtype == "seal":
+            if client is not None:
+                client.owned.add(msg["oid"])
             self.seal_object(msg["oid"], msg["loc"], msg.get("contained", []),
-                             sealer=worker)
+                             sealer=worker, client=client)
         elif mtype == "get_locations":
             self._on_get_request(conn, msg, worker)
         elif mtype == "wait":
@@ -1307,6 +1463,8 @@ class Node:
                 self.seal_object(oid, loc, contained, sealer=worker)
             self._on_task_done(worker, msg)
         elif mtype == "create_actor":
+            if client is not None:
+                client.owned.update(msg["spec"].get("return_ids", ()))
             self.create_actor(msg["spec"])
         elif mtype == "kill_actor":
             self.kill_actor(msg["actor_id"], no_restart=msg.get("no_restart", True))
@@ -1333,19 +1491,37 @@ class Node:
         elif mtype == "add_ref":
             reason = msg.get("reason", "handle")
             for oid in msg["oids"]:
+                if client is not None and reason == "handle":
+                    client.pinned[oid] = client.pinned.get(oid, 0) + 1
                 self.registry.add_ref(oid, reason=reason)
         elif mtype == "remove_ref":
             reason = msg.get("reason", "handle")
             for oid in msg["oids"]:
+                if client is not None and reason == "handle":
+                    # one remove covers the client's whole local count:
+                    # either the initial owned pin or its announced borrow
+                    if oid in client.owned:
+                        client.owned.discard(oid)
+                    else:
+                        n = client.pinned.pop(oid, 1) - 1
+                        if n > 0:
+                            client.pinned[oid] = n
                 self.registry.remove_ref(oid, reason=reason)
         elif mtype == "create_pg":
             self.create_placement_group(msg["spec"])
         elif mtype == "remove_pg":
             self.remove_placement_group(msg["pg_id"])
         elif mtype == "get_actor_by_name":
+            # namespace-scoped: the caller names its namespace explicitly
+            # (client resolves from its runtime context); a tenant cannot
+            # see another namespace's entries without asking for them
+            ns_name = msg.get("namespace") or (
+                client.namespace if client is not None else "default")
             with self.lock:
-                aid = self.gcs.named_actors.get(msg["name"])
+                aid = self.gcs.named_actors.get((ns_name, msg["name"]))
                 info = self.actors[aid].info if aid in self.actors else None
+                if info is not None and info.state == "DEAD":
+                    aid = info = None  # dead actors are not lookup targets
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
                                "value": (aid, info.creation_spec.get("class_blob_id") if info else None)})
         elif mtype == "state_snapshot":
@@ -1734,6 +1910,7 @@ class Node:
     def seal_object(
         self, oid: bytes, loc: ObjectLocation, contained: List[bytes],
         sealer: Optional[WorkerHandle] = None,
+        client: Optional[ClientState] = None,
     ) -> None:
         # annotate the location with its node + object-server address so
         # any consumer anywhere can attach-or-pull ("" = head node).
@@ -1759,6 +1936,10 @@ class Node:
                 owner, owner_kind = sealer.actor_id.hex(), "actor"
             else:
                 owner, owner_kind = sealer.worker_id.hex(), "worker"
+        elif client is not None:
+            # per-tenant attribution: the job id, not an anonymous
+            # "driver" — `ray_tpu memory` then rolls bytes up per tenant
+            owner, owner_kind = client.job_id, "driver"
         else:
             owner, owner_kind = "driver", "driver"
         # contained refs are counted (and remembered for cascade-decrement
@@ -2209,6 +2390,7 @@ class Node:
                     self.gcs.tasks[spec["task_id"]] = TaskInfo(
                         task_id=spec["task_id"], name=spec.get("name", "task"),
                         trace_ctx=spec.get("trace_ctx"),
+                        job_id=spec.get("job_id"),
                     )
                 track = (
                     not spec.get("actor_id")
@@ -2903,7 +3085,14 @@ class Node:
             if w.is_actor_worker and w.actor_id in self.actors:
                 art = self.actors[w.actor_id]
                 if not is_creation:
-                    art.inflight.pop(tid, None)
+                    done_spec = art.inflight.pop(tid, None)
+                    if done_spec is not None and art.inflight_groups:
+                        g = done_spec.get("concurrency_group") or "_default"
+                        n = art.inflight_groups.get(g, 1) - 1
+                        if n > 0:
+                            art.inflight_groups[g] = n
+                        else:
+                            art.inflight_groups.pop(g, None)
                     # a concurrency slot opened: dispatch the next queued
                     # method right here (no scheduler wake — resources
                     # didn't change, only this actor's pipeline advanced)
@@ -2983,6 +3172,9 @@ class Node:
     # actors (GcsActorManager FSM analog)
     # ------------------------------------------------------------------
     def create_actor(self, spec: dict) -> None:
+        dup_of: Optional[bytes] = None
+        groups_env = (json.dumps(spec["concurrency_groups"])
+                      if spec.get("concurrency_groups") else None)
         with self.lock:
             info = ActorInfo(
                 actor_id=spec["actor_id"],
@@ -2991,16 +3183,58 @@ class Node:
                 max_restarts=spec.get("max_restarts", 0),
                 max_task_retries=spec.get("max_task_retries", 0),
                 creation_spec=spec,
+                namespace=spec.get("namespace") or "default",
+                job_id=spec.get("job_id"),
+                lifetime=spec.get("lifetime"),
             )
-            self.gcs.actors[spec["actor_id"]] = info
-            if info.name:
-                self.gcs.named_actors[info.name] = spec["actor_id"]
-            self.actors[spec["actor_id"]] = ActorRuntime(info=info)
+            with self.gcs.lock:  # see submit_task: the tenant reap and
+                # flush/snapshot iterate this dict under gcs.lock alone,
+                # so inserts must hold it too (node->gcs nesting, same as
+                # the gcs.tasks fix)
+                self.gcs.actors[spec["actor_id"]] = info
             for oid in spec["return_ids"]:
                 self.registry.create_pending(oid)
-            self._wake_scheduler()
+            if info.name:
+                key = (info.namespace, info.name)
+                existing = self.gcs.named_actors.get(key)
+                prior = self.actors.get(existing) if existing else None
+                if prior is not None and prior.info.state != "DEAD":
+                    # name collision INSIDE one namespace: fail this
+                    # creation (two tenants using the same name in their
+                    # own namespaces never reach here — distinct keys)
+                    dup_of = existing
+                    info.state = "DEAD"
+                    info.death_cause = (
+                        f"actor name {info.name!r} is already taken in "
+                        f"namespace {info.namespace!r}")
+                else:
+                    self.gcs.named_actors[key] = spec["actor_id"]
+            if dup_of is None:
+                self.actors[spec["actor_id"]] = ActorRuntime(
+                    info=info, groups_env=groups_env)
+                self._wake_scheduler()
+        if dup_of is not None:
+            from ray_tpu.exceptions import RayActorError
+
+            self._seal_error_returns(
+                spec, RayActorError(info.death_cause))
+            events_mod.emit(
+                "actor", f"{info.class_name} name collision in namespace",
+                severity="ERROR", entity_id=spec["actor_id"].hex(),
+                namespace=info.namespace)
+            return
         events_mod.emit("actor", f"{info.class_name} -> PENDING_CREATION",
                         severity="DEBUG", entity_id=spec["actor_id"].hex())
+
+    def _unregister_named_actor(self, info: ActorInfo) -> None:
+        """Drop a permanently-DEAD actor's namespace directory entry (the
+        name becomes reusable; lookups of dead actors already miss)."""
+        if not info.name:
+            return
+        with self.lock:
+            key = (info.namespace, info.name)
+            if self.gcs.named_actors.get(key) == info.actor_id:
+                del self.gcs.named_actors[key]
 
     def _schedule_actor_creations_and_tasks(self) -> None:
         spawn_failed: List[Tuple[ActorRuntime, List[dict], Exception]] = []
@@ -3031,6 +3265,10 @@ class Node:
                         extra_env["RAY_TPU_ASSIGNED_TPUS"] = extra_env["TPU_VISIBLE_CHIPS"]
                     if art.max_concurrency > 1:
                         extra_env["RAY_TPU_MAX_CONCURRENCY"] = str(art.max_concurrency)
+                    if art.groups_env:
+                        # the worker builds one bounded pool per group from
+                        # this (plus the default max_concurrency pool)
+                        extra_env["RAY_TPU_CONCURRENCY_GROUPS"] = art.groups_env
                     try:
                         proc = self._spawn_on_node(
                             ns, worker_id, spec.get("runtime_env"), extra_env
@@ -3070,6 +3308,7 @@ class Node:
                 err = RayActorError(
                     f"Actor {art.info.class_name} worker failed to spawn: {e}"
                 )
+                self._unregister_named_actor(art.info)
                 self._seal_error_returns(art.info.creation_spec, err)
                 for s in failed:
                     self._seal_error_returns(s, err)
@@ -3105,15 +3344,50 @@ class Node:
         # bounds actual execution concurrency itself (inline loop or its
         # BoundedExecutor pool), so the extra calls just wait in its local
         # queue instead of across a head round trip
-        window = art.max_concurrency + self.cfg.actor_pipeline_depth
-        while art.queue and len(art.inflight) < window:
-            spec = art.queue[0]
+        groups = art.concurrency_groups
+        if not groups:
+            window = art.max_concurrency + self.cfg.actor_pipeline_depth
+            while art.queue and len(art.inflight) < window:
+                spec = art.queue[0]
+                if not self._deps_ready(spec):
+                    self._dep_blocked_actors.add(art.info.actor_id)
+                    break
+                art.queue.popleft()
+                art.inflight[spec["task_id"]] = spec
+                self._queue_execute(w, spec, art.tpu_ids)
+            return
+        # concurrency groups: one dispatch window PER group, FIFO within a
+        # group, groups independent — a group whose window is full (or
+        # whose next method is dep-blocked) is skipped, never the others
+        # (the starvation fix: health-group calls dispatch past a
+        # saturated default group).  ``_default`` keeps max_concurrency
+        # semantics for method calls with no group.  Single left-to-right
+        # pass rebuilding the queue: popleft + append keeps this O(n)
+        # under the node lock (deque.remove mid-scan was O(n) per
+        # dispatch — quadratic exactly when a group is saturated).
+        depth = self.cfg.actor_pipeline_depth
+        blocked: set = set()
+        kept: List[dict] = []
+        for _ in range(len(art.queue)):
+            spec = art.queue.popleft()
+            g = spec.get("concurrency_group") or "_default"
+            if g in blocked:
+                kept.append(spec)  # per-group FIFO: nothing in g may pass
+                continue
+            cap = groups.get(g, art.max_concurrency)
+            if art.inflight_groups.get(g, 0) >= cap + depth:
+                blocked.add(g)
+                kept.append(spec)
+                continue
             if not self._deps_ready(spec):
                 self._dep_blocked_actors.add(art.info.actor_id)
-                break
-            art.queue.popleft()
+                blocked.add(g)
+                kept.append(spec)
+                continue
             art.inflight[spec["task_id"]] = spec
+            art.inflight_groups[g] = art.inflight_groups.get(g, 0) + 1
             self._queue_execute(w, spec, art.tpu_ids)
+        art.queue.extend(kept)
 
     def _on_actor_started(self, spec: dict, w: WorkerHandle, failed: bool, error: Optional[str]) -> None:
         with self.lock:
@@ -3149,6 +3423,7 @@ class Node:
             entity_id=spec["actor_id"].hex(), node=art.node_id)
         if failed:
             self._release_spec_pins(art.info.creation_spec)
+            self._unregister_named_actor(art.info)
 
     def submit_actor_task(self, spec: dict) -> None:
         from ray_tpu.exceptions import RayActorError
@@ -3168,6 +3443,7 @@ class Node:
                     task_id=spec["task_id"],
                     name=spec.get("name", "actor_task"),
                     trace_ctx=spec.get("trace_ctx"),
+                    job_id=spec.get("job_id"),
                 )
             art.queue.append(spec)
             # direct dispatch on the submitting connection's reader thread;
@@ -3207,6 +3483,7 @@ class Node:
             # retried methods back at the front IN their dispatch order
             art.queue.extendleft(reversed(retried))
             art.inflight.clear()
+            art.inflight_groups.clear()
             art.worker = None
             # release resources (skip CPUs a blocked method already gave
             # back through _on_blocked, or the pool double-counts them)
@@ -3243,8 +3520,10 @@ class Node:
             severity="WARNING", entity_id=w.actor_id.hex(),
             restarts=info.num_restarts)
         if info.state == "DEAD":
-            # permanently gone: creation-spec arg pins drop now
+            # permanently gone: creation-spec arg pins drop now, and the
+            # name becomes reusable in its namespace
             self._release_spec_pins(info.creation_spec)
+            self._unregister_named_actor(info)
         err = RayActorError(f"Actor {info.class_name} died: {reason}")
         for spec in failed_specs:
             self._seal_error_returns(spec, err)
@@ -3408,6 +3687,7 @@ class Node:
                 self._wake_scheduler()
         if art.info.state == "DEAD":
             self._release_spec_pins(art.info.creation_spec)
+            self._unregister_named_actor(art.info)
         err = RayActorError(f"Actor {art.info.class_name} was killed before creation")
         for spec in failed_specs:
             self._seal_error_returns(spec, err)
@@ -3656,6 +3936,20 @@ class Node:
         if what == "traces":
             self._fold_local_traces()
             return self.traces.list(limit), len(self.traces)
+        if what == "tenants":
+            # one row per driver job (live + recently dead), with actor
+            # counts per namespace — what chaos resolves pids from and
+            # what `ray_tpu list tenants` renders
+            with self.gcs.lock:
+                actor_counts: Dict[str, int] = {}
+                for a in self.gcs.actors.values():
+                    if a.job_id and a.state != "DEAD":
+                        actor_counts[a.job_id] = actor_counts.get(a.job_id, 0) + 1
+            with self.lock:
+                out = [dict(rec, actors=actor_counts.get(jid, 0))
+                       for jid, rec in self._jobs.items()]
+            out.sort(key=lambda r: r["job_id"])
+            return out[:limit], len(out)
         raise ValueError(f"unknown state table {what!r}")
 
     # ------------------------------------------------------------------
@@ -3982,6 +4276,25 @@ class Node:
         with self.gcs.lock:
             actor_names = {a.actor_id.hex(): a.class_name
                            for a in self.gcs.actors.values()}
+            actor_ns = {a.actor_id.hex(): a.namespace
+                        for a in self.gcs.actors.values()}
+            ns_actors: Dict[str, int] = {}
+            for a in self.gcs.actors.values():
+                if a.state != "DEAD":
+                    ns_actors[a.namespace] = ns_actors.get(a.namespace, 0) + 1
+        with self.lock:
+            job_ns = {jid: rec["namespace"] for jid, rec in self._jobs.items()}
+
+        def owner_namespace(owner: str, kind: str) -> str:
+            """Namespace a sealed owner rolls up under: actors carry
+            theirs, driver owners are job ids, pooled workers are shared
+            infrastructure (their seals serve whichever tenant's task ran
+            last — attributing them to one would lie)."""
+            if kind == "actor":
+                return actor_ns.get(owner, "default")
+            if kind == "driver":
+                return job_ns.get(owner, "default")
+            return "(shared)"
 
         def annotate(owner: str, kind: str):
             """(display label, owner process still alive)."""
@@ -4030,6 +4343,24 @@ class Node:
                 "orphan": not alive,
             })
         by_owner.sort(key=lambda a: -a["bytes"])
+        # per-namespace rollup: one row per tenant — pinned bytes, object
+        # and actor counts, owning jobs (ISSUE 13 satellite: one tenant's
+        # footprint reads off a single row of `ray_tpu top` / `memory`)
+        ns_rows: Dict[str, dict] = {}
+        for o in by_owner:
+            nsn = owner_namespace(o["owner"], o["owner_kind"])
+            row = ns_rows.setdefault(nsn, {
+                "namespace": nsn, "bytes": 0, "objects": 0,
+                "actors": ns_actors.get(nsn, 0), "jobs": 0})
+            row["bytes"] += o["bytes"]
+            row["objects"] += o["objects"]
+            if o["owner_kind"] == "driver":
+                row["jobs"] += 1
+        for nsn, count in ns_actors.items():
+            ns_rows.setdefault(nsn, {
+                "namespace": nsn, "bytes": 0, "objects": 0,
+                "actors": count, "jobs": 0})
+        by_namespace = sorted(ns_rows.values(), key=lambda r: -r["bytes"])
         rows = rows[:limit]  # only shipped rows need per-row annotation
         for r in rows:
             r["owner_label"], alive = annotate(r["owner"], r["owner_kind"])
@@ -4042,6 +4373,7 @@ class Node:
             "orphan_bytes": orphan_bytes,
             "num_objects": num_objects,
             "by_owner": by_owner,
+            "by_namespace": by_namespace,
             "by_pin_reason": by_reason,
             "rows": rows,
             "store": self.registry.stats(),
@@ -4110,6 +4442,7 @@ class Node:
             "tasks": task_states,
             "store": audit["store"],
             "owners": audit["by_owner"][:20],
+            "namespaces": audit["by_namespace"][:20],
             "total_pinned_bytes": audit["total_bytes"],
             "orphan_bytes": audit["orphan_bytes"],
             "tsdb": self.tsdb.stats(),

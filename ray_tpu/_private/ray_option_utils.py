@@ -17,7 +17,7 @@ TASK_OPTIONS = {
 ACTOR_OPTIONS = {
     "num_cpus", "num_tpus", "resources", "max_restarts", "max_task_retries",
     "scheduling_strategy", "name", "lifetime", "runtime_env", "memory",
-    "max_concurrency",
+    "max_concurrency", "namespace", "concurrency_groups",
 }
 
 # env_vars/working_dir apply at spawn; pip/conda build hash-keyed cached
@@ -139,6 +139,24 @@ def validate_options(opts: Dict[str, Any], for_actor: bool) -> Dict[str, Any]:
     mc = opts.get("max_concurrency")
     if mc is not None and (not isinstance(mc, int) or mc < 1):
         raise ValueError(f"max_concurrency must be an int >= 1, got {mc!r}")
+    lt = opts.get("lifetime")
+    if lt not in (None, "detached"):
+        raise ValueError(
+            f'lifetime must be None or "detached", got {lt!r}')
+    ns = opts.get("namespace")
+    if ns is not None and (not isinstance(ns, str) or not ns):
+        raise ValueError(f"namespace must be a non-empty string, got {ns!r}")
+    cg = opts.get("concurrency_groups")
+    if cg is not None:
+        if (not isinstance(cg, dict) or not cg or not all(
+                isinstance(k, str) and k and isinstance(v, int) and v >= 1
+                for k, v in cg.items())):
+            raise ValueError(
+                "concurrency_groups must be a non-empty Dict[str, int>=1] "
+                f"of group name -> max concurrency, got {cg!r}")
+        if "_default" in cg:
+            raise ValueError(
+                '"_default" is reserved (the unnamed max_concurrency pool)')
     if "runtime_env" in opts:
         validate_runtime_env(opts["runtime_env"])
     return opts

@@ -37,6 +37,16 @@ from ray_tpu._private.object_store import ObjectLocation, read_value, store_valu
 
 FN_NAMESPACE = "fn"
 
+# per-execution tenant identity (see Worker.current_job_id): contextvars
+# so the value follows the executing thread OR asyncio task, never leaks
+# between a threaded actor's concurrent methods or interleaved coroutines
+import contextvars  # noqa: E402
+
+_job_ctx: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "ray_tpu_current_job", default=None)
+_ns_ctx: "contextvars.ContextVar[Optional[str]]" = contextvars.ContextVar(
+    "ray_tpu_current_namespace", default=None)
+
 
 class _ArgPlaceholder:
     """Marks a top-level ObjectRef argument resolved by the head before dispatch."""
@@ -71,6 +81,17 @@ class Worker:
         self.current_task_id: Optional[bytes] = None
         self.current_actor_id: Optional[bytes] = None
         self.actor_instance: Any = None
+        # tenant identity: for drivers, assigned at register_client; for
+        # workers, inherited per-task from the executing spec (actor
+        # workers pin theirs at creation).  get_runtime_context() and
+        # namespace-scoped get_actor read these.  The per-task half
+        # lives in CONTEXTVARS (module-level _job_ctx/_ns_ctx): threaded
+        # actors run methods from different submitters concurrently, and
+        # async methods hop to the event-loop thread — contextvars track
+        # the executing thread AND the asyncio task, so one method never
+        # reads another's tenant.
+        self.job_id: Optional[str] = None
+        self.namespace: Optional[str] = None
         # per-thread: threaded actors run several methods at once, and each
         # thread's nested-get blocked/unblocked notifications must pair up
         self._depth_local = threading.local()
@@ -85,6 +106,22 @@ class Worker:
         # way).  Drained by flush_removals on client calls + a 1s timer.
         self._dead_handles: "deque[bytes]" = deque()
         self._flusher_started = False
+
+    @property
+    def current_job_id(self) -> Optional[str]:
+        return _job_ctx.get()
+
+    @current_job_id.setter
+    def current_job_id(self, value: Optional[str]) -> None:
+        _job_ctx.set(value)
+
+    @property
+    def current_namespace(self) -> Optional[str]:
+        return _ns_ctx.get()
+
+    @current_namespace.setter
+    def current_namespace(self, value: Optional[str]) -> None:
+        _ns_ctx.set(value)
 
     @property
     def task_depth(self) -> int:
@@ -337,6 +374,10 @@ class Worker:
         runtime_env: Optional[dict] = None,
         max_concurrency: int = 1,
         release_cpu_after_start: bool = False,
+        concurrency_group: Optional[str] = None,
+        concurrency_groups: Optional[Dict[str, int]] = None,
+        lifetime: Optional[str] = None,
+        namespace: Optional[str] = None,
     ) -> Tuple[dict, List[ObjectRef]]:
         cfg = get_config()
         if runtime_env and (runtime_env.get("working_dir")
@@ -416,9 +457,18 @@ class Worker:
             "runtime_env": runtime_env,
             "max_concurrency": max_concurrency,
             "release_cpu_after_start": release_cpu_after_start,
+            "concurrency_group": concurrency_group,
+            "concurrency_groups": concurrency_groups,
+            "lifetime": lifetime,
             # lineage edge for recursive cancellation (the reference embeds
             # the parent in the task id itself, src/ray/common/id.h)
             "parent_task_id": self.current_task_id,
+            # tenant attribution: the submitting job, inherited by nested
+            # submissions from inside tasks (current_*) or the driver's
+            # own identity; actor creation may pin an explicit namespace
+            "job_id": self.current_job_id or self.job_id,
+            "namespace": (namespace if is_actor_creation and namespace
+                          else self.current_namespace or self.namespace),
         }
         # strip default/absent fields off the wire — every consumer reads
         # optionals with .get(); a plain task's spec shrinks ~2x
@@ -487,6 +537,9 @@ def _on_cancel_message(msg: dict) -> None:
 _async_loop: Optional[asyncio.AbstractEventLoop] = None
 _async_loop_lock = threading.Lock()
 _async_sem: Optional[asyncio.Semaphore] = None
+# per-concurrency-group coroutine bounds (created on the loop thread's
+# first use of each group; setdefault keeps racing creators consistent)
+_async_group_sems: Dict[str, asyncio.Semaphore] = {}
 
 
 def _get_async_loop() -> asyncio.AbstractEventLoop:
@@ -502,7 +555,32 @@ def _get_async_loop() -> asyncio.AbstractEventLoop:
     return _async_loop
 
 
-async def _ensure_coro(awaitable, trace_ctx=None):
+_group_caps_cache: Optional[Dict[str, int]] = None
+
+
+def _concurrency_group_caps() -> Dict[str, int]:
+    """Declared concurrency groups of this (actor) worker, from the env
+    the head set at spawn (``@remote(concurrency_groups={...})``).
+    Parsed once — the env is fixed for the worker's lifetime and this
+    sits on the async-method execution path."""
+    global _group_caps_cache
+    if _group_caps_cache is None:
+        raw = os.environ.get("RAY_TPU_CONCURRENCY_GROUPS")
+        caps: Dict[str, int] = {}
+        if raw:
+            import json
+
+            try:
+                caps = {str(k): int(v) for k, v in json.loads(raw).items()}
+            except (ValueError, TypeError, AttributeError):
+                caps = {}
+        _group_caps_cache = caps
+    return _group_caps_cache
+
+
+async def _ensure_coro(awaitable, trace_ctx=None, group: Optional[str] = None,
+                       job_id: Optional[str] = None,
+                       namespace: Optional[str] = None):
     if trace_ctx is not None:
         # run_coroutine_threadsafe creates the Task with the LOOP thread's
         # context, not the submitting executor thread's — re-adopt here so
@@ -510,16 +588,32 @@ async def _ensure_coro(awaitable, trace_ctx=None):
         from ray_tpu.util import tracing
 
         tracing._current.set(trace_ctx)
+    # same re-adoption for tenant identity: the coroutine body must see
+    # the SUBMITTER's job/namespace (runtime context, get_actor default,
+    # nested-submission stamping), not the loop thread's leftovers
+    _job_ctx.set(job_id)
+    _ns_ctx.set(namespace)
     # max_concurrency must bound RUNNING coroutines, not just threads: the
     # head pipelines extra calls beyond max_concurrency (actor_pipeline_depth)
     # and an async method frees its executor thread immediately, so without
     # this gate pipelined coroutines would interleave past the user's limit
     # (an async actor declared max_concurrency=1 expects serial execution).
-    global _async_sem
-    if _async_sem is None:
-        _async_sem = asyncio.Semaphore(
-            int(os.environ.get("RAY_TPU_MAX_CONCURRENCY", "1")))
-    async with _async_sem:
+    # Concurrency groups get one semaphore EACH (the asyncio half of the
+    # reference's ConcurrencyGroupManager<FiberState>): a saturated default
+    # group never starves a named group's coroutines.
+    caps = _concurrency_group_caps()
+    if group is not None and group in caps:
+        sem = _async_group_sems.get(group)
+        if sem is None:
+            sem = _async_group_sems.setdefault(
+                group, asyncio.Semaphore(caps[group]))
+    else:
+        global _async_sem
+        if _async_sem is None:
+            _async_sem = asyncio.Semaphore(
+                int(os.environ.get("RAY_TPU_MAX_CONCURRENCY", "1")))
+        sem = _async_sem
+    async with sem:
         return await awaitable
 
 
@@ -584,6 +678,11 @@ def _execute_task(msg: dict) -> None:
         os.environ.pop("TPU_VISIBLE_CHIPS", None)
         os.environ.pop("RAY_TPU_ASSIGNED_TPUS", None)
     w.current_task_id = spec["task_id"]
+    # tenant context: nested submissions and get_runtime_context() inside
+    # this task see the submitting job/namespace (set even when absent so
+    # a pooled worker never leaks the previous tenant's identity)
+    w.current_job_id = spec.get("job_id")
+    w.current_namespace = spec.get("namespace")
     # continue the submitter's trace: nested submissions from this thread
     # chain under it (tracing_helper.py span-resume analog).  Set even when
     # None — a pooled worker must not leak the previous task's context.
@@ -613,6 +712,11 @@ def _execute_task(msg: dict) -> None:
             finally:
                 w.task_depth -= 1
             w.current_actor_id = spec["actor_id"]
+            # a dedicated actor worker belongs to its actor's tenant for
+            # life: method calls without a job context still resolve
+            # namespace-scoped lookups against the actor's own namespace
+            w.job_id = spec.get("job_id") or w.job_id
+            w.namespace = spec.get("namespace") or w.namespace
             results = [None]
         elif spec.get("compiled_graph"):
             # compiled-graph control op (dag/compiled.py): a shipped
@@ -639,7 +743,11 @@ def _execute_task(msg: dict) -> None:
                     # the loop, not the executor pool — 1000 awaiting calls
                     # cost 1000 loop tasks, not 1000 threads.
                     fut = asyncio.run_coroutine_threadsafe(
-                        _ensure_coro(out, spec.get("trace_ctx")), _get_async_loop()
+                        _ensure_coro(out, spec.get("trace_ctx"),
+                                     spec.get("concurrency_group"),
+                                     spec.get("job_id"),
+                                     spec.get("namespace")),
+                        _get_async_loop()
                     )
                     with _async_futs_lock:
                         _async_futs[spec["task_id"]] = fut
@@ -681,7 +789,10 @@ def _execute_task(msg: dict) -> None:
                 out = fn(*args, **kwargs)
                 if inspect.isawaitable(out):  # async remote function
                     out = asyncio.run_coroutine_threadsafe(
-                        _ensure_coro(out, spec.get("trace_ctx")), _get_async_loop()
+                        _ensure_coro(out, spec.get("trace_ctx"),
+                                     None, spec.get("job_id"),
+                                     spec.get("namespace")),
+                        _get_async_loop()
                     ).result()
                 if spec.get("dynamic_returns"):
                     out = _stream_dynamic_returns(w, spec, out)
@@ -898,9 +1009,16 @@ def main() -> None:
     # Threaded/async actor support: with max_concurrency > 1 the head
     # pipelines up to N methods at us; a BoundedExecutor-analog pool runs
     # them concurrently (creation always runs inline, before any method).
+    # Declared concurrency groups each get their OWN bounded pool
+    # (ConcurrencyGroupManager<BoundedExecutor> analog) — and force the
+    # default lane through a pool too, even at max_concurrency=1:
+    # executing the default group inline on this loop thread would stop
+    # message draining and starve the named groups it exists to protect.
     max_concurrency = int(os.environ.get("RAY_TPU_MAX_CONCURRENCY", "1"))
+    group_caps = _concurrency_group_caps()
     pool = None
-    if max_concurrency > 1:
+    group_pools: Dict[str, Any] = {}
+    if max_concurrency > 1 or group_caps:
         from concurrent.futures import ThreadPoolExecutor
 
         # Threads are created lazily; async methods release their thread as
@@ -909,6 +1027,12 @@ def main() -> None:
         pool = ThreadPoolExecutor(
             max_workers=max_concurrency, thread_name_prefix="actor-exec"
         )
+        for gname, cap in group_caps.items():
+            # one pool per group: FIFO within the group (a single executor
+            # queue), non-interfering across groups (disjoint threads)
+            group_pools[gname] = ThreadPoolExecutor(
+                max_workers=max(1, cap), thread_name_prefix=f"cg-{gname}"
+            )
 
     client._cancel_handler = _on_cancel_message
 
@@ -960,7 +1084,11 @@ def main() -> None:
                     and spec.get("actor_id") is not None
                     and not spec.get("is_actor_creation")
                 ):
-                    pool.submit(_execute_task, msg)
+                    # route to the method's concurrency group's pool;
+                    # unknown/absent group -> default pool
+                    target = group_pools.get(
+                        spec.get("concurrency_group"), pool)
+                    target.submit(_execute_task, msg)
                 else:
                     _execute_task(msg)
         except KeyboardInterrupt:
@@ -986,6 +1114,8 @@ def main() -> None:
             continue
     if pool is not None:
         pool.shutdown(wait=False)
+    for gp in group_pools.values():
+        gp.shutdown(wait=False)
     if _profiler is not None:
         _dump_profile()  # os._exit skips atexit
     _events_pusher.stop()  # final ship + crash-dump before the hard exit

@@ -10,7 +10,7 @@ gives a single total order, which is strictly stronger).
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import cloudpickle
 
@@ -20,23 +20,37 @@ from ray_tpu._private.worker import global_worker
 
 
 class ActorMethod:
-    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1):
+    def __init__(self, handle: "ActorHandle", method_name: str, num_returns: int = 1,
+                 concurrency_group: Optional[str] = None):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
         return self._handle._submit_method(
-            self._method_name, args, kwargs, self._num_returns
+            self._method_name, args, kwargs, self._num_returns,
+            concurrency_group=self._concurrency_group,
         )
 
-    def options(self, num_returns: int = 1, **_):
+    def options(self, num_returns: int = 1,
+                concurrency_group: Optional[str] = None, **_):
         if num_returns == "dynamic":
             raise ValueError('num_returns="dynamic" is not supported for '
                              "actor methods")
         if not isinstance(num_returns, int) or num_returns < 1:
             raise ValueError(f"num_returns must be an int >= 1, got {num_returns!r}")
-        return ActorMethod(self._handle, self._method_name, num_returns)
+        if concurrency_group is not None:
+            declared = self._handle._concurrency_groups
+            # validated only when the handle carries the declaration (a
+            # deserialized handle may not); the worker routes unknown
+            # groups to the default pool
+            if declared and concurrency_group not in declared:
+                raise ValueError(
+                    f"unknown concurrency group {concurrency_group!r}; "
+                    f"declared: {sorted(declared)}")
+        return ActorMethod(self._handle, self._method_name, num_returns,
+                           concurrency_group=concurrency_group)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -45,10 +59,14 @@ class ActorMethod:
 
 
 class ActorHandle:
-    def __init__(self, actor_id: bytes, class_name: str, method_num_returns: Optional[Dict[str, int]] = None):
+    def __init__(self, actor_id: bytes, class_name: str, method_num_returns: Optional[Dict[str, int]] = None,
+                 concurrency_groups: Optional[Tuple[str, ...]] = None):
         self._actor_id = actor_id
         self._class_name = class_name
         self._method_num_returns = method_num_returns or {}
+        # declared concurrency-group NAMES (for method.options validation);
+        # the sizes live head-side in the creation spec
+        self._concurrency_groups = tuple(concurrency_groups or ())
 
     @property
     def _id_hex(self) -> str:
@@ -59,7 +77,8 @@ class ActorHandle:
             raise AttributeError(item)
         return ActorMethod(self, item, self._method_num_returns.get(item, 1))
 
-    def _submit_method(self, method_name: str, args, kwargs, num_returns: int):
+    def _submit_method(self, method_name: str, args, kwargs, num_returns: int,
+                       concurrency_group: Optional[str] = None):
         w = global_worker
         spec, return_refs = w.build_task_spec(
             name=f"{self._class_name}.{method_name}",
@@ -70,6 +89,7 @@ class ActorHandle:
             resources={},
             actor_id=self._actor_id,
             method_name=method_name,
+            concurrency_group=concurrency_group,
         )
         w.client.submit_actor_task(spec)
         return return_refs[0] if num_returns == 1 else return_refs
@@ -98,14 +118,17 @@ class ActorHandle:
         return return_refs[0]
 
     def __reduce__(self):
-        return (_rebuild_handle, (self._actor_id, self._class_name, self._method_num_returns))
+        return (_rebuild_handle, (self._actor_id, self._class_name,
+                                  self._method_num_returns,
+                                  self._concurrency_groups))
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:8]})"
 
 
-def _rebuild_handle(actor_id, class_name, mnr):
-    return ActorHandle(actor_id, class_name, mnr)
+def _rebuild_handle(actor_id, class_name, mnr, concurrency_groups=()):
+    return ActorHandle(actor_id, class_name, mnr,
+                       concurrency_groups=concurrency_groups)
 
 
 class ActorClass:
@@ -168,6 +191,7 @@ class ActorClass:
         # (reference semantics); an explicit num_cpus is held for life.
         cpu_defaulted = options.get("num_cpus") is None
         resources = ray_option_utils.resources_from_options(options, default_num_cpus=1)
+        concurrency_groups = options.get("concurrency_groups")
         spec, return_refs = w.build_task_spec(
             name=f"{self._cls.__name__}.__init__",
             fn_id=fn_id,
@@ -184,9 +208,15 @@ class ActorClass:
             runtime_env=options.get("runtime_env"),
             max_concurrency=max_concurrency,
             release_cpu_after_start=cpu_defaulted,
+            concurrency_groups=concurrency_groups,
+            lifetime=options.get("lifetime"),
+            namespace=options.get("namespace"),
         )
         w.client.create_actor(spec)
-        return ActorHandle(actor_id, self._cls.__name__)
+        return ActorHandle(
+            actor_id, self._cls.__name__,
+            concurrency_groups=tuple(concurrency_groups or ()),
+        )
 
 
 class _ActorClassWrapper:
@@ -203,10 +233,19 @@ class _ActorClassWrapper:
         return ClassNode(self._ac, args, kwargs, self._options)
 
 
-def get_actor(name: str) -> ActorHandle:
-    """Look up a named actor (``ray.get_actor`` analog)."""
+def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+    """Look up a named actor (``ray.get_actor`` analog).
+
+    Lookups are namespace-scoped: with no ``namespace`` the caller's own
+    is used (the driver's, or — inside a task/actor — the submitting
+    job's), so one tenant's names never resolve to another tenant's
+    actors.  A name that only exists in a different namespace raises
+    ``ValueError`` exactly like a missing one."""
     w = global_worker
-    aid, _ = w.client.get_actor_by_name(name)
+    ns = (namespace or w.current_namespace or w.namespace or "default")
+    aid, _ = w.client.get_actor_by_name(name, namespace=ns)
     if aid is None:
-        raise ValueError(f"Failed to look up actor with name '{name}'")
+        raise ValueError(
+            f"Failed to look up actor with name '{name}' in namespace "
+            f"'{ns}'")
     return ActorHandle(aid, name)

@@ -189,9 +189,11 @@ def serve_replica_scaler(controller=None) -> Callable[[str, int], None]:
     def scale(deployment: str, delta: int) -> None:
         nonlocal controller
         if controller is None:
-            from ray_tpu.serve._private.controller import CONTROLLER_NAME
+            from ray_tpu.serve._private.controller import (
+                CONTROLLER_NAME, SERVE_NAMESPACE)
 
-            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                           namespace=SERVE_NAMESPACE)
         ray_tpu.get(
             controller.scale_deployment.remote(deployment, delta=delta),
             timeout=30)

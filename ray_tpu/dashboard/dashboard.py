@@ -474,10 +474,12 @@ class Dashboard:
             # the declarative goal config last applied over PUT (empty if
             # serve is down or nothing was config-deployed)
             import ray_tpu
-            from ray_tpu.serve._private.controller import CONTROLLER_NAME
+            from ray_tpu.serve._private.controller import (
+                CONTROLLER_NAME, SERVE_NAMESPACE)
 
             try:
-                controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                               namespace=SERVE_NAMESPACE)
                 return _jsonable(ray_tpu.get(
                     controller.get_deploy_config.remote(), timeout=10) or {})
             except Exception:
@@ -682,10 +684,12 @@ class Dashboard:
         No controller -> {}; a broken/slow controller -> explicit error
         payload (an operator must be able to tell the two apart)."""
         import ray_tpu
-        from ray_tpu.serve._private.controller import CONTROLLER_NAME
+        from ray_tpu.serve._private.controller import (
+            CONTROLLER_NAME, SERVE_NAMESPACE)
 
         try:
-            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                           namespace=SERVE_NAMESPACE)
         except Exception:
             return {}  # serve not running
         try:

@@ -206,10 +206,12 @@ class ChaosMonkey:
         and emulated-multihost replicas alike — the worker process just
         dies, exactly like a preempted host."""
         import ray_tpu
-        from ray_tpu.serve._private.controller import CONTROLLER_NAME
+        from ray_tpu.serve._private.controller import (
+            CONTROLLER_NAME, SERVE_NAMESPACE)
 
         if controller is None:
-            controller = ray_tpu.get_actor(CONTROLLER_NAME)
+            controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                           namespace=SERVE_NAMESPACE)
         info = ray_tpu.get(
             controller.get_routing_info.remote(deployment), timeout=10)
         if not info or not info["replicas"]:
@@ -229,6 +231,30 @@ class ChaosMonkey:
         os.kill(pid, signal.SIGKILL)
         return self._record("kill_replica", tag, pid=pid,
                             deployment=deployment)
+
+    def kill_tenant_driver(self, job_id: Optional[str] = None,
+                           namespace: Optional[str] = None) -> dict:
+        """SIGKILL one tenant's proxied driver subprocess (the isolated
+        per-connection driver the client proxy spawned).  The pid comes
+        from the head's tenant directory — the driver registered it at
+        connect — so the kill is indistinguishable from the tenant's
+        driver host dying: the head sees the client connection drop and
+        reaps everything the job owned while other tenants keep running."""
+        with self.node.lock:
+            cands = [dict(rec) for rec in self.node._jobs.values()
+                     if rec["alive"] and rec.get("proxied") and rec.get("pid")
+                     and (job_id is None or rec["job_id"] == job_id)
+                     and (namespace is None
+                          or rec["namespace"] == namespace)]
+        if not cands:
+            raise RuntimeError(
+                f"chaos: no live proxied tenant driver "
+                f"(job_id={job_id!r}, namespace={namespace!r})")
+        rec = (cands[0] if job_id or namespace
+               else self._rng.choice(sorted(cands, key=lambda r: r["job_id"])))
+        os.kill(int(rec["pid"]), signal.SIGKILL)
+        return self._record("kill_tenant_driver", rec["job_id"],
+                            pid=rec["pid"], namespace=rec["namespace"])
 
     def _slice_of(self, node_id: str) -> Optional[str]:
         with self.node.lock:
@@ -261,6 +287,11 @@ class ChaosMonkey:
             # target names the DEPLOYMENT; the replica is seeded-random
             return self.kill_serve_replica(
                 inj.target, replica_tag=inj.params.get("replica_tag"))
+        if inj.op == "kill_tenant_driver":
+            # target names the tenant JOB (empty = seeded-random tenant)
+            return self.kill_tenant_driver(
+                job_id=inj.target or None,
+                namespace=inj.params.get("namespace"))
         target = inj.target or self.pick(inj.slice_id)
         if inj.op == "sigkill":
             return self.sigkill(target, slice_id=inj.slice_id)

@@ -198,7 +198,12 @@ class LintConfig:
     # recv loops).  Modules listed as clientbound senders have their sends
     # checked against the clientbound handler chains; everything else's
     # sends are checked against the head's chains.
-    head_handler_modules: Tuple[str, ...] = ("ray_tpu/_private/node.py",)
+    head_handler_modules: Tuple[str, ...] = (
+        "ray_tpu/_private/node.py",
+        # the client proxy is a server on the same direction: clients send
+        # proxy_hello AT it and it dispatches like the head does
+        "ray_tpu/util/client/proxier.py",
+    )
     clientbound_handler_modules: Tuple[str, ...] = (
         "ray_tpu/_private/client.py",
         "ray_tpu/_private/worker.py",
@@ -211,6 +216,10 @@ class LintConfig:
         # over the agents' control connections (agent_send) — its frames
         # go head -> agent, same direction as node.py's
         "ray_tpu/devtools/chaos/harness.py",
+        # the proxy answers the client's handshake: proxy_ready/proxy_error
+        # flow proxy -> client and are dispatched in client.py (the tenant
+        # relay in util/client/driver.py forwards only variable frames)
+        "ray_tpu/util/client/proxier.py",
     )
     # the codec rebuilds frames from protobuf — its dict literals are not
     # send sites, and its tables must not count as senders

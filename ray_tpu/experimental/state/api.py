@@ -90,6 +90,14 @@ def list_jobs(limit: int = 1000) -> List[dict]:
     return _list("jobs", limit)
 
 
+def list_tenants(limit: int = 1000) -> List[dict]:
+    """Driver jobs (tenants) with namespace, driver pid, proxied flag,
+    liveness, and live actor counts — the multi-tenancy directory (what
+    ``ray_tpu list tenants`` renders and the tenant-kill chaos op
+    resolves pids from)."""
+    return _list("tenants", limit)
+
+
 def list_events(limit: int = 1000, source: Optional[str] = None,
                 severity: Optional[str] = None) -> List[dict]:
     """Flight-recorder events from the head's cluster-wide event table

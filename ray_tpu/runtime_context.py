@@ -25,6 +25,21 @@ class RuntimeContext:
     def actor_id(self) -> Optional[bytes]:
         return global_worker.current_actor_id
 
+    @property
+    def namespace(self) -> str:
+        """The tenant namespace this code runs under: the driver's own
+        (assigned at ``init(namespace=...)``; proxied tenants default to
+        an isolated per-job namespace), or — inside a task/actor method —
+        the namespace of the job that submitted it."""
+        return (global_worker.current_namespace
+                or global_worker.namespace or "default")
+
+    @property
+    def job_id(self) -> Optional[str]:
+        """The submitting job's id (``job-NNNN``), the unit the head
+        attributes ownership/metrics to and reaps on driver death."""
+        return global_worker.current_job_id or global_worker.job_id
+
     def get_tpu_ids(self) -> List[int]:
         """Chips assigned to the current task/actor (CUDA_VISIBLE_DEVICES analog:
         the raylet exports TPU_VISIBLE_CHIPS, see node.py actor spawn)."""

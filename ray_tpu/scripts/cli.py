@@ -407,6 +407,17 @@ def _render_top(snap: dict, sort: str) -> str:
             flag = "  [ORPHAN]" if o.get("orphan") else ""
             out.append(f"{label[:39]:<40} {_fmt_bytes(o['bytes']):>10} "
                        f"{o['objects']:>8}{flag}")
+    namespaces = snap.get("namespaces") or []
+    if namespaces:
+        # per-tenant rollup: one row per namespace — a tenant's pinned
+        # bytes and live actor count read off a single line
+        out.append("")
+        out.append(f"{'NAMESPACE':<28} {'BYTES':>10} {'OBJECTS':>8} "
+                   f"{'ACTORS':>7} {'JOBS':>5}")
+        for r in namespaces[:10]:
+            out.append(f"{r['namespace'][:27]:<28} "
+                       f"{_fmt_bytes(r['bytes']):>10} {r['objects']:>8} "
+                       f"{r['actors']:>7} {r['jobs']:>5}")
     return "\n".join(out)
 
 
@@ -477,6 +488,14 @@ def cmd_memory(args) -> None:
         flag = "  [ORPHAN: owner dead]" if o.get("orphan") else ""
         print(f"{o['owner_label'][:39]:<40} {o['owner_kind']:<8} "
               f"{_fmt_bytes(o['bytes']):>10} {o['objects']:>8}{flag}")
+    namespaces = audit.get("by_namespace") or []
+    if namespaces:
+        print()
+        print(f"{'NAMESPACE':<28} {'BYTES':>10} {'OBJECTS':>8} "
+              f"{'ACTORS':>7} {'JOBS':>5}")
+        for r in namespaces:
+            print(f"{r['namespace'][:27]:<28} {_fmt_bytes(r['bytes']):>10} "
+                  f"{r['objects']:>8} {r['actors']:>7} {r['jobs']:>5}")
     rows = audit.get("rows") or []
     if rows:
         print()
@@ -636,10 +655,11 @@ def cmd_profile(args) -> None:
 def cmd_serve_status(_args) -> None:
     """``serve status`` analog over the running cluster."""
     rt = _connect()
-    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+    from ray_tpu.serve._private.controller import (
+        CONTROLLER_NAME, SERVE_NAMESPACE)
 
     try:
-        controller = rt.get_actor(CONTROLLER_NAME)
+        controller = rt.get_actor(CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
     except Exception:
         print(json.dumps({}))  # serve not running
         return
@@ -731,7 +751,7 @@ def main(argv=None) -> None:
     s = sub.add_parser("list", help="state API tables")
     s.add_argument("what", choices=["actors", "tasks", "nodes", "objects",
                                     "workers", "placement_groups", "jobs",
-                                    "traces", "slices"])
+                                    "traces", "slices", "tenants"])
     s.add_argument("--limit", type=int, default=100)
     s.set_defaults(fn=cmd_list)
 

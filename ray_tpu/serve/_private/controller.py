@@ -33,6 +33,13 @@ from ray_tpu.serve.config import (
 logger = logging.getLogger(__name__)
 
 CONTROLLER_NAME = "SERVE_CONTROLLER"
+HTTP_PROXY_NAME = "SERVE_HTTP_PROXY"
+# Cluster-singleton serve infrastructure lives in a FIXED system
+# namespace: named-actor lookups are namespace-scoped per tenant, and a
+# controller registered in the deploying driver's namespace would be
+# invisible to the dashboard/CLI/chaos (and a second tenant's
+# serve.start() would boot a second controller + proxy on the same port).
+SERVE_NAMESPACE = "serve"
 
 
 class _Replica:
@@ -229,7 +236,8 @@ class ServeController:
         from ray_tpu.serve.schema import _UNSET, import_target, parse_deploy_config
 
         schema = parse_deploy_config(config)
-        self_handle = ray_tpu.get_actor(CONTROLLER_NAME)
+        self_handle = ray_tpu.get_actor(CONTROLLER_NAME,
+                                        namespace=SERVE_NAMESPACE)
         deployed: List[str] = []
         warnings: List[str] = []
 
@@ -461,6 +469,22 @@ class ServeController:
         goal = state.goal
         tag = f"{state.name}#{uuid.uuid4().hex[:8]}"
         options = dict(goal["config"].ray_actor_options or {})
+        # control-plane concurrency group: health pings and drain polls
+        # run in their OWN bounded pool on the replica worker, so a
+        # saturated request lane can never starve them (the PR 12 ingress
+        # exposure this group exists to close).  User code still runs on
+        # the default lane: serialized unless batching raises it.
+        # MERGED into any user-declared groups — setdefault would drop
+        # "control" whenever ray_actor_options declares its own groups,
+        # and with it every health probe.
+        groups = dict(options.get("concurrency_groups") or {})
+        groups.setdefault("control", 2)
+        options["concurrency_groups"] = groups
+        # replicas are serve infrastructure managed (and explicitly
+        # killed) by the detached controller: the tenant-disconnect reap
+        # must not SIGKILL them past the graceful drain path just because
+        # the driver that deployed the app went away
+        options.setdefault("lifetime", "detached")
         if goal.get("uses_batching"):
             # @serve.batch replicas execute up to their query cap
             # concurrently so batches can form; user code still runs on
@@ -518,14 +542,15 @@ class ServeController:
             pending = None
             died = None
             try:
-                # a plain (serialized) replica queues this call behind the
-                # requests already executing/queued on it, so the full
-                # graceful window applies: when it answers, everything
-                # accepted before the drain has finished.  grace_s lets
-                # the replica keep serving stale-router racers inside the
-                # window (refusing only once a kill is imminent).
+                # control group: the drain flag flips and the polls answer
+                # even while the request lane is saturated (previously
+                # these queued behind every accepted request and a slow
+                # lane starved the drain).  grace_s lets the replica keep
+                # serving stale-router racers inside the window (refusing
+                # only once a kill is imminent).
                 st = ray_tpu.get(
-                    replica.handle.prepare_for_drain.remote(
+                    replica.handle.prepare_for_drain.options(
+                        concurrency_group="control").remote(
                         grace_s=max(deadline - time.monotonic(), 0.1)),
                     timeout=max(deadline - time.monotonic(), 0.1))
                 while (st.get("inflight", 0) > 0 or st.get("streams", 0) > 0):
@@ -533,9 +558,18 @@ class ServeController:
                         pending = st
                         break
                     time.sleep(0.1)
-                    st = ray_tpu.get(replica.handle.drain_status.remote(),
-                                     timeout=max(deadline - time.monotonic(),
-                                                 0.1))
+                    st = ray_tpu.get(replica.handle.drain_status.options(
+                        concurrency_group="control").remote(),
+                        timeout=max(deadline - time.monotonic(), 0.1))
+                if not pending:
+                    # default-lane barrier: a request ACCEPTED before the
+                    # drain but still queued at the worker is invisible to
+                    # the inflight gauge — this call rides the same FIFO
+                    # lane, so its reply proves the lane is empty (the
+                    # airtight everything-accepted-finished guarantee the
+                    # queued-behind-requests drain used to give)
+                    ray_tpu.get(replica.handle.drain_status.remote(),
+                                timeout=max(deadline - time.monotonic(), 0.1))
             except GetTimeoutError:
                 # never reached the replica inside the window — a request
                 # is still occupying its executor (the cut-off case)
@@ -593,7 +627,11 @@ class ServeController:
                     for r in state.replicas:
                         if r.state in (ReplicaState.STARTING, ReplicaState.RUNNING):
                             try:
-                                probes.append((state, r, r.handle.ping.remote()))
+                                # control group: a replica saturated with
+                                # slow requests still answers its health
+                                # probe (liveness, not busyness)
+                                probes.append((state, r, r.handle.ping.options(
+                                    concurrency_group="control").remote()))
                             except Exception:
                                 pass
             if probes:

@@ -124,9 +124,11 @@ class HTTPProxyActor:
                  num_exec_threads: Optional[int] = None,
                  max_inflight_requests: Optional[int] = None):
         import ray_tpu
-        from ray_tpu.serve._private.controller import CONTROLLER_NAME
+        from ray_tpu.serve._private.controller import (
+            CONTROLLER_NAME, SERVE_NAMESPACE)
 
-        self._controller = ray_tpu.get_actor(controller_name or CONTROLLER_NAME)
+        self._controller = ray_tpu.get_actor(
+            controller_name or CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
         self._routers: Dict[str, Router] = {}
         self._routers_lock = threading.Lock()
         self._route_table: Dict[str, str] = {}

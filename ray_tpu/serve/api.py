@@ -215,7 +215,8 @@ def start(http_options: Optional[HTTPOptions] = None, _http: bool = True) -> _Se
     (``serve.start`` analog)."""
     global _client
     import ray_tpu
-    from ray_tpu.serve._private.controller import CONTROLLER_NAME, ServeController
+    from ray_tpu.serve._private.controller import (
+        CONTROLLER_NAME, HTTP_PROXY_NAME, SERVE_NAMESPACE, ServeController)
     from ray_tpu.serve._private.http_proxy import HTTPProxyActor
 
     ray_tpu.init()
@@ -227,15 +228,20 @@ def start(http_options: Optional[HTTPOptions] = None, _http: bool = True) -> _Se
             _client = None  # stale (previous ray session); rebuild
 
     try:
-        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        controller = ray_tpu.get_actor(CONTROLLER_NAME,
+                                       namespace=SERVE_NAMESPACE)
         ray_tpu.get(controller.ping.remote(), timeout=10)
     except Exception:
         controller = (
             ray_tpu.remote(ServeController)
             # threaded executor: every router parks one 30 s long-poll here,
             # so headroom must exceed any realistic router count or the
-            # control plane wedges behind parked listeners
-            .options(name=CONTROLLER_NAME, max_concurrency=512)
+            # control plane wedges behind parked listeners.  Detached:
+            # the serve instance is cluster infrastructure — it must
+            # survive the deploying driver's disconnect (multi-tenancy
+            # reaps a job's non-detached actors when its driver dies)
+            .options(name=CONTROLLER_NAME, namespace=SERVE_NAMESPACE,
+                     max_concurrency=512, lifetime="detached")
             .remote()
         )
         ray_tpu.get(controller.ping.remote(), timeout=60)
@@ -244,13 +250,24 @@ def start(http_options: Optional[HTTPOptions] = None, _http: bool = True) -> _Se
     http = None
     if _http:
         opts = http_options or HTTPOptions()
-        proxy = ray_tpu.remote(HTTPProxyActor).remote(
-            opts.host, opts.port,
-            async_ingress=opts.async_ingress,
-            num_exec_threads=opts.num_exec_threads,
-            max_inflight_requests=opts.max_inflight_requests,
-        )
-        http = tuple(ray_tpu.get(proxy.ready.remote(), timeout=60))
+        # get-or-create like the controller: a second driver's start()
+        # must REUSE the live proxy, not bind a second one to the same
+        # port (named + detached in the serve system namespace so it is
+        # findable across tenants and survives its creator)
+        try:
+            proxy = ray_tpu.get_actor(HTTP_PROXY_NAME,
+                                      namespace=SERVE_NAMESPACE)
+            http = tuple(ray_tpu.get(proxy.ready.remote(), timeout=10))
+        except Exception:
+            proxy = ray_tpu.remote(HTTPProxyActor).options(
+                name=HTTP_PROXY_NAME, namespace=SERVE_NAMESPACE,
+                lifetime="detached").remote(
+                opts.host, opts.port,
+                async_ingress=opts.async_ingress,
+                num_exec_threads=opts.num_exec_threads,
+                max_inflight_requests=opts.max_inflight_requests,
+            )
+            http = tuple(ray_tpu.get(proxy.ready.remote(), timeout=60))
     _client = _ServeClient(controller, proxy, http)
     return _client
 

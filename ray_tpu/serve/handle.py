@@ -49,9 +49,11 @@ class DeploymentHandle:
 
             if self._controller is None:
                 import ray_tpu
-                from ray_tpu.serve._private.controller import CONTROLLER_NAME
+                from ray_tpu.serve._private.controller import (
+                    CONTROLLER_NAME, SERVE_NAMESPACE)
 
-                self._controller = ray_tpu.get_actor(CONTROLLER_NAME)
+                self._controller = ray_tpu.get_actor(
+                    CONTROLLER_NAME, namespace=SERVE_NAMESPACE)
             # one Router per (controller, deployment) per process: handles
             # are cheap to churn, and each Router owns background
             # listener/metrics threads that must stay bounded

@@ -63,6 +63,8 @@ PREFILL_INTERFERENCE_FRAC = 0.20  # interference share of decode tick time
 PREFILL_MIN_TICKS = 20       # interleaved ticks before the share is trusted
 MFU_DROP_FRAC = 0.10         # trailing-window MFU drop vs the earlier mean
 MFU_MIN_LEVEL = 0.02         # earlier-mean floor (CPU dev noise guard)
+TENANT_REAP_STUCK_S = 10.0   # death with no reap for this long = wedged
+TENANT_KILL_RECENT_S = 120.0  # explained incident stays visible this long
 
 
 def _finding(rule: str, severity: str, summary: str,
@@ -293,6 +295,53 @@ def _rule_drain_stuck(events, tasks):
         "a handler is outliving graceful_shutdown_timeout_s: shorten "
         "request runtimes, raise the graceful window, or accept the "
         "cutoff (the evidence rows carry the in-flight counts)")
+
+
+def _rule_tenant_killed(events, tasks):
+    """A tenant's driver died.  Two shapes: a death with NO matching
+    "tenant reaped" is an OPEN incident (the head's reap is wedged —
+    that job's actors and pins are leaking) and stays ERROR until the
+    reap lands; a death whose reap completed is EXPLAINED at WARNING
+    while recent (``TENANT_KILL_RECENT_S`` against the event table's own
+    clock), then the rule goes quiet — the cluster is healthy again and
+    the incident is history, not a finding."""
+    deaths = _rows(events, "client_proxy", "tenant driver died")
+    if not deaths:
+        return None
+    reaps = _rows(events, "client_proxy", "tenant reaped")
+    reaped_ts: Dict[str, float] = {}
+    for r in reaps:
+        eid = str(r.get("entity_id"))
+        reaped_ts[eid] = max(reaped_ts.get(eid, 0.0), float(r.get("ts") or 0.0))
+    now = max((float(e.get("ts") or 0.0) for e in events), default=0.0)
+    open_, recent = [], []
+    for r in deaths:
+        eid = str(r.get("entity_id"))
+        ts = float(r.get("ts") or 0.0)
+        if reaped_ts.get(eid, -1.0) < ts:
+            if now - ts >= TENANT_REAP_STUCK_S:
+                open_.append(r)
+        elif now - ts <= TENANT_KILL_RECENT_S:
+            recent.append(r)
+    if open_:
+        return _finding(
+            "tenant_killed", "ERROR",
+            f"{len(open_)} tenant driver death(s) with no completed reap: "
+            "the dead job's actors and object pins are still held",
+            open_,
+            "the head's client-disconnect reap did not run; check the "
+            "head log for the tenant's job id")
+    if recent:
+        jobs = sorted({str(r.get("entity_id")) for r in recent})
+        return _finding(
+            "tenant_killed", "WARNING",
+            f"tenant driver died and was reaped: {', '.join(jobs)} — "
+            "non-detached actors killed, pins released; other tenants "
+            "unaffected",
+            recent,
+            "no action needed unless the death was unexpected; the "
+            "chaos/events tables show whether it was injected")
+    return None
 
 
 def _rule_worker_churn(events, tasks):
@@ -706,6 +755,7 @@ RULES = (
     _rule_router_saturation,
     _rule_ingress_shedding,
     _rule_drain_stuck,
+    _rule_tenant_killed,
     _rule_worker_churn,
     _rule_slow_node_skew,
     _rule_recompile_storm,

@@ -113,6 +113,11 @@ def trace(name: str, attributes: Optional[dict] = None,
         "parent_span_id": parent["span_id"] if parent else "",
         "name": name,
     }
+    job = parent.get("job") if parent else _current_job()
+    if job:
+        # tenant identity rides the context: every span of the trace can
+        # be attributed to the submitting job (multi-tenant trace audit)
+        ctx["job"] = job
     token = _current.set(ctx)
     otel_cm = _otel_span(name, attributes)
     t0 = time.perf_counter()
@@ -123,6 +128,17 @@ def trace(name: str, attributes: Optional[dict] = None,
         _current.reset(token)
         emit_span(name, time.perf_counter() - t0, ctx, phase=phase,
                   attributes=attributes)
+
+
+def _current_job() -> Optional[str]:
+    """The running process's tenant job id (driver identity or the
+    executing task's), for root-span attribution.  Lazy import: tracing
+    must stay importable before the worker runtime is."""
+    try:
+        from ray_tpu._private.worker import global_worker
+    except ImportError:
+        return None
+    return global_worker.current_job_id or global_worker.job_id
 
 
 def _otel_span(name: str, attributes: Optional[dict]):
@@ -144,12 +160,15 @@ def child_context(name: str) -> Optional[Dict[str, str]]:
     parent = current_context()
     if parent is None:
         return None
-    return {
+    ctx = {
         "trace_id": parent["trace_id"],
         "span_id": new_span_id(),
         "parent_span_id": parent["span_id"],
         "name": name,
     }
+    if parent.get("job"):
+        ctx["job"] = parent["job"]
+    return ctx
 
 
 # outgoing-task alias kept for the original call sites (worker.py)
@@ -206,6 +225,8 @@ def emit_span(name: str, dur_s: float, ctx: Optional[Dict[str, str]],
         return
     merged = dict(attributes or ())
     merged.update(data)
+    if ctx.get("job"):
+        merged.setdefault("job", ctx["job"])
     safe = {(f"attr_{k}" if k in _RESERVED_KEYS else k): v
             for k, v in merged.items()}
     _events.emit(
