@@ -79,6 +79,13 @@ class CoreClient:
         # cross-message ordering on this connection is preserved; a 1 ms
         # side flusher bounds the latency of fire-and-forget submits.
         self._submit_buf: List[tuple] = []
+        # Borrow-announcement coalescing: handle-reason add_refs buffer
+        # here and ride ONE add_ref frame per flush tick (a get() wave
+        # over a list of refs was one frame per ref).  Ordering stays
+        # safe because every other send flushes this buffer FIRST — an
+        # add_ref can arrive early (a transient extra pin, harmless) but
+        # never after a remove_ref sent on this connection.
+        self._ref_add_buf: List[bytes] = []
         self._submit_lock = threading.Lock()
         self._flush_event = threading.Event()
         self._flush_thread: Optional[threading.Thread] = None
@@ -106,7 +113,7 @@ class CoreClient:
     # -- plumbing ----------------------------------------------------------
     def send(self, msg: dict) -> None:
         with self.send_lock:
-            if self._submit_buf:
+            if self._ref_add_buf or self._submit_buf:
                 self._flush_submits_locked()
             self.conn.send(msg)
 
@@ -121,41 +128,51 @@ class CoreClient:
         elif n == 1:
             # arm the deferred flush only on the empty->nonempty transition;
             # re-setting per submit made the flusher spin at 1 kHz
-            if self._flush_thread is None:
-                with self._submit_lock:  # two transitions racing must not
-                    if self._flush_thread is None:  # start two flushers
-                        self._flush_thread = threading.Thread(
-                            target=self._flush_loop, daemon=True,
-                            name="submit-flush")
-                        self._flush_thread.start()
-            self._flush_event.set()
+            self._arm_flusher()
 
     def flush_submits(self) -> None:
         with self.send_lock:
-            if self._submit_buf:
+            if self._ref_add_buf or self._submit_buf:
                 self._flush_submits_locked()
 
     def _flush_submits_locked(self) -> None:
-        """send_lock held.  Lock order is always send_lock -> _submit_lock."""
+        """send_lock held.  Lock order is always send_lock -> _submit_lock.
+        Refs flush BEFORE submits: a buffered borrow announcement must
+        precede any task spec that could reference the borrowed object."""
         with self._submit_lock:
+            refs, self._ref_add_buf = self._ref_add_buf, []
             batch, self._submit_buf = self._submit_buf, []
-        if batch:
-            try:
+        try:
+            if refs:
+                self.conn.send({"type": "add_ref", "oids": refs,
+                                "reason": "handle"})
+            if batch:
                 self.conn.send({"type": "submit_batch", "batch": batch})
-            except (OSError, ValueError):
-                pass  # connection gone; recv loop surfaces it
+        except (OSError, ValueError):
+            pass  # connection gone; recv loop surfaces it
 
     def _flush_loop(self) -> None:
         while not self.closed:
             self._flush_event.wait()
             time.sleep(0.001)
             self._flush_event.clear()
-            if not self._submit_buf:
+            if not self._submit_buf and not self._ref_add_buf:
                 continue  # threshold flush already drained it
             try:
                 self.flush_submits()
             except Exception:
                 pass
+
+    def _arm_flusher(self) -> None:
+        """Start/poke the deferred flusher (empty->nonempty transitions)."""
+        if self._flush_thread is None:
+            with self._submit_lock:  # two transitions racing must not
+                if self._flush_thread is None:  # start two flushers
+                    self._flush_thread = threading.Thread(
+                        target=self._flush_loop, daemon=True,
+                        name="submit-flush")
+                    self._flush_thread.start()
+        self._flush_event.set()
 
     def _recv_loop(self) -> None:
         while not self.closed:
@@ -344,10 +361,23 @@ class CoreClient:
     def notify_unblocked(self) -> None:
         self.send({"type": "unblocked"})
 
+    _REF_FLUSH_THRESHOLD = 256
+
     def add_refs(self, oids: List[bytes], reason: str = "handle") -> None:
         """``reason`` labels the pin in the head's ownership audit
         ("handle" for live ObjectRefs, "task_arg" for spec-build arg
-        pins); lifetime accounting is reason-agnostic."""
+        pins); lifetime accounting is reason-agnostic.  Handle-reason
+        announcements coalesce per flush tick (see _ref_add_buf); other
+        reasons ship inline — their senders already batch per task."""
+        if reason == "handle":
+            with self._submit_lock:
+                self._ref_add_buf.extend(oids)
+                n = len(self._ref_add_buf)
+            if n >= self._REF_FLUSH_THRESHOLD:
+                self.flush_submits()
+            elif n == len(oids):  # empty -> nonempty transition
+                self._arm_flusher()
+            return
         self.send({"type": "add_ref", "oids": oids, "reason": reason})
 
     def remove_refs(self, oids: List[bytes], reason: str = "handle") -> None:

@@ -133,6 +133,55 @@ def load() -> Optional[ctypes.CDLL]:
             getattr(lib, fn).argtypes = [ctypes.c_void_p]
         lib.rtpu_store_close.restype = None
         lib.rtpu_store_close.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        # RefIndex (head registry hot maps; see store_core.cc)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.rtpu_refs_create.restype = ctypes.c_void_p
+        lib.rtpu_refs_create.argtypes = []
+        lib.rtpu_refs_ensure.restype = None
+        lib.rtpu_refs_ensure.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64, ctypes.c_int32]
+        lib.rtpu_refs_contains.restype = ctypes.c_int
+        lib.rtpu_refs_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_refs_add.restype = None
+        lib.rtpu_refs_add.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int64]
+        lib.rtpu_refs_remove.restype = ctypes.c_int64
+        lib.rtpu_refs_remove.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.c_int64, u8p]
+        for fn in ("rtpu_refs_seal", "rtpu_refs_unseal", "rtpu_refs_erase"):
+            getattr(lib, fn).restype = ctypes.c_int
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_refs_get.restype = ctypes.c_int
+        lib.rtpu_refs_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32)]
+        lib.rtpu_refs_get_batch.restype = None
+        lib.rtpu_refs_get_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32)]
+        lib.rtpu_refs_size.restype = ctypes.c_uint64
+        lib.rtpu_refs_size.argtypes = [ctypes.c_void_p]
+        for fn in ("rtpu_refs_set_origin", "rtpu_refs_add_replica"):
+            getattr(lib, fn).restype = ctypes.c_int
+            getattr(lib, fn).argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+        for fn in ("rtpu_refs_pop_replica", "rtpu_refs_num_replicas",
+                   "rtpu_refs_clear_replicas"):
+            getattr(lib, fn).restype = ctypes.c_int
+            getattr(lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_refs_replica_mask.restype = ctypes.c_uint64
+        lib.rtpu_refs_replica_mask.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p]
+        lib.rtpu_refs_drop_slot.restype = None
+        lib.rtpu_refs_drop_slot.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.rtpu_refs_locate.restype = None
+        lib.rtpu_refs_locate.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32)]
+        lib.rtpu_refs_clear.restype = None
+        lib.rtpu_refs_clear.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -213,6 +262,111 @@ class NativeArena:
         except OSError:
             pass
         self._lib.rtpu_store_close(self._h, 1 if unlink else 0)
+
+
+class RefIndex:
+    """Thin handle over the C RefIndex (head registry hot maps).
+
+    All batch calls take a single packed ``bytes`` of concatenated
+    16-byte oids and run with the GIL released — one mutex hop per
+    MESSAGE instead of one Python-lock hop per oid.  Callers own the
+    16-byte-oid invariant (``object_store`` routes rare odd-size ids to
+    the pure-Python twin)."""
+
+    OID = 16
+    NUM_REASONS = 8
+    MAX_SLOTS = 64
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native store core unavailable")
+        self._lib = lib
+        self._h = lib.rtpu_refs_create()
+        if not self._h:
+            raise OSError("could not create native ref index")
+
+    def ensure(self, packed: bytes, n: int, reason: int) -> None:
+        self._lib.rtpu_refs_ensure(self._h, packed, n, reason)
+
+    def contains(self, oid: bytes) -> bool:
+        return self._lib.rtpu_refs_contains(self._h, oid) == 1
+
+    def add(self, packed: bytes, n: int, reason: int, delta: int) -> None:
+        self._lib.rtpu_refs_add(self._h, packed, n, reason, delta)
+
+    def remove(self, packed: bytes, n: int, reason: int,
+               delta: int) -> list:
+        """Returns the oids erased by this decrement (count<=0 while
+        sealed) — the caller reaps exactly those."""
+        buf = (ctypes.c_uint8 * (n * self.OID))()
+        dead = self._lib.rtpu_refs_remove(
+            self._h, packed, n, reason, delta, buf)
+        raw = bytes(buf)
+        return [raw[i * self.OID:(i + 1) * self.OID] for i in range(dead)]
+
+    def seal(self, oid: bytes) -> int:
+        return self._lib.rtpu_refs_seal(self._h, oid)
+
+    def unseal(self, oid: bytes) -> int:
+        return self._lib.rtpu_refs_unseal(self._h, oid)
+
+    def erase(self, oid: bytes) -> int:
+        return self._lib.rtpu_refs_erase(self._h, oid)
+
+    def get(self, oid: bytes):
+        """(count, sealed, pins[8]) or None."""
+        count = ctypes.c_int64()
+        sealed = ctypes.c_int32()
+        pins = (ctypes.c_int32 * self.NUM_REASONS)()
+        rc = self._lib.rtpu_refs_get(self._h, oid, ctypes.byref(count),
+                                     ctypes.byref(sealed), pins)
+        if rc != 0:
+            return None
+        return count.value, bool(sealed.value), list(pins)
+
+    def get_batch(self, packed: bytes, n: int):
+        """Parallel (counts, pins-rows); missing oids have count None."""
+        counts = (ctypes.c_int64 * n)()
+        pins = (ctypes.c_int32 * (n * self.NUM_REASONS))()
+        self._lib.rtpu_refs_get_batch(self._h, packed, n, counts, pins)
+        missing = -(1 << 63)
+        out_counts = [None if c == missing else c for c in counts]
+        out_pins = [pins[i * self.NUM_REASONS:(i + 1) * self.NUM_REASONS]
+                    for i in range(n)]
+        return out_counts, out_pins
+
+    def size(self) -> int:
+        return self._lib.rtpu_refs_size(self._h)
+
+    def set_origin(self, oid: bytes, slot: int) -> int:
+        return self._lib.rtpu_refs_set_origin(self._h, oid, slot)
+
+    def add_replica(self, oid: bytes, slot: int) -> int:
+        return self._lib.rtpu_refs_add_replica(self._h, oid, slot)
+
+    def pop_replica(self, oid: bytes) -> int:
+        return self._lib.rtpu_refs_pop_replica(self._h, oid)
+
+    def num_replicas(self, oid: bytes) -> int:
+        return self._lib.rtpu_refs_num_replicas(self._h, oid)
+
+    def replica_mask(self, oid: bytes) -> int:
+        return self._lib.rtpu_refs_replica_mask(self._h, oid)
+
+    def clear_replicas(self, oid: bytes) -> int:
+        return self._lib.rtpu_refs_clear_replicas(self._h, oid)
+
+    def drop_slot(self, slot: int) -> None:
+        self._lib.rtpu_refs_drop_slot(self._h, slot)
+
+    def locate(self, packed: bytes, n: int, prefer_slot: int) -> list:
+        out = (ctypes.c_int32 * n)()
+        self._lib.rtpu_refs_locate(self._h, packed, n, prefer_slot, out)
+        return list(out)
+
+    def clear(self) -> None:
+        self._lib.rtpu_refs_clear(self._h)
 
 
 def available() -> bool:

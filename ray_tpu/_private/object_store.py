@@ -114,28 +114,391 @@ class ObjectLocation:
 
 @dataclass
 class _Entry:
+    """COLD per-object metadata (payload location, owner attribution,
+    containment, the waiters' Event).  The HOT per-object state —
+    ref_count, per-reason pin counts, the replica location set — lives in
+    the session's ref index (C++ ``RefIndex`` in src/store_core, or the
+    pure-Python ``_PyRefs`` twin), keyed by the same oid."""
+
     loc: Optional[ObjectLocation] = None
     sealed: threading.Event = field(default_factory=threading.Event)
-    # handle refs (one per process holding live ObjectRefs) + contained-in-
-    # object refs + task-spec pins; starts at 1 for the creator's handle
-    ref_count: int = 1
     contained: List[bytes] = field(default_factory=list)
     last_access: float = field(default_factory=time.monotonic)
     # ownership audit (`ray memory` analog): who sealed the payload —
     # "driver", a worker id hex, or an actor id hex — plus wall-clock
-    # creation time for age and a per-reason pin breakdown.  pins is
-    # ADVISORY accounting layered over ref_count (the lifetime source of
-    # truth): it answers "why is this still alive", not "is it alive".
+    # creation time for age.
     owner: Optional[str] = None
     owner_kind: str = "unknown"  # driver | worker | actor | head
     created: float = field(default_factory=time.time)
-    pins: Dict[str, int] = field(default_factory=lambda: {"handle": 1})
-    # location SET (ownership_based_object_directory.h:37 analog): nodes
-    # holding a pulled copy of the payload, node_id -> object-server addr.
-    # Sources for future pulls; survivors when the origin node dies.
-    replicas: Dict[str, tuple] = field(default_factory=dict)
-    # round-robin cursor over {origin} + replicas for pull load-spreading
-    rr: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Ref index: the registry's hot maps (refcounts, pin reasons, location sets)
+# ---------------------------------------------------------------------------
+#
+# Pin-reason slots are fixed across the C and Python implementations; the
+# audit's pins breakdown is rebuilt from them (an unknown reason folds
+# into "other" — lifetime accounting is reason-agnostic either way).
+PIN_REASONS = ("handle", "task_arg", "contained", "lineage",
+               "pending_demand", "reserved5", "reserved6", "other")
+_REASON_IDX = {name: i for i, name in enumerate(PIN_REASONS)}
+_OTHER_IDX = len(PIN_REASONS) - 1
+
+
+def _reason_idx(reason: str) -> int:
+    return _REASON_IDX.get(reason, _OTHER_IDX)
+
+
+def _pins_dict(pins) -> Dict[str, int]:
+    return {PIN_REASONS[i]: v for i, v in enumerate(pins) if v > 0}
+
+
+class _PyRefs:
+    """Pure-Python twin of the native RefIndex (store_core.cc) — same
+    contract, same slot semantics, used when the toolchain can't build
+    the .so or ``RAY_TPU_NATIVE_REFS=0`` forces it.  One lock, batch
+    methods, erase-at-zero atomic with the decrement."""
+
+    MAX_SLOTS = 64
+
+    def __init__(self):
+        self._lock = make_lock("object_store.refs")
+        # oid -> [count, pins(list[8]), sealed, origin_slot, replica_mask, rr]
+        self._m: Dict[bytes, list] = {}
+
+    def ensure(self, oids, reason: str = "handle") -> None:
+        ridx = _reason_idx(reason)
+        with self._lock:
+            m = self._m
+            for oid in oids:
+                if oid not in m:
+                    pins = [0] * len(PIN_REASONS)
+                    pins[ridx] = 1
+                    m[oid] = [1, pins, False, -1, 0, 0]
+
+    def contains(self, oid: bytes) -> bool:
+        with self._lock:
+            return oid in self._m
+
+    def add(self, oids, reason: str, delta: int) -> None:
+        ridx = _reason_idx(reason)
+        with self._lock:
+            m = self._m
+            for oid in oids:
+                e = m.get(oid)
+                if e is not None:
+                    e[0] += delta
+                    e[1][ridx] += delta
+
+    def remove(self, oids, reason: str, delta: int) -> List[bytes]:
+        ridx = _reason_idx(reason)
+        dead: List[bytes] = []
+        with self._lock:
+            m = self._m
+            for oid in oids:
+                e = m.get(oid)
+                if e is None:
+                    continue
+                e[0] -= delta
+                left = e[1][ridx] - delta
+                e[1][ridx] = left if left > 0 else 0
+                if e[0] <= 0 and e[2]:
+                    dead.append(oid)
+                    del m[oid]
+        return dead
+
+    def seal(self, oid: bytes) -> int:
+        with self._lock:
+            e = self._m.get(oid)
+            if e is None:
+                return -1
+            e[2] = True
+            if e[0] <= 0:
+                del self._m[oid]
+                return 1
+            return 0
+
+    def unseal(self, oid: bytes) -> int:
+        with self._lock:
+            e = self._m.get(oid)
+            if e is None:
+                return -1
+            e[2] = False
+            e[3] = -1
+            e[4] = 0
+            return 0
+
+    def erase(self, oid: bytes) -> int:
+        with self._lock:
+            return 0 if self._m.pop(oid, None) is not None else -1
+
+    def get(self, oid: bytes):
+        with self._lock:
+            e = self._m.get(oid)
+            if e is None:
+                return None
+            return e[0], e[2], list(e[1])
+
+    def get_batch(self, oids):
+        counts, pins = [], []
+        with self._lock:
+            for oid in oids:
+                e = self._m.get(oid)
+                if e is None:
+                    counts.append(None)
+                    pins.append([0] * len(PIN_REASONS))
+                else:
+                    counts.append(e[0])
+                    pins.append(list(e[1]))
+        return counts, pins
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._m)
+
+    # -- location sets --
+    def set_origin(self, oid: bytes, slot: int) -> int:
+        with self._lock:
+            e = self._m.get(oid)
+            if e is None:
+                return -1
+            e[3] = slot
+            return 0
+
+    def add_replica(self, oid: bytes, slot: int) -> int:
+        if not 0 <= slot < self.MAX_SLOTS:
+            return -2
+        with self._lock:
+            e = self._m.get(oid)
+            if e is None:
+                return -1
+            if slot == e[3] or e[4] & (1 << slot):
+                return 0
+            e[4] |= 1 << slot
+            return 1
+
+    def pop_replica(self, oid: bytes) -> int:
+        with self._lock:
+            e = self._m.get(oid)
+            if e is None or not e[4]:
+                return -1
+            slot = (e[4] & -e[4]).bit_length() - 1
+            e[4] &= e[4] - 1
+            return slot
+
+    def num_replicas(self, oid: bytes) -> int:
+        with self._lock:
+            e = self._m.get(oid)
+            return -1 if e is None else bin(e[4]).count("1")
+
+    def replica_mask(self, oid: bytes) -> int:
+        with self._lock:
+            e = self._m.get(oid)
+            return 0 if e is None else e[4]
+
+    def clear_replicas(self, oid: bytes) -> int:
+        with self._lock:
+            e = self._m.get(oid)
+            if e is None:
+                return -1
+            e[4] = 0
+            return 0
+
+    def drop_slot(self, slot: int) -> None:
+        mask = ~(1 << slot)
+        with self._lock:
+            for e in self._m.values():
+                e[4] &= mask
+
+    def locate(self, oids, prefer_slot: int) -> List[int]:
+        out = []
+        with self._lock:
+            for oid in oids:
+                e = self._m.get(oid)
+                if e is None:
+                    out.append(-2)
+                    continue
+                mask = e[4]
+                if not mask:
+                    out.append(-1)
+                    continue
+                if prefer_slot >= 0:
+                    if prefer_slot == e[3]:
+                        out.append(-1)
+                        continue
+                    if mask & (1 << prefer_slot):
+                        out.append(prefer_slot)
+                        continue
+                n_rep = bin(mask).count("1")
+                idx = e[5] % (1 + n_rep)
+                e[5] += 1
+                if idx == 0:
+                    out.append(-1)
+                    continue
+                m = mask
+                for _ in range(idx - 1):
+                    m &= m - 1
+                out.append((m & -m).bit_length() - 1)
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._m.clear()
+
+
+class _NativeRefs:
+    """GIL-released C ref index.  Batch calls pack 16-byte oids into one
+    contiguous buffer (one mutex hop per message); the rare odd-size id
+    (tests, fixed sentinel ids) routes to an embedded pure-Python twin so
+    the contract holds for every key."""
+
+    def __init__(self):
+        from ray_tpu._private import native
+
+        self._ix = native.RefIndex()
+        self._odd = _PyRefs()
+
+    @staticmethod
+    def _split(oids):
+        """(packed-16B-bytes, n16, odd-list) preserving per-group order."""
+        n = len(oids)
+        if all(len(o) == 16 for o in oids):
+            # total-length alone can't gate this: a mixed batch (8B+24B)
+            # sums to n*16 and would re-chunk into garbage keys
+            return b"".join(oids), n, ()
+        std = [o for o in oids if len(o) == 16]
+        odd = [o for o in oids if len(o) != 16]
+        return b"".join(std), len(std), odd
+
+    def ensure(self, oids, reason: str = "handle") -> None:
+        packed, n, odd = self._split(oids)
+        if n:
+            self._ix.ensure(packed, n, _reason_idx(reason))
+        if odd:
+            self._odd.ensure(odd, reason)
+
+    def contains(self, oid: bytes) -> bool:
+        if len(oid) == 16:
+            return self._ix.contains(oid)
+        return self._odd.contains(oid)
+
+    def add(self, oids, reason: str, delta: int) -> None:
+        packed, n, odd = self._split(oids)
+        if n:
+            self._ix.add(packed, n, _reason_idx(reason), delta)
+        if odd:
+            self._odd.add(odd, reason, delta)
+
+    def remove(self, oids, reason: str, delta: int) -> List[bytes]:
+        packed, n, odd = self._split(oids)
+        dead: List[bytes] = []
+        if n:
+            dead = self._ix.remove(packed, n, _reason_idx(reason), delta)
+        if odd:
+            dead.extend(self._odd.remove(odd, reason, delta))
+        return dead
+
+    def seal(self, oid: bytes) -> int:
+        if len(oid) == 16:
+            return self._ix.seal(oid)
+        return self._odd.seal(oid)
+
+    def unseal(self, oid: bytes) -> int:
+        if len(oid) == 16:
+            return self._ix.unseal(oid)
+        return self._odd.unseal(oid)
+
+    def erase(self, oid: bytes) -> int:
+        if len(oid) == 16:
+            return self._ix.erase(oid)
+        return self._odd.erase(oid)
+
+    def get(self, oid: bytes):
+        if len(oid) == 16:
+            return self._ix.get(oid)
+        return self._odd.get(oid)
+
+    def get_batch(self, oids):
+        packed, n, odd = self._split(oids)
+        if not odd:
+            return self._ix.get_batch(packed, n) if n else ([], [])
+        # mixed batch (audit pages): per-oid lookups keep row order
+        counts, pins = [], []
+        for oid in oids:
+            got = self.get(oid)
+            if got is None:
+                counts.append(None)
+                pins.append([0] * len(PIN_REASONS))
+            else:
+                counts.append(got[0])
+                pins.append(got[2])
+        return counts, pins
+
+    def size(self) -> int:
+        return self._ix.size() + self._odd.size()
+
+    def set_origin(self, oid: bytes, slot: int) -> int:
+        if len(oid) == 16:
+            return self._ix.set_origin(oid, slot)
+        return self._odd.set_origin(oid, slot)
+
+    def add_replica(self, oid: bytes, slot: int) -> int:
+        if len(oid) == 16:
+            return self._ix.add_replica(oid, slot)
+        return self._odd.add_replica(oid, slot)
+
+    def pop_replica(self, oid: bytes) -> int:
+        if len(oid) == 16:
+            return self._ix.pop_replica(oid)
+        return self._odd.pop_replica(oid)
+
+    def num_replicas(self, oid: bytes) -> int:
+        if len(oid) == 16:
+            return self._ix.num_replicas(oid)
+        return self._odd.num_replicas(oid)
+
+    def replica_mask(self, oid: bytes) -> int:
+        if len(oid) == 16:
+            return self._ix.replica_mask(oid)
+        return self._odd.replica_mask(oid)
+
+    def clear_replicas(self, oid: bytes) -> int:
+        if len(oid) == 16:
+            return self._ix.clear_replicas(oid)
+        return self._odd.clear_replicas(oid)
+
+    def drop_slot(self, slot: int) -> None:
+        self._ix.drop_slot(slot)
+        self._odd.drop_slot(slot)
+
+    def locate(self, oids, prefer_slot: int) -> List[int]:
+        packed, n, odd = self._split(oids)
+        if not odd:
+            return self._ix.locate(packed, n, prefer_slot) if n else []
+        # mixed batch: per-oid dispatch keeps result order (odd-size ids
+        # go to the Python twin, same as every other method here)
+        return [
+            (self._ix.locate(oid, 1, prefer_slot)[0] if len(oid) == 16
+             else self._odd.locate((oid,), prefer_slot)[0])
+            for oid in oids
+        ]
+
+    def clear(self) -> None:
+        self._ix.clear()
+        self._odd.clear()
+
+
+def _make_refs():
+    """The session ref index: native unless unavailable or disabled."""
+    if os.environ.get("RAY_TPU_NATIVE_REFS", "1") != "0":
+        try:
+            from ray_tpu._private import native
+
+            if native.available():
+                return _NativeRefs()
+        except Exception:
+            pass
+    return _PyRefs()
 
 
 # Objects touched within this window are not spill candidates — closes the
@@ -165,6 +528,19 @@ class ObjectRegistry:
         self._capacity = capacity_bytes
         self._spill_dir = spill_dir
         self._num_spilled = 0
+        # HOT maps (refcounts, pin reasons, location sets) live here —
+        # native C++ with the GIL released, or the pure-Python twin.
+        # add_refs/remove_refs never take self._lock: the ref index has
+        # its own mutex, and only the oids it reports dead come back to
+        # Python for metadata/payload reaping.
+        self._refs = _make_refs()
+        # node slot table for the location sets: slot <-> (node_id, addr).
+        # The index speaks small ints; Python owns the mapping.
+        self._node_slots: Dict[str, int] = {}
+        self._slot_info: List[tuple] = []
+        # fast path: until the first replica is ever recorded (single-node
+        # sessions, i.e. almost always), get_location skips the index
+        self._any_replicas = False
         # incrementally-maintained ownership aggregate: (owner, kind) ->
         # [bytes, objects] over SEALED entries, adjusted at seal /
         # node-loss unseal / delete.  owner_summary() reads it in
@@ -187,7 +563,19 @@ class ObjectRegistry:
     def create_pending(self, oid: bytes) -> None:
         """Declare an object that a task will produce (return slot)."""
         with self._lock:
-            self._objects.setdefault(oid, _Entry())
+            if oid not in self._objects:
+                self._objects[oid] = _Entry()
+                self._refs.ensure((oid,))
+
+    def create_pending_batch(self, oids) -> None:
+        """One lock hop + one index call for a whole spec's return slots
+        (a 1M-task submission wave creates 1M entries through here)."""
+        with self._lock:
+            new = [oid for oid in oids if oid not in self._objects]
+            for oid in new:
+                self._objects[oid] = _Entry()
+            if new:
+                self._refs.ensure(new)
 
     def seal(self, oid: bytes, loc: ObjectLocation,
              contained: Optional[List[bytes]] = None,
@@ -197,13 +585,14 @@ class ObjectRegistry:
         deletion wins atomically: the prepared payload is discarded instead
         of resurrecting the entry (returns False).  Plain seal returns True."""
         unlink = None
-        dead: List[bytes] = []
+        dead: List[tuple] = []
         missed = False
+        fresh = False
         with self._lock:
-            if only_if_live:
-                e = self._objects.get(oid)
-            else:
-                e = self._objects.setdefault(oid, _Entry())
+            e = self._objects.get(oid)
+            if e is None and not only_if_live:
+                e = self._objects[oid] = _Entry()
+                self._refs.ensure((oid,))
             if e is None:
                 # entry died between the caller's decision and this seal:
                 # reap the orphaned payload (outside the lock — reap
@@ -223,11 +612,12 @@ class ObjectRegistry:
                 if loc.arena_path:
                     dead.append(("arena", (loc.arena_key, None)))
                     unlink = None
-                elif e.loc is not None and loc.shm_name == e.loc.shm_name:
+                elif loc.shm_name == e.loc.shm_name:
                     unlink = None  # same segment as the winner: never unlink
                 else:
                     unlink = loc.shm_name
             else:
+                fresh = True
                 e.loc = loc
                 e.contained = list(contained or [])
                 # first seal records the producer as owner; a re-seal after
@@ -237,24 +627,35 @@ class ObjectRegistry:
                     e.owner_kind = owner_kind or "unknown"
                 e.created = time.time()
                 self._owner_agg_add(e, 1)
-                for c in e.contained:
-                    ce = self._objects.get(c)
-                    if ce is not None:
-                        ce.ref_count += 1
-                        ce.pins["contained"] = ce.pins.get("contained", 0) + 1
                 if loc.shm_name and not loc.node_id:
                     self._bytes_used += loc.size
+            # The containment pins, Event set, and index sealed flag stay
+            # UNDER the registry lock (the index mutex nests inside it,
+            # never the reverse): a concurrent mark_node_lost must never
+            # observe e.contained populated while the +1s are missing, or
+            # replace the Event between the loc write and the set.
+            dead_at_seal = False
             if not missed:
+                if fresh and e.contained:
+                    # +1 per child; no-op for already-deleted children,
+                    # same as the old existing-entry check
+                    self._refs.add(e.contained, "contained", 1)
                 e.sealed.set()
-                if e.ref_count <= 0:
-                    # every handle died before the producer finished (fire-
-                    # and-forget): reclaim immediately
-                    self._delete_locked(oid, e, dead)
+                # the index's sealed flag is the delete-at-zero gate: a 1
+                # return means every handle died before the producer
+                # finished (fire-and-forget) — reclaim below
+                dead_at_seal = self._refs.seal(oid) == 1
+        if missed:
+            self._reap(dead)
+            self._maybe_spill()
+            return False
+        if dead_at_seal:
+            self._reap_dead_entries([oid])
         if unlink:
             self._reap([("shm", unlink)])
         self._reap(dead)
         self._maybe_spill()
-        return not missed
+        return True
 
     def mark_node_lost(self, node_id: str) -> List[bytes]:
         """Un-seal every object whose only copy lived on a dead node, so
@@ -265,36 +666,38 @@ class ObjectRegistry:
         if not node_id:
             return []  # head-local objects die with the session, not here
         lost: List[bytes] = []
-        dead: List[tuple] = []
+        orphaned_children: List[bytes] = []
         with self._lock:
-            # snapshot: dropping containment refs below can delete entries
+            slot = self._node_slots.get(node_id, -1)
+            if slot >= 0:
+                # the dead node's pulled copies leave every location set
+                self._refs.drop_slot(slot)
             for oid, e in list(self._objects.items()):
-                if oid not in self._objects:
-                    continue  # deleted by an earlier iteration's ref drop
-                e.replicas.pop(node_id, None)
-                if e.loc is not None and e.loc.node_id == node_id:
-                    if e.replicas:
-                        # a surviving copy exists: promote it to primary —
-                        # no un-seal, no lineage reconstruction (the payoff
-                        # of the location set)
-                        nid, addr = next(iter(e.replicas.items()))
-                        del e.replicas[nid]
-                        e.loc = ObjectLocation(
-                            shm_name=e.loc.shm_name, size=e.loc.size,
-                            is_error=e.loc.is_error, node_id=nid,
-                            fetch_addr=tuple(addr))
-                        continue
-                    # drop contained-ref increments this payload made; a
-                    # successful re-seal will re-add them
-                    for c in e.contained:
-                        self._remove_ref_locked(c, 1, dead, "contained")
-                    e.contained = []
-                    self._owner_agg_add(e, -1)  # a re-seal re-adds
-                    e.loc = None
-                    e.sealed = threading.Event()  # fresh event: old waiters
-                    # saw the sealed one; new waiters block until refill
-                    lost.append(oid)
-        self._reap(dead)
+                if e.loc is None or e.loc.node_id != node_id:
+                    continue
+                surv = self._refs.pop_replica(oid)
+                if surv >= 0:
+                    # a surviving copy exists: promote it to primary —
+                    # no un-seal, no lineage reconstruction (the payoff
+                    # of the location set)
+                    nid, addr = self._slot_info[surv]
+                    e.loc = ObjectLocation(
+                        shm_name=e.loc.shm_name, size=e.loc.size,
+                        is_error=e.loc.is_error, node_id=nid,
+                        fetch_addr=tuple(addr))
+                    continue
+                # drop contained-ref increments this payload made; a
+                # successful re-seal will re-add them
+                orphaned_children.extend(e.contained)
+                e.contained = []
+                self._owner_agg_add(e, -1)  # a re-seal re-adds
+                e.loc = None
+                e.sealed = threading.Event()  # fresh event: old waiters
+                # saw the sealed one; new waiters block until refill
+                self._refs.unseal(oid)
+                lost.append(oid)
+        if orphaned_children:
+            self.remove_refs(orphaned_children, reason="contained")
         return lost
 
     def contains(self, oid: bytes) -> bool:
@@ -324,7 +727,10 @@ class ObjectRegistry:
 
     def wait_sealed(self, oid: bytes, timeout: Optional[float]) -> Optional[ObjectLocation]:
         with self._lock:
-            e = self._objects.setdefault(oid, _Entry())
+            e = self._objects.get(oid)
+            if e is None:
+                e = self._objects[oid] = _Entry()
+                self._refs.ensure((oid,))
         if not e.sealed.wait(timeout):
             return None
         e.last_access = time.monotonic()
@@ -343,22 +749,48 @@ class ObjectRegistry:
                 return None
             e.last_access = time.monotonic()
             loc = e.loc
-            if not (e.replicas and loc is not None and loc.shm_name
-                    and loc.fetch_addr):
-                return loc
-            origin_node = loc.node_id or ""
-            if prefer_node is not None:
-                if prefer_node == origin_node:
-                    return loc  # own-node origin (incl. head arena payloads)
-                if prefer_node in e.replicas:
-                    return self._replica_loc(loc, prefer_node,
-                                             e.replicas[prefer_node])
-            sources = [(origin_node, loc.fetch_addr)] + list(e.replicas.items())
-            nid, addr = sources[e.rr % len(sources)]
-            e.rr += 1
-            if nid == origin_node:
-                return loc
-            return self._replica_loc(loc, nid, addr)
+        if not (self._any_replicas and loc is not None and loc.shm_name
+                and loc.fetch_addr):
+            return loc
+        return self._choose_source(oid, loc, prefer_node)
+
+    def get_locations_batch(
+        self, oids, prefer_node: Optional[str] = None,
+    ) -> Dict[bytes, Optional[ObjectLocation]]:
+        """One lock hop for a whole dep set (the dispatch path resolves
+        every argument location through here)."""
+        out: Dict[bytes, Optional[ObjectLocation]] = {}
+        now = time.monotonic()
+        with self._lock:
+            for oid in oids:
+                e = self._objects.get(oid)
+                if e is None or not e.sealed.is_set():
+                    out[oid] = None
+                    continue
+                e.last_access = now
+                out[oid] = e.loc
+        if self._any_replicas:
+            for oid, loc in out.items():
+                if loc is not None and loc.shm_name and loc.fetch_addr:
+                    out[oid] = self._choose_source(oid, loc, prefer_node)
+        return out
+
+    def _choose_source(self, oid: bytes, loc: ObjectLocation,
+                       prefer_node: Optional[str]) -> ObjectLocation:
+        """Replica-set pull spreading: ask the ref index which copy this
+        consumer should read (own node wins, else round-robin)."""
+        prefer_slot = -1
+        if prefer_node is not None:
+            if prefer_node == (loc.node_id or ""):
+                return loc  # own-node origin (incl. head arena payloads)
+            prefer_slot = self._node_slots.get(prefer_node, -1)
+        choice = self._refs.locate((oid,), prefer_slot)[0]
+        if choice < 0:
+            return loc
+        nid, addr = self._slot_info[choice]
+        if nid == (loc.node_id or "") or addr is None:
+            return loc
+        return self._replica_loc(loc, nid, addr)
 
     @staticmethod
     def _replica_loc(loc: ObjectLocation, node_id: str, addr) -> ObjectLocation:
@@ -366,6 +798,18 @@ class ObjectRegistry:
         return ObjectLocation(
             shm_name=loc.shm_name, size=loc.size, is_error=loc.is_error,
             node_id=node_id, fetch_addr=tuple(addr))
+
+    def _node_slot_locked(self, node_id: str, addr=None) -> int:
+        """Slot for ``node_id`` (lock held), assigning one on first use;
+        a provided address refreshes the slot's pull endpoint."""
+        slot = self._node_slots.get(node_id)
+        if slot is None:
+            slot = len(self._slot_info)
+            self._node_slots[node_id] = slot
+            self._slot_info.append((node_id, tuple(addr) if addr else None))
+        elif addr:
+            self._slot_info[slot] = (node_id, tuple(addr))
+        return slot
 
     def add_replica(self, oid: bytes, node_id: str, fetch_addr) -> None:
         """Record that ``node_id`` now holds a pulled copy (location-set
@@ -375,51 +819,85 @@ class ObjectRegistry:
             return
         with self._lock:
             e = self._objects.get(oid)
-            if (
+            if not (
                 e is not None and e.loc is not None and e.loc.shm_name
                 and node_id != e.loc.node_id
             ):
-                e.replicas[node_id] = tuple(fetch_addr)
+                return
+            slot = self._node_slot_locked(node_id, fetch_addr)
+            origin = self._node_slot_locked(e.loc.node_id or "",
+                                            e.loc.fetch_addr)
+        self._refs.set_origin(oid, origin)
+        if self._refs.add_replica(oid, slot) == 1:
+            self._any_replicas = True
 
     def replica_nodes(self, oid: bytes) -> List[str]:
+        mask = self._refs.replica_mask(oid)
+        if not mask:
+            return []
         with self._lock:
-            e = self._objects.get(oid)
-            return list(e.replicas) if e is not None else []
+            return [info[0] for i, info in enumerate(self._slot_info)
+                    if mask & (1 << i)]
 
     # -- reference counting --------------------------------------------
+    # These never take the registry lock: the ref index has its own
+    # (GIL-released, in the native case) mutex, and batch calls make one
+    # hop per MESSAGE.  Only the oids the index erased (count<=0 while
+    # sealed, atomic with the decrement) come back for metadata reaping.
     def add_ref(self, oid: bytes, n: int = 1, reason: str = "handle") -> None:
         """``reason`` feeds the audit's pin breakdown ("handle" = a live
         ObjectRef somewhere, "task_arg" = pinned by a pending task's spec,
         "contained" = referenced inside another sealed object)."""
-        with self._lock:
-            e = self._objects.get(oid)
-            if e is not None:
-                e.ref_count += n
-                e.pins[reason] = e.pins.get(reason, 0) + n
+        self._refs.add((oid,), reason, n)
+
+    def add_refs(self, oids, n: int = 1, reason: str = "handle") -> None:
+        self._refs.add(oids, reason, n)
 
     def remove_ref(self, oid: bytes, n: int = 1,
                    reason: str = "handle") -> None:
         """Owner-side count decrement; deletes (and cascades to contained
         refs) at zero.  Unsealed entries linger at count<=0 until their
         producer seals, then reclaim immediately."""
-        dead: List[bytes] = []
-        with self._lock:
-            self._remove_ref_locked(oid, n, dead, reason)
-        self._reap(dead)
+        self.remove_refs((oid,), n=n, reason=reason)
 
-    def _remove_ref_locked(self, oid: bytes, n: int, dead: List[bytes],
-                           reason: str = "handle") -> None:
-        e = self._objects.get(oid)
-        if e is None:
-            return
-        e.ref_count -= n
-        left = e.pins.get(reason, 0) - n
-        if left > 0:
-            e.pins[reason] = left
-        else:
-            e.pins.pop(reason, None)
-        if e.ref_count <= 0 and e.sealed.is_set():
-            self._delete_locked(oid, e, dead)
+    def remove_refs(self, oids, n: int = 1, reason: str = "handle") -> None:
+        dead = self._refs.remove(oids, reason, n)
+        if dead:
+            self._reap_dead_entries(dead)
+
+    def _reap_dead_entries(self, dead_oids: List[bytes]) -> None:
+        """Finish deletion for oids the ref index just erased: reap
+        payloads, cascade containment pins (which can erase more entries),
+        fire the on_delete hooks — the cold half of the old delete path."""
+        reap: List[tuple] = []
+        pending = list(dead_oids)
+        while pending:
+            children: List[bytes] = []
+            with self._lock:
+                for oid in pending:
+                    e = self._objects.pop(oid, None)
+                    if e is None:
+                        continue
+                    if e.loc is not None and e.sealed.is_set():
+                        self._owner_agg_add(e, -1)
+                    if e.loc is not None:
+                        if e.loc.arena_path:
+                            reap.append(("arena", (e.loc.arena_key,
+                                                   e.loc.shm_name)))
+                            if not e.loc.node_id:
+                                self._bytes_used -= e.loc.size
+                        elif e.loc.shm_name:
+                            reap.append(("shm", e.loc.shm_name))
+                            if not e.loc.node_id:
+                                self._bytes_used -= e.loc.size
+                        elif e.loc.spilled_path:
+                            reap.append(("file", e.loc.spilled_path))
+                    children.extend(e.contained)
+                    if self.on_delete is not None:
+                        reap.append(("hook", oid))
+            pending = (self._refs.remove(children, "contained", 1)
+                       if children else [])
+        self._reap(reap)
 
     def _owner_agg_add(self, e: "_Entry", n: int) -> None:
         """Adjust the sealed-bytes-per-owner aggregate by ``n`` objects
@@ -436,26 +914,6 @@ class ObjectRegistry:
         agg[1] += n
         if agg[1] <= 0:
             del self._owner_agg[key]
-
-    def _delete_locked(self, oid: bytes, e: _Entry, dead: List[tuple]) -> None:
-        if e.loc is not None and e.sealed.is_set():
-            self._owner_agg_add(e, -1)
-        if e.loc is not None:
-            if e.loc.arena_path:
-                dead.append(("arena", (e.loc.arena_key, e.loc.shm_name)))
-                if not e.loc.node_id:
-                    self._bytes_used -= e.loc.size
-            elif e.loc.shm_name:
-                dead.append(("shm", e.loc.shm_name))
-                if not e.loc.node_id:
-                    self._bytes_used -= e.loc.size
-            elif e.loc.spilled_path:
-                dead.append(("file", e.loc.spilled_path))
-        del self._objects[oid]
-        for c in e.contained:
-            self._remove_ref_locked(c, 1, dead, "contained")
-        if self.on_delete is not None:
-            dead.append(("hook", oid))
 
     def _reap(self, dead: List[tuple]) -> None:
         for kind, name in dead:
@@ -527,8 +985,9 @@ class ObjectRegistry:
                     continue  # deleted concurrently
                 e2.loc.shm_name = None
                 e2.loc.spilled_path = path
-                had_replicas = bool(e2.replicas)
-                e2.replicas.clear()
+                had_replicas = self._refs.num_replicas(oid) > 0
+                if had_replicas:
+                    self._refs.clear_replicas(oid)
                 self._bytes_used -= size
                 self._num_spilled += 1
                 bytes_used = self._bytes_used
@@ -555,12 +1014,12 @@ class ObjectRegistry:
         return loc.node_id or "head"
 
     @staticmethod
-    def _pin_reason(e: "_Entry") -> str:
+    def _pin_reason(pins) -> str:
         """The dominant reason this object is still alive, in pin-strength
         order: a task-spec pin outlives handles, containment outlives a
-        dropped handle."""
+        dropped handle.  ``pins`` is the ref index's slot list."""
         for reason in ("task_arg", "lineage", "contained", "handle"):
-            if e.pins.get(reason, 0) > 0:
+            if pins[_REASON_IDX[reason]] > 0:
                 return reason
         return "unknown"
 
@@ -570,42 +1029,51 @@ class ObjectRegistry:
         import itertools
 
         now = time.time()
-        out = []
         with self._lock:
-            for oid, e in itertools.islice(self._objects.items(), limit):
-                loc = e.loc
-                out.append({
-                    "object_id": oid.hex(),
-                    "sealed": e.sealed.is_set(),
-                    "ref_count": e.ref_count,
-                    "size": loc.size if loc else None,
-                    "where": self._where(e),
-                    "owner": e.owner,
-                    "owner_kind": e.owner_kind,
-                    "pin_reason": self._pin_reason(e),
-                    "age_s": round(now - e.created, 1),
-                })
-        return out
+            page = [
+                (oid, e.sealed.is_set(), e.loc, self._where(e), e.owner,
+                 e.owner_kind, e.created)
+                for oid, e in itertools.islice(self._objects.items(), limit)
+            ]
+        counts, pins = self._refs.get_batch([row[0] for row in page])
+        return [{
+            "object_id": oid.hex(),
+            "sealed": sealed,
+            "ref_count": counts[i] if counts[i] is not None else 0,
+            "size": loc.size if loc else None,
+            "where": where,
+            "owner": owner,
+            "owner_kind": owner_kind,
+            "pin_reason": self._pin_reason(pins[i]),
+            "age_s": round(now - created, 1),
+        } for i, (oid, sealed, loc, where, owner, owner_kind, created)
+            in enumerate(page)]
 
     def memory_audit(self) -> List[dict]:
         """Every SEALED object with ownership/pin detail — the raw rows of
-        the ``ray memory`` table.  Rows are fully materialized under the
-        lock (pins is a live dict a concurrent add_ref mutates; copying
-        it outside would race), sorted outside."""
+        the ``ray memory`` table.  Row fields snapshot under the lock;
+        counts/pins come from one batch index call (its own mutex), so a
+        full-table audit costs two lock hops, not one per row."""
         now = time.time()
         with self._lock:
-            rows = [{
-                "object_id": oid.hex(),
-                "size": e.loc.size,
-                "where": self._where(e),
-                "owner": e.owner or "unknown",
-                "owner_kind": e.owner_kind,
-                "ref_count": e.ref_count,
-                "pins": dict(e.pins),
-                "pin_reason": self._pin_reason(e),
-                "age_s": round(now - e.created, 1),
-            } for oid, e in self._objects.items()
+            snap = [
+                (oid, e.loc.size, self._where(e), e.owner or "unknown",
+                 e.owner_kind, e.created)
+                for oid, e in self._objects.items()
                 if e.sealed.is_set() and e.loc is not None]
+        counts, pins = self._refs.get_batch([row[0] for row in snap])
+        rows = [{
+            "object_id": oid.hex(),
+            "size": size,
+            "where": where,
+            "owner": owner,
+            "owner_kind": owner_kind,
+            "ref_count": counts[i] if counts[i] is not None else 0,
+            "pins": _pins_dict(pins[i]),
+            "pin_reason": self._pin_reason(pins[i]),
+            "age_s": round(now - created, 1),
+        } for i, (oid, size, where, owner, owner_kind, created)
+            in enumerate(snap)]
         rows.sort(key=lambda r: -r["size"])
         return rows
 
@@ -638,6 +1106,7 @@ class ObjectRegistry:
             spilled = [e.loc.spilled_path for e in self._objects.values()
                        if e.loc and e.loc.spilled_path]
             self._objects.clear()
+            self._refs.clear()
         for p in spilled:
             try:
                 os.unlink(p)

@@ -248,29 +248,109 @@ def scale_envelope(quick: bool = False) -> List[Dict]:
         print(json.dumps(rec), flush=True)
         results.append(rec)
 
-    # ------------------------------------------- 100k queued tasks, 1 node
-    n_tasks = 10_000 if quick else 100_000
-    ray_tpu.init(num_cpus=4, num_tpus=0)
-    try:
-        @ray_tpu.remote
-        def noop():
-            return None
+    # --------------------------- queued tasks (100k and 1M), 1 node
+    # BOTH phases under ONE contention regime: dispatch/drain runs
+    # concurrently with submission from first submit to last completion
+    # (the old row measured submit against a concurrent drain but drain
+    # after submit had finished — drain_ops_s was flattered ~15x by the
+    # work already done during the submit wall).  sustained_ops_s is the
+    # honest end-to-end number: n_tasks over first-submit -> last-
+    # completion, plus bucket-estimated p50/p99 dispatch latency from
+    # the head's scheduler histogram.
+    def queued_tasks_row(n_tasks: int, label: str):
+        ray_tpu.init(num_cpus=4, num_tpus=0)
+        try:
+            @ray_tpu.remote
+            def noop():
+                return None
 
-        ray_tpu.get([noop.remote() for _ in range(50)], timeout=120)
-        t0 = time.perf_counter()
-        refs = [noop.remote() for _ in range(n_tasks)]
-        submit_dt = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        for i in range(0, n_tasks, 5000):
-            ray_tpu.get(refs[i:i + 5000], timeout=1800)
-        drain_dt = time.perf_counter() - t0
-        record({"metric": f"queued_tasks_{n_tasks // 1000}k",
-                "value": n_tasks, "unit": "tasks",
-                "submit_ops_s": round(n_tasks / submit_dt, 1),
-                "drain_ops_s": round(n_tasks / drain_dt, 1)})
-        del refs
-    finally:
-        ray_tpu.shutdown()
+            ray_tpu.get([noop.remote() for _ in range(50)], timeout=120)
+            t0 = time.perf_counter()
+            refs = [noop.remote() for _ in range(n_tasks)]
+            submit_dt = time.perf_counter() - t0
+            for i in range(0, n_tasks, 5000):
+                ray_tpu.get(refs[i:i + 5000], timeout=3600)
+            total_dt = time.perf_counter() - t0
+            from ray_tpu._private.worker import global_worker
+
+            node = global_worker.node
+            lat = node._merged_histogram_summary(
+                node._merged_metrics_snapshot(),
+                "ray_tpu_sched_dispatch_latency_s") or {}
+            record({"metric": label, "value": n_tasks, "unit": "tasks",
+                    "submit_ops_s": round(n_tasks / submit_dt, 1),
+                    "sustained_ops_s": round(n_tasks / total_dt, 1),
+                    "drain_wall_s": round(total_dt - submit_dt, 1),
+                    "dispatch_p50_est_s": lat.get("p50_est_s"),
+                    "dispatch_p99_est_s": lat.get("p99_est_s")})
+            del refs
+        finally:
+            ray_tpu.shutdown()
+
+    queued_tasks_row(10_000 if quick else 100_000,
+                     "queued_tasks_10k" if quick else "queued_tasks_100k")
+    if not quick:
+        # the reference-bar row: 1M queued tasks through one head
+        # (release/benchmarks' many_tasks), target >=10k sustained ops/s
+        queued_tasks_row(1_000_000, "queued_tasks_1m")
+
+    # --------------------------- typed-wire overhead on task_throughput
+    # the proto arm (packed hot-frame codec) vs the raw-pickle arm, same
+    # wave benchmark: the acceptance bar is <=3% overhead, recorded here
+    # per arm so the default flip stays justified by data.  ALTERNATING
+    # repeats + medians: on a 1-core host a single back-to-back pair is
+    # dominated by pool-warmup/GC ordering noise (one-shot runs swung
+    # +-15% either direction); A/B/A/B with medians is stable.
+    import statistics as _stats
+
+    wave = 20 if quick else 100
+    reps = 1 if quick else 3
+    arms = {"pickle": [], "proto": []}
+
+    def wire_arm(mode: str) -> float:
+        saved_wire = _os.environ.get("RAY_TPU_WIRE")
+        _os.environ["RAY_TPU_WIRE"] = mode
+        ray_tpu.init(num_cpus=4, num_tpus=0)
+        try:
+            @ray_tpu.remote
+            def noop2():
+                return None
+
+            def wavefn():
+                ray_tpu.get([noop2.remote() for _ in range(wave)],
+                            timeout=120)
+
+            ramp_until = time.perf_counter() + (1.0 if quick else 3.0)
+            while time.perf_counter() < ramp_until:
+                wavefn()
+            t0 = time.perf_counter()
+            n = 0
+            while time.perf_counter() - t0 < (0.5 if quick else 2.0):
+                wavefn()
+                n += wave
+            return n / (time.perf_counter() - t0)
+        finally:
+            ray_tpu.shutdown()
+            # restore the CALLER's pin (pop would flip the rest of the
+            # envelope run to the built-in default mid-bench)
+            if saved_wire is None:
+                _os.environ.pop("RAY_TPU_WIRE", None)
+            else:
+                _os.environ["RAY_TPU_WIRE"] = saved_wire
+
+    for i in range(reps):
+        order = ("proto", "pickle") if i % 2 else ("pickle", "proto")
+        for mode in order:
+            arms[mode].append(wire_arm(mode))
+    p = _stats.median(arms["pickle"])
+    q = _stats.median(arms["proto"])
+    record({"metric": "task_throughput_wire_pickle",
+            "value": round(p, 2), "unit": "ops/s"})
+    record({"metric": "task_throughput_wire_proto",
+            "value": round(q, 2), "unit": "ops/s"})
+    record({"metric": "wire_overhead", "value": round((p - q) / p * 100, 2),
+            "unit": "%", "proto_ops_s": round(q, 2),
+            "pickle_ops_s": round(p, 2), "reps": reps})
 
     # ------------------------------------------------- 1k live actors
     # every actor is its own worker process; on a 1-core host the boot
@@ -306,6 +386,54 @@ def scale_envelope(quick: bool = False) -> List[Dict]:
     finally:
         ray_tpu.shutdown()
         _os.environ.pop("RAY_TPU_MAXIMUM_STARTUP_CONCURRENCY", None)
+
+    # ------------------- sustained 16-emulated-node envelope, doctor-watched
+    # the multi-node head envelope: every node takes dispatches for a
+    # sustained window (tasks spread + one actor per node), then the
+    # doctor reads the recorded state — the run only counts as healthy
+    # with zero ERROR/CRITICAL findings (doctor_clean).
+    n_nodes = 4 if quick else 16
+    budget_s = 10 if quick else 45
+    from ray_tpu.cluster_utils import Cluster as _Cluster
+
+    cluster = _Cluster(initialize_head=True,
+                       head_node_args={"num_cpus": 2, "num_tpus": 0})
+    try:
+        for _ in range(n_nodes - 1):
+            cluster.add_node(num_cpus=2)
+
+        @ray_tpu.remote
+        def spread():
+            return None
+
+        @ray_tpu.remote
+        class PerNode:
+            def ping(self):
+                return 1
+
+        actors = [PerNode.remote() for _ in range(n_nodes)]
+        ray_tpu.get([a.ping.remote() for a in actors], timeout=600)
+        done = 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < budget_s:
+            refs = [spread.remote() for _ in range(400)]
+            refs += [a.ping.remote() for a in actors]
+            ray_tpu.get(refs, timeout=600)
+            done += len(refs)
+        dt = time.perf_counter() - t0
+        from ray_tpu.util.doctor import run_doctor
+
+        findings = run_doctor()
+        errors = [f for f in findings
+                  if f.get("severity") in ("ERROR", "CRITICAL")]
+        record({"metric": "multi_node_envelope", "value": n_nodes,
+                "unit": "nodes", "sustained_s": round(dt, 1),
+                "ops_s": round(done / dt, 1),
+                "doctor_findings": len(findings),
+                "doctor_errors": len(errors),
+                "doctor_clean": not errors})
+    finally:
+        cluster.shutdown()
 
     # ------------------------------------------------- 8 GiB single get
     gib = 1 if quick else 8

@@ -15,23 +15,28 @@ dict <-> Envelope, and ``WireConnection`` swaps the codec in under any
 ``multiprocessing.connection.Connection`` via send_bytes/recv_bytes.
 
 Interop: a pickle frame starts with opcode 0x80; an Envelope always
-starts with the version varint tag 0x08 — receivers sniff the first
-byte.  Untyped long-tail messages are sent as RAW pickle frames (no
-envelope wrap): that avoids double-copying the payload and protobuf's
-2 GiB message cap (thin-client blobs ship multi-GiB frames here).
+starts with the version varint tag 0x08; a PACKED frame (packed_wire.py
+— the hot ~7 frame types lowered to struct-packed headers, no protobuf
+reflection) starts with the magic 0xB1 — receivers sniff the first
+byte, so all three encodings are always accepted.  Untyped long-tail
+messages are sent as RAW pickle frames (no envelope wrap): that avoids
+double-copying the payload and protobuf's 2 GiB message cap
+(thin-client blobs ship multi-GiB frames here).
 
 Encoding selection (``RAY_TPU_WIRE``): every connection RECEIVES
-through the sniffing decoder — both encodings are always accepted, so
-mixed clusters interoperate — and the flag selects only what a process
-SENDS.  ``proto`` emits typed frames; the default ``pickle`` emits raw
-pickle frames: same-version same-language peers take the native fast
-path (the pure-Python typed codec costs ~50-90us/task of message
-construction, which a 1-core head feels as double-digit percent of
-no-op task throughput), while the IDL remains the versioned encoding a
-non-Python or cross-version peer speaks at any time.  The full test
-suite runs with ``RAY_TPU_WIRE=proto`` (tests/conftest.py) so every
-typed arm is exercised end-to-end on every cluster test; the default
-send path is cluster-tested by a subprocess driver in test_wire.py.
+through the sniffing decoder — mixed clusters interoperate — and the
+flag selects only what a process SENDS.  The DEFAULT is ``proto``: hot
+frames take the packed codec (low-single-digit % overhead vs raw
+pickle — the packed headers cost ~2-6us/frame where the pure-Python
+protobuf Envelope cost ~50-90us/task, ~19% of no-op throughput on a
+1-core head), other typed frames take the Envelope arm, and the long
+tail rides raw pickle.  ``envelope`` forces the protobuf arm for every
+typed frame (the packed codec off — the IDL-conformance arm a
+cross-language peer would speak); ``pickle`` restores the raw-pickle
+fast path everywhere (the pre-flip default, still fully supported).
+The suite pins RAY_TPU_WIRE=proto in tests/conftest.py (redundant with
+the default, but explicit), and test_wire.py cluster-tests the pickle
+and mixed-mode arms via subprocess drivers.
 """
 
 from __future__ import annotations
@@ -40,6 +45,7 @@ import os
 import pickle
 from typing import Any, Dict, Optional
 
+from ray_tpu._private import packed_wire
 from ray_tpu._private.object_store import ObjectLocation
 from ray_tpu.protocol import ray_tpu_pb2 as pb
 
@@ -250,11 +256,19 @@ def _enc_seal(msg, env) -> bool:
 
 
 def _enc_add_ref(msg, env) -> bool:
+    if msg.get("reason", "handle") != "handle":
+        # the RefUpdate schema predates pin reasons: encoding here would
+        # silently drop the reason and skew the head's pin-reason audit.
+        # The packed arm carries it; this Envelope fallback preserves it
+        # via the pickle arm.
+        return False
     env.add_ref.oids.extend(msg["oids"])
     return True
 
 
 def _enc_remove_ref(msg, env) -> bool:
+    if msg.get("reason", "handle") != "handle":
+        return False  # see _enc_add_ref
     env.remove_ref.oids.extend(msg["oids"])
     return True
 
@@ -346,7 +360,14 @@ _ENCODERS = {
 }
 
 
-def encode(msg: Dict[str, Any]) -> bytes:
+def encode(msg: Dict[str, Any], packed: bool = True) -> bytes:
+    if packed:
+        # hot frames take the struct-packed codec; None means "not a
+        # packed type / oversize / unexpected shape" and falls through to
+        # the Envelope arm (whose own gates land on raw pickle)
+        out = packed_wire.encode(msg)
+        if out is not None:
+            return out
     env = pb.Envelope(version=WIRE_VERSION)
     enc = _ENCODERS.get(msg.get("type"))
     done = False
@@ -447,8 +468,13 @@ _DECODERS = {
     "execute": _dec_execute,
     "task_done": _dec_task_done,
     "seal": _dec_seal,
-    "add_ref": lambda m: {"type": "add_ref", "oids": list(m.oids)},
-    "remove_ref": lambda m: {"type": "remove_ref", "oids": list(m.oids)},
+    # the Envelope RefUpdate arm only ever carries handle-reason updates
+    # (non-handle reasons fall back to pickle — see _enc_add_ref);
+    # materializing the default keeps decode(encode(x)) == x
+    "add_ref": lambda m: {"type": "add_ref", "oids": list(m.oids),
+                          "reason": "handle"},
+    "remove_ref": lambda m: {"type": "remove_ref", "oids": list(m.oids),
+                             "reason": "handle"},
     "kv_put": lambda m: {"type": "kv_put", "ns": m.ns, "key": m.key,
                          "value": m.value},
     "kv_get": lambda m: {"type": "kv_get", "ns": m.ns, "key": m.key,
@@ -467,11 +493,18 @@ _DECODERS = {
 
 
 def decode(data: bytes) -> Dict[str, Any]:
-    if data[:1] == b"\x80":
-        # raw pickle frame — the DEFAULT send encoding (and the untyped
-        # long-tail of proto-mode senders).  This arm is load-bearing,
-        # not legacy: removing it breaks every default-mode cluster.
+    head = data[:1]
+    if head == b"\x80":
+        # raw pickle frame — RAY_TPU_WIRE=pickle senders and the untyped
+        # long-tail of proto-mode senders.  This arm is load-bearing,
+        # not legacy: removing it breaks every pickle-mode cluster.
         return pickle.loads(data)
+    if head == packed_wire.MAGIC_BYTE:
+        # packed hot frame (the proto-mode default for ~7 frame types)
+        try:
+            return packed_wire.decode(data)
+        except Exception as e:
+            raise WireDecodeError(f"bad packed frame: {e}") from e
     try:
         env = pb.Envelope.FromString(data)
     except Exception as e:
@@ -493,19 +526,20 @@ def decode(data: bytes) -> Dict[str, Any]:
 
 class WireConnection:
     """Drop-in ``Connection`` facade.  The RECEIVE path always accepts
-    both encodings (decode() sniffs the first byte — raw pickle frames
+    every encoding (decode() sniffs the first byte — raw pickle, packed,
     and Envelope frames share the same length-prefixed transport
-    framing); ``typed`` gates only what THIS side emits."""
+    framing); ``typed``/``packed`` gate only what THIS side emits."""
 
-    __slots__ = ("_conn", "_typed")
+    __slots__ = ("_conn", "_typed", "_packed")
 
-    def __init__(self, conn, typed: bool):
+    def __init__(self, conn, typed: bool, packed: bool = True):
         self._conn = conn
         self._typed = typed
+        self._packed = packed
 
     def send(self, msg: Dict[str, Any]) -> None:
         if self._typed:
-            self._conn.send_bytes(encode(msg))
+            self._conn.send_bytes(encode(msg, packed=self._packed))
         else:
             self._conn.send_bytes(pickle.dumps(msg, _PICKLE_PROTO))
 
@@ -535,8 +569,16 @@ class WireConnection:
 def wrap(conn):
     """Wrap a freshly connected/accepted control connection.  EVERY
     connection receives through the sniffing decoder, so any peer can
-    speak either encoding at any time (mixed clusters and rolling
-    flag changes just work); ``RAY_TPU_WIRE=pickle|proto`` selects only
-    what this process SENDS (see the module docstring)."""
+    speak any encoding at any time (mixed clusters and rolling flag
+    changes just work); ``RAY_TPU_WIRE=proto|envelope|pickle`` selects
+    only what this process SENDS (see the module docstring).  The
+    default is ``proto`` — the typed wire with the packed hot-frame
+    codec.  Caveat: a peer from a release that predates the packed
+    codec cannot sniff its 0xB1 magic — when rolling such a fleet, pin
+    ``RAY_TPU_WIRE=envelope`` (or ``pickle``) on upgraded processes
+    until every node is current, then drop the pin."""
+    mode = os.environ.get("RAY_TPU_WIRE", "proto")
     return WireConnection(
-        conn, typed=os.environ.get("RAY_TPU_WIRE", "pickle") == "proto")
+        conn,
+        typed=mode in ("proto", "envelope"),
+        packed=mode == "proto")

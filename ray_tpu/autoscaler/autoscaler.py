@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from itertools import islice
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -45,23 +46,53 @@ class StandardAutoscaler:
         self._idle_since: Dict[str, float] = {}
 
     # -- demand / utilization views ------------------------------------
+    # per-tick demand sample bound: to_launch is clamped by
+    # upscaling_speed each pass, so any backlog sample big enough to
+    # saturate that clamp yields the identical launch decision — and the
+    # tick stays O(cap) under head.lock instead of O(backlog) (the 1M
+    # queued-task envelope would otherwise copy a dict per parked task
+    # while dispatch waits on the lock)
+    DEMAND_SAMPLE_CAP = 1024
+
     def pending_demand(self) -> List[Dict[str, float]]:
         """Resource requests with no node that can fit them now (the
         LoadMetrics pending-demand feed)."""
         head = self.head
+        cap_n = self.DEMAND_SAMPLE_CAP
         demands: List[Dict[str, float]] = []
         with head.lock:
             avail = {nid: dict(ns.available) for nid, ns in head.nodes.items()
                      if ns.alive}
-            for spec in list(head.pending_tasks):
+            for spec in islice(head.pending_tasks, cap_n):
                 demands.append(dict(spec.get("resources", {})))
+            # resource-starved backlog: the scheduler parks unplaceable
+            # shapes in per-shape queues (node._starved) — exactly the
+            # demand that should trigger scale-up, so it MUST feed load
+            # metrics (a TPU task waiting for a slice lives here within
+            # one scheduler pass of submission).  Every shape gets one
+            # representative OUTSIDE the cap (shape count is O(shapes) by
+            # design) so a flood of one shape can't hide another's demand;
+            # the rest of the budget then samples queue depth.
+            starved = [q for q in getattr(head, "_starved", {}).values() if q]
+            for q in starved:
+                demands.append(dict(q[0].get("resources", {})))
+            for q in starved:
+                take = min(len(q) - 1, cap_n - len(demands))
+                if take <= 0:
+                    if len(demands) >= cap_n:
+                        break
+                    continue
+                for spec in islice(q, 1, 1 + take):
+                    demands.append(dict(spec.get("resources", {})))
             # tasks leased into a busy worker's pipeline are queued work
             # too (the reference reports lease BACKLOGS to load metrics —
             # resource_demand_scheduler feeds on them); without this, fast
             # worker dispatch hides all queued demand inside pipelines and
             # the autoscaler never sees a reason to scale
             for w in head.workers.values():
-                for spec in list(w.pipeline):
+                if len(demands) >= cap_n:
+                    break
+                for spec in islice(w.pipeline, cap_n - len(demands)):
                     demands.append(dict(spec.get("resources", {})))
             for art in head.actors.values():
                 if art.info.state == "PENDING_CREATION" and art.worker is None:

@@ -221,9 +221,18 @@ class LintConfig:
         # relay in util/client/driver.py forwards only variable frames)
         "ray_tpu/util/client/proxier.py",
     )
-    # the codec rebuilds frames from protobuf — its dict literals are not
-    # send sites, and its tables must not count as senders
-    protocol_exclude: Tuple[str, ...] = ("ray_tpu/_private/wire.py",)
+    # the codecs rebuild frames from the wire — their dict literals are
+    # not send sites, and their tables must not count as senders
+    protocol_exclude: Tuple[str, ...] = (
+        "ray_tpu/_private/wire.py",
+        "ray_tpu/_private/packed_wire.py",
+    )
+    # R1 also checks the packed hot-frame codec: its _FRAME_IDS/_PACK/
+    # _UNPACK tables must stay in lockstep (a frame type added to the
+    # encoder but not the decoder is a silent wire break) and every
+    # packed type must have live send sites and dispatch arms in BOTH
+    # wire directions, exactly like the Envelope arms
+    packed_codec_module: str = "ray_tpu/_private/packed_wire.py"
     # R3 — modules on the task submit/dispatch path where per-task entropy
     # (uuid4/urandom ~200us on this kernel) costs whole-percent throughput
     hot_path_modules: Tuple[str, ...] = (

@@ -206,6 +206,55 @@ def check_protocol(project: Project, config: LintConfig) -> List[Finding]:
                 f'to clients',
                 "delete the dispatch arm (or the sender was lost — restore it)",
                 detail=f"dead-client-handler:{t}"))
+
+    # -- packed hot-frame codec (packed_wire.py) ------------------------
+    # Same contract as the Envelope arms, applied to the struct-packed
+    # codec: the _FRAME_IDS/_PACK/_UNPACK tables must agree key-for-key
+    # (a type in the encoder but not the decoder is a silent wire break),
+    # and every packed type needs a live sender and a dispatch arm.
+    codec_sf = project.get(getattr(config, "packed_codec_module", "") or "")
+    if codec_sf is not None and codec_sf.tree is not None:
+        tables: Dict[str, Tuple[Set[str], int]] = {}
+        for node in codec_sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id in ("_FRAME_IDS", "_PACK",
+                                               "_UNPACK") \
+                    and isinstance(node.value, ast.Dict):
+                keys = {s for s in (str_const(k) for k in node.value.keys
+                                    if k is not None) if s is not None}
+                tables[node.targets[0].id] = (keys, node.lineno)
+        if len(tables) == 3:
+            all_types = set().union(*(k for k, _ in tables.values()))
+            for t in sorted(all_types):
+                for tname, (keys, line) in tables.items():
+                    if t not in keys and not codec_sf.suppressed(line, "R1"):
+                        findings.append(make_finding(
+                            codec_sf, "R1", line,
+                            f'packed frame type "{t}" is missing from '
+                            f'{tname} (codec tables out of lockstep — a '
+                            f'peer would drop or misdecode the frame)',
+                            f"add the {tname} entry for it (or remove the "
+                            f"type from the other tables)",
+                            detail=f"packed-table-skew:{tname}:{t}"))
+            ids, ids_line = tables["_FRAME_IDS"]
+            handled = set(head_handlers) | set(client_handlers)
+            sent = sent_to_head | sent_to_client
+            for t in sorted(ids):
+                if t not in handled and not codec_sf.suppressed(ids_line, "R1"):
+                    findings.append(make_finding(
+                        codec_sf, "R1", ids_line,
+                        f'packed frame type "{t}" has no dispatch arm in '
+                        f'any recv loop (either wire direction)',
+                        "add the handler or drop the packed arm",
+                        detail=f"packed-unhandled:{t}"))
+                if t not in sent and not codec_sf.suppressed(ids_line, "R1"):
+                    findings.append(make_finding(
+                        codec_sf, "R1", ids_line,
+                        f'dead packed arm: no module sends frame type '
+                        f'"{t}" on either wire direction',
+                        "delete the packed arm (or the sender was lost)",
+                        detail=f"packed-dead:{t}"))
     return findings
 
 

@@ -261,3 +261,362 @@ void rtpu_store_close(void* h, int unlink_file) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// RefIndex: the head registry's hot maps, pushed down from Python.
+//
+// The reference keeps reference counts and object locations in C++
+// (`src/ray/core_worker/reference_count.h`, ownership-based object
+// directory) precisely because they are touched per task arg; our head
+// did both in a Python dict under a Python lock, which serialized every
+// submission wave.  This index absorbs exactly the per-oid hot state:
+//
+//   - ref_count          lifetime source of truth (may go negative while
+//                        the producer hasn't sealed yet — same contract
+//                        as the Python _Entry)
+//   - pins[8]            advisory per-reason counts (handle/task_arg/
+//                        contained/lineage/...; Python owns the
+//                        reason-name <-> slot mapping)
+//   - sealed             the delete-at-zero gate: entries are erased when
+//                        count <= 0 AND sealed, atomically with the
+//                        decrement that got them there
+//   - origin slot +      location SET as small-int node slots (Python
+//     replica mask + rr   owns slot <-> node_id/addr); `locate` picks the
+//                        pull source per oid (prefer-own-node, else
+//                        round-robin over origin+replicas)
+//
+// All calls take packed arrays of 16-byte oids and run with the GIL
+// released (ctypes); one mutex serializes the index — the win over the
+// Python path is batch granularity (one lock hop per MESSAGE instead of
+// per oid) plus true GIL-free execution, not lock-free cleverness.
+// Cold metadata (payload location, owner attribution, sealed Events,
+// containment lists) stays in Python, keyed by the same oid, so the
+// ownership/memory audits read identical rows.
+
+namespace {
+
+constexpr int kNumReasons = 8;
+constexpr int kMaxSlots = 64;  // replica node slots per object (bitmask)
+constexpr int kOidLen = 16;
+
+struct RefEntry {
+  int64_t count = 1;
+  int32_t pins[kNumReasons] = {0};
+  uint64_t replicas = 0;  // bit i = node slot i holds a pulled copy
+  int16_t origin_slot = -1;
+  uint16_t rr = 0;
+  bool sealed = false;
+};
+
+struct RefIndex {
+  std::unordered_map<std::string, RefEntry> map;
+  std::mutex mu;
+};
+
+// same keep-reachable discipline as the arenas: a GIL-released call can
+// be parked on `mu` while Python shuts down, so destroy() never frees
+std::mutex g_refs_mu;
+std::vector<RefIndex*>& g_refs() {
+  static std::vector<RefIndex*>* v = new std::vector<RefIndex*>();
+  return *v;
+}
+
+inline std::string ref_key(const uint8_t* oids, int64_t i) {
+  return std::string(reinterpret_cast<const char*>(oids) + i * kOidLen,
+                     kOidLen);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rtpu_refs_create() {
+  auto* r = new RefIndex();
+  {
+    std::lock_guard<std::mutex> g(g_refs_mu);
+    g_refs().push_back(r);
+  }
+  return r;
+}
+
+// Create entries for any missing oid with the creator's initial handle
+// pin (count=1, pins[reason]=1 — Python passes the "handle" slot).
+// Existing entries are untouched (setdefault semantics).
+void rtpu_refs_ensure(void* h, const uint8_t* oids, int64_t n,
+                      int32_t reason) {
+  auto* r = static_cast<RefIndex*>(h);
+  if (reason < 0 || reason >= kNumReasons) reason = kNumReasons - 1;
+  std::lock_guard<std::mutex> g(r->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    auto res = r->map.emplace(ref_key(oids, i), RefEntry{});
+    if (res.second) res.first->second.pins[reason] = 1;
+  }
+}
+
+int rtpu_refs_contains(void* h, const uint8_t* oid) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  return r->map.count(std::string(reinterpret_cast<const char*>(oid),
+                                  kOidLen))
+             ? 1
+             : 0;
+}
+
+// Batch increment; missing oids are a no-op (a ref to a deleted object
+// is the caller's stale handle, same as the Python path).
+void rtpu_refs_add(void* h, const uint8_t* oids, int64_t n, int32_t reason,
+                   int64_t delta) {
+  auto* r = static_cast<RefIndex*>(h);
+  if (reason < 0 || reason >= kNumReasons) reason = kNumReasons - 1;
+  std::lock_guard<std::mutex> g(r->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = r->map.find(ref_key(oids, i));
+    if (it == r->map.end()) continue;
+    it->second.count += delta;
+    it->second.pins[reason] += static_cast<int32_t>(delta);
+  }
+}
+
+// Batch decrement.  An entry whose count drops to <= 0 while sealed is
+// erased HERE, atomically with the decrement (a concurrent add can then
+// never resurrect it — add on a missing key is a no-op), and its oid is
+// appended to dead_out (capacity n * 16 bytes).  Returns the dead count;
+// Python reaps payload/metadata for exactly those oids.
+int64_t rtpu_refs_remove(void* h, const uint8_t* oids, int64_t n,
+                         int32_t reason, int64_t delta, uint8_t* dead_out) {
+  auto* r = static_cast<RefIndex*>(h);
+  if (reason < 0 || reason >= kNumReasons) reason = kNumReasons - 1;
+  int64_t dead = 0;
+  std::lock_guard<std::mutex> g(r->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    auto key = ref_key(oids, i);
+    auto it = r->map.find(key);
+    if (it == r->map.end()) continue;
+    RefEntry& e = it->second;
+    e.count -= delta;
+    int32_t left = e.pins[reason] - static_cast<int32_t>(delta);
+    e.pins[reason] = left > 0 ? left : 0;
+    if (e.count <= 0 && e.sealed) {
+      std::memcpy(dead_out + dead * kOidLen, key.data(), kOidLen);
+      ++dead;
+      r->map.erase(it);
+    }
+  }
+  return dead;
+}
+
+// Mark sealed.  Returns 1 when the entry died at seal time (every handle
+// dropped before the producer finished — fire-and-forget reclaim: the
+// entry is erased and the caller discards the payload), 0 on a live
+// seal, -1 when the entry is missing (concurrent deletion won).
+int rtpu_refs_seal(void* h, const uint8_t* oid) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->map.find(
+      std::string(reinterpret_cast<const char*>(oid), kOidLen));
+  if (it == r->map.end()) return -1;
+  it->second.sealed = true;
+  if (it->second.count <= 0) {
+    r->map.erase(it);
+    return 1;
+  }
+  return 0;
+}
+
+// Node-loss un-seal: the only copy died, lineage will refill the slot.
+// The entry survives at its current count; replicas were already dropped
+// via rtpu_refs_drop_slot.
+int rtpu_refs_unseal(void* h, const uint8_t* oid) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->map.find(
+      std::string(reinterpret_cast<const char*>(oid), kOidLen));
+  if (it == r->map.end()) return -1;
+  it->second.sealed = false;
+  it->second.origin_slot = -1;
+  it->second.replicas = 0;
+  return 0;
+}
+
+int rtpu_refs_erase(void* h, const uint8_t* oid) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  return r->map.erase(
+             std::string(reinterpret_cast<const char*>(oid), kOidLen))
+             ? 0
+             : -1;
+}
+
+// Snapshot one entry (audit path): count, sealed, all pin slots.
+int rtpu_refs_get(void* h, const uint8_t* oid, int64_t* count_out,
+                  int32_t* sealed_out, int32_t* pins_out /* [8] */) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->map.find(
+      std::string(reinterpret_cast<const char*>(oid), kOidLen));
+  if (it == r->map.end()) return -1;
+  *count_out = it->second.count;
+  *sealed_out = it->second.sealed ? 1 : 0;
+  std::memcpy(pins_out, it->second.pins, sizeof(it->second.pins));
+  return 0;
+}
+
+// Batch snapshot for the memory audit: one mutex hop for the whole table
+// page instead of one per row.  Missing oids get count = INT64_MIN.
+void rtpu_refs_get_batch(void* h, const uint8_t* oids, int64_t n,
+                         int64_t* counts, int32_t* pins /* n*8 */) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = r->map.find(ref_key(oids, i));
+    if (it == r->map.end()) {
+      counts[i] = INT64_MIN;
+      continue;
+    }
+    counts[i] = it->second.count;
+    std::memcpy(pins + i * kNumReasons, it->second.pins,
+                sizeof(it->second.pins));
+  }
+}
+
+uint64_t rtpu_refs_size(void* h) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  return r->map.size();
+}
+
+// -- location sets ---------------------------------------------------------
+
+int rtpu_refs_set_origin(void* h, const uint8_t* oid, int32_t slot) {
+  auto* r = static_cast<RefIndex*>(h);
+  if (slot < -1 || slot >= kMaxSlots) return -2;
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->map.find(
+      std::string(reinterpret_cast<const char*>(oid), kOidLen));
+  if (it == r->map.end()) return -1;
+  it->second.origin_slot = static_cast<int16_t>(slot);
+  return 0;
+}
+
+// Record a pulled copy.  1 = added, 0 = already present / is the origin,
+// -1 = missing entry, -2 = slot out of mask range (callers just skip:
+// the location set is a pull-spreading optimization, not correctness).
+int rtpu_refs_add_replica(void* h, const uint8_t* oid, int32_t slot) {
+  auto* r = static_cast<RefIndex*>(h);
+  if (slot < 0 || slot >= kMaxSlots) return -2;
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->map.find(
+      std::string(reinterpret_cast<const char*>(oid), kOidLen));
+  if (it == r->map.end()) return -1;
+  RefEntry& e = it->second;
+  if (slot == e.origin_slot) return 0;
+  uint64_t bit = 1ULL << slot;
+  if (e.replicas & bit) return 0;
+  e.replicas |= bit;
+  return 1;
+}
+
+// Remove and return the lowest replica slot (node-loss promotion picks a
+// survivor); -1 when the entry has no replicas or is missing.
+int rtpu_refs_pop_replica(void* h, const uint8_t* oid) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->map.find(
+      std::string(reinterpret_cast<const char*>(oid), kOidLen));
+  if (it == r->map.end() || it->second.replicas == 0) return -1;
+  int slot = __builtin_ctzll(it->second.replicas);
+  it->second.replicas &= it->second.replicas - 1;
+  return slot;
+}
+
+// The raw replica slot mask (0 for missing entries) — Python decodes the
+// bits back to node ids for `replica_nodes`/broadcast planning.
+uint64_t rtpu_refs_replica_mask(void* h, const uint8_t* oid) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->map.find(
+      std::string(reinterpret_cast<const char*>(oid), kOidLen));
+  return it == r->map.end() ? 0 : it->second.replicas;
+}
+
+// Spill path: the shm segment is leaving; every pulled copy of it gets
+// unlinked, so the location set empties without touching sealed state.
+int rtpu_refs_clear_replicas(void* h, const uint8_t* oid) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->map.find(
+      std::string(reinterpret_cast<const char*>(oid), kOidLen));
+  if (it == r->map.end()) return -1;
+  it->second.replicas = 0;
+  return 0;
+}
+
+int rtpu_refs_num_replicas(void* h, const uint8_t* oid) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  auto it = r->map.find(
+      std::string(reinterpret_cast<const char*>(oid), kOidLen));
+  if (it == r->map.end()) return -1;
+  return __builtin_popcountll(it->second.replicas);
+}
+
+// A node died: clear its slot bit from every location set (cold path —
+// full scan, like the Python mark_node_lost scan it replaces).
+void rtpu_refs_drop_slot(void* h, int32_t slot) {
+  auto* r = static_cast<RefIndex*>(h);
+  if (slot < 0 || slot >= kMaxSlots) return;
+  uint64_t mask = ~(1ULL << slot);
+  std::lock_guard<std::mutex> g(r->mu);
+  for (auto& kv : r->map) kv.second.replicas &= mask;
+}
+
+// Pick the pull source for each oid (one call per dep set — the `locate`
+// batch API).  out[i]: -2 unknown entry, -1 use the primary location,
+// otherwise the chosen replica slot.  prefer_slot is the consumer's own
+// node (its copy wins: zero-copy attach); with no preference match the
+// choice round-robins over {origin} + replicas in ascending-slot order.
+void rtpu_refs_locate(void* h, const uint8_t* oids, int64_t n,
+                      int32_t prefer_slot, int32_t* out) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  for (int64_t i = 0; i < n; ++i) {
+    auto it = r->map.find(ref_key(oids, i));
+    if (it == r->map.end()) {
+      out[i] = -2;
+      continue;
+    }
+    RefEntry& e = it->second;
+    if (e.replicas == 0) {
+      out[i] = -1;
+      continue;
+    }
+    if (prefer_slot >= 0) {
+      if (prefer_slot == e.origin_slot) {
+        out[i] = -1;
+        continue;
+      }
+      if (prefer_slot < kMaxSlots && (e.replicas & (1ULL << prefer_slot))) {
+        out[i] = prefer_slot;
+        continue;
+      }
+    }
+    int n_rep = __builtin_popcountll(e.replicas);
+    int idx = e.rr % (1 + n_rep);
+    ++e.rr;
+    if (idx == 0) {
+      out[i] = -1;  // the origin's turn
+      continue;
+    }
+    uint64_t m = e.replicas;
+    for (int k = 1; k < idx; ++k) m &= m - 1;  // drop idx-1 lowest bits
+    out[i] = __builtin_ctzll(m);
+  }
+}
+
+void rtpu_refs_clear(void* h) {
+  auto* r = static_cast<RefIndex*>(h);
+  std::lock_guard<std::mutex> g(r->mu);
+  r->map.clear();
+}
+
+}  // extern "C"

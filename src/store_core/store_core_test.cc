@@ -29,6 +29,30 @@ uint64_t rtpu_store_capacity(void* h);
 uint64_t rtpu_store_num_objects(void* h);
 uint64_t rtpu_store_num_free_blocks(void* h);
 void rtpu_store_close(void* h, int unlink_file);
+void* rtpu_refs_create();
+void rtpu_refs_ensure(void* h, const uint8_t* oids, int64_t n,
+                      int32_t reason);
+int rtpu_refs_contains(void* h, const uint8_t* oid);
+void rtpu_refs_add(void* h, const uint8_t* oids, int64_t n, int32_t reason,
+                   int64_t delta);
+int64_t rtpu_refs_remove(void* h, const uint8_t* oids, int64_t n,
+                         int32_t reason, int64_t delta, uint8_t* dead_out);
+int rtpu_refs_seal(void* h, const uint8_t* oid);
+int rtpu_refs_unseal(void* h, const uint8_t* oid);
+int rtpu_refs_erase(void* h, const uint8_t* oid);
+int rtpu_refs_get(void* h, const uint8_t* oid, int64_t* count_out,
+                  int32_t* sealed_out, int32_t* pins_out);
+void rtpu_refs_get_batch(void* h, const uint8_t* oids, int64_t n,
+                         int64_t* counts, int32_t* pins);
+uint64_t rtpu_refs_size(void* h);
+int rtpu_refs_set_origin(void* h, const uint8_t* oid, int32_t slot);
+int rtpu_refs_add_replica(void* h, const uint8_t* oid, int32_t slot);
+int rtpu_refs_pop_replica(void* h, const uint8_t* oid);
+int rtpu_refs_num_replicas(void* h, const uint8_t* oid);
+void rtpu_refs_drop_slot(void* h, int32_t slot);
+void rtpu_refs_locate(void* h, const uint8_t* oids, int64_t n,
+                      int32_t prefer_slot, int32_t* out);
+void rtpu_refs_clear(void* h);
 }
 
 namespace {
@@ -334,6 +358,174 @@ void test_close_vs_capacity() {
   std::puts("  close vs capacity OK");
 }
 
+// -- RefIndex ---------------------------------------------------------------
+
+constexpr int32_t kHandle = 0, kTaskArg = 1, kContained = 2;
+
+// Pack a contiguous oid array for the batch calls.
+std::vector<uint8_t> pack_oids(const std::vector<int>& ids) {
+  std::vector<uint8_t> out(ids.size() * 16);
+  for (size_t i = 0; i < ids.size(); ++i) {
+    Oid o(ids[i]);
+    std::memcpy(out.data() + i * 16, o.b, 16);
+  }
+  return out;
+}
+
+void test_refs_lifecycle() {
+  void* r = rtpu_refs_create();
+  auto oids = pack_oids({1, 2, 3});
+  rtpu_refs_ensure(r, oids.data(), 3, kHandle);
+  // setdefault semantics: re-ensure must not reset counts
+  rtpu_refs_add(r, oids.data(), 1, kTaskArg, 2);
+  rtpu_refs_ensure(r, oids.data(), 3, kHandle);
+  int64_t count = 0;
+  int32_t sealed = 0, pins[8] = {0};
+  assert(rtpu_refs_get(r, oids.data(), &count, &sealed, pins) == 0);
+  assert(count == 3 && sealed == 0 && pins[kHandle] == 1 &&
+         pins[kTaskArg] == 2);
+  assert(rtpu_refs_size(r) == 3);
+  assert(rtpu_refs_contains(r, oids.data()) == 1);
+
+  // add on a missing oid is a no-op, never a resurrection
+  auto ghost = pack_oids({99});
+  rtpu_refs_add(r, ghost.data(), 1, kHandle, 5);
+  assert(rtpu_refs_contains(r, ghost.data()) == 0);
+
+  // remove to zero while UNSEALED: entry lingers (negative ok)
+  std::vector<uint8_t> dead(3 * 16);
+  auto two = pack_oids({2});
+  assert(rtpu_refs_remove(r, two.data(), 1, kHandle, 2, dead.data()) == 0);
+  assert(rtpu_refs_get(r, two.data(), &count, &sealed, pins) == 0);
+  assert(count == -1 && pins[kHandle] == 0);  // pins clamp at 0
+  // seal of the lingering entry reclaims it immediately (returns 1)
+  assert(rtpu_refs_seal(r, two.data()) == 1);
+  assert(rtpu_refs_contains(r, two.data()) == 0);
+
+  // sealed entry dies atomically with the decrement that zeroed it
+  auto one = pack_oids({1});
+  assert(rtpu_refs_seal(r, one.data()) == 0);
+  assert(rtpu_refs_remove(r, one.data(), 1, kTaskArg, 2, dead.data()) == 0);
+  assert(rtpu_refs_remove(r, one.data(), 1, kHandle, 1, dead.data()) == 1);
+  assert(std::memcmp(dead.data(), one.data(), 16) == 0);
+  assert(rtpu_refs_contains(r, one.data()) == 0);
+  // double-remove of the erased oid: no-op
+  assert(rtpu_refs_remove(r, one.data(), 1, kHandle, 1, dead.data()) == 0);
+
+  assert(rtpu_refs_erase(r, pack_oids({3}).data()) == 0);
+  assert(rtpu_refs_size(r) == 0);
+  std::puts("  refs lifecycle OK");
+}
+
+void test_refs_locations() {
+  void* r = rtpu_refs_create();
+  auto o = pack_oids({7});
+  rtpu_refs_ensure(r, o.data(), 1, kHandle);
+  assert(rtpu_refs_set_origin(r, o.data(), 0) == 0);
+  assert(rtpu_refs_num_replicas(r, o.data()) == 0);
+  int32_t out = -7;
+  rtpu_refs_locate(r, o.data(), 1, -1, &out);
+  assert(out == -1);  // no replicas: primary
+  assert(rtpu_refs_add_replica(r, o.data(), 2) == 1);
+  assert(rtpu_refs_add_replica(r, o.data(), 2) == 0);  // idempotent
+  assert(rtpu_refs_add_replica(r, o.data(), 0) == 0);  // origin never a replica
+  assert(rtpu_refs_add_replica(r, o.data(), 64) == -2);  // out of mask range
+  assert(rtpu_refs_add_replica(r, o.data(), 5) == 1);
+  assert(rtpu_refs_num_replicas(r, o.data()) == 2);
+
+  // prefer-own-copy wins regardless of rr state
+  rtpu_refs_locate(r, o.data(), 1, 5, &out);
+  assert(out == 5);
+  rtpu_refs_locate(r, o.data(), 1, 0, &out);
+  assert(out == -1);  // consumer IS the origin
+  // round-robin covers origin + both replicas over 3 calls
+  bool saw_origin = false, saw2 = false, saw5 = false;
+  for (int i = 0; i < 3; ++i) {
+    rtpu_refs_locate(r, o.data(), 1, -1, &out);
+    if (out == -1) saw_origin = true;
+    if (out == 2) saw2 = true;
+    if (out == 5) saw5 = true;
+  }
+  assert(saw_origin && saw2 && saw5);
+
+  // node loss: slot drops from every mask; promotion pops the lowest
+  rtpu_refs_drop_slot(r, 2);
+  assert(rtpu_refs_num_replicas(r, o.data()) == 1);
+  assert(rtpu_refs_pop_replica(r, o.data()) == 5);
+  assert(rtpu_refs_pop_replica(r, o.data()) == -1);
+  // unseal resets the location set for the lineage refill
+  assert(rtpu_refs_add_replica(r, o.data(), 3) == 1);
+  assert(rtpu_refs_unseal(r, o.data()) == 0);
+  assert(rtpu_refs_num_replicas(r, o.data()) == 0);
+  rtpu_refs_locate(r, pack_oids({42}).data(), 1, -1, &out);
+  assert(out == -2);  // unknown oid
+  std::puts("  refs locations OK");
+}
+
+// Concurrent refcount churn over the batch API: the head's reader
+// threads add/remove borrows while seals and audits race — the exact
+// GIL-released contention profile of a submission wave.  TSan run
+// (`make test-tsan`) is the data-race proof for the batch refcount API.
+void test_refs_concurrent_churn() {
+  void* r = rtpu_refs_create();
+  constexpr int kIds = 128;
+  constexpr int kThreads = 4;
+  constexpr int kIters = 4000;
+  {
+    std::vector<int> ids;
+    for (int i = 0; i < kIds; ++i) ids.push_back(i);
+    auto all = pack_oids(ids);
+    rtpu_refs_ensure(r, all.data(), kIds, kHandle);
+  }
+  std::atomic<int64_t> deads{0};
+  std::vector<std::thread> ts;
+  for (int t = 0; t < kThreads; ++t) {
+    ts.emplace_back([&, t]() {
+      std::vector<uint8_t> dead(8 * 16);
+      for (int i = 0; i < kIters; ++i) {
+        // small overlapping batches, mixed reasons
+        std::vector<int> ids{(i * 3 + t) % kIds, (i * 5 + t * 7) % kIds,
+                             (i + t * 11) % kIds};
+        auto oids = pack_oids(ids);
+        rtpu_refs_ensure(r, oids.data(), 3, kHandle);
+        rtpu_refs_add(r, oids.data(), 3, kTaskArg, 1);
+        if (i % 2 == 0) rtpu_refs_seal(r, oids.data());
+        deads += rtpu_refs_remove(r, oids.data(), 3, kTaskArg, 1,
+                                  dead.data());
+        if (i % 7 == t) {
+          deads += rtpu_refs_remove(r, oids.data(), 1, kHandle, 1,
+                                    dead.data());
+        }
+        int64_t c = 0;
+        int32_t s = 0, pins[8];
+        (void)rtpu_refs_get(r, oids.data(), &c, &s, pins);
+        (void)rtpu_refs_size(r);
+        if (i % 63 == 0) {
+          std::vector<int64_t> counts(3);
+          std::vector<int32_t> batch_pins(3 * 8);
+          rtpu_refs_get_batch(r, oids.data(), 3, counts.data(),
+                              batch_pins.data());
+        }
+      }
+    });
+  }
+  for (auto& th : ts) th.join();
+  // single-threaded post-check: every surviving entry readable, pins
+  // non-negative, and the task_arg pins all drained (adds == removes)
+  uint64_t live = rtpu_refs_size(r);
+  for (int i = 0; i < kIds; ++i) {
+    Oid o(i);
+    int64_t c = 0;
+    int32_t s = 0, pins[8];
+    if (rtpu_refs_get(r, o.b, &c, &s, pins) == 0) {
+      for (int k = 0; k < 8; ++k) assert(pins[k] >= 0);
+      assert(pins[kTaskArg] == 0);
+    }
+  }
+  std::printf("  refs concurrent churn OK (%llu live, %lld reclaimed)\n",
+              (unsigned long long)live, (long long)deads.load());
+}
+
 }  // namespace
 
 int main() {
@@ -344,6 +536,9 @@ int main() {
   test_churn_invariants();
   test_concurrent_churn();
   test_close_vs_capacity();
+  test_refs_lifecycle();
+  test_refs_locations();
+  test_refs_concurrent_churn();
   std::puts("store_core_test: ALL OK");
   return 0;
 }

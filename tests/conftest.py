@@ -21,10 +21,14 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("RAY_TPU_LOG_LEVEL", "WARNING")
-# The suite runs over the TYPED wire protocol so every protobuf arm is
-# exercised by every cluster test (production defaults to the native
-# fast path for same-version peers — see _private/wire.py).
+# The suite runs over the TYPED wire protocol (also the production
+# default since the packed hot-frame codec landed) so every packed and
+# protobuf arm is exercised by every cluster test — see _private/wire.py.
 os.environ.setdefault("RAY_TPU_WIRE", "proto")
+# ... and with a SHARDED head dispatch (also the production default):
+# the whole actor/gang/concurrency-group surface runs at shard count 4,
+# pinned explicitly so a default change can't silently shrink coverage.
+os.environ.setdefault("RAY_TPU_HEAD_SHARDS", "4")
 
 import jax  # noqa: E402
 

@@ -1,0 +1,9 @@
+"""Packed-codec fixture: the _UNPACK table is missing "beta" — R1 must
+flag the skew (a frame type in the encoder but not the decoder is a
+silent wire break at the peer)."""
+
+_FRAME_IDS = {"alpha": 1, "beta": 2}
+
+_PACK = {"alpha": None, "beta": None}
+
+_UNPACK = {"alpha": None}  # EXPECT:R1

@@ -1,0 +1,15 @@
+"""Send + dispatch sites for the packed fixture types: both frame types
+have live senders and handlers, so ONLY the table-skew finding fires."""
+
+
+def serve(conn, msg):
+    mtype = msg["type"]
+    if mtype == "alpha":
+        conn.ack()
+    elif mtype == "beta":
+        conn.ack()
+
+
+def emit(conn):
+    conn.send({"type": "alpha"})
+    conn.send({"type": "beta"})

@@ -205,6 +205,7 @@ def test_scale_up_counts_whole_slice_capacity(ray_start_regular):
     finally:
         with node.lock:
             node.pending_tasks.clear()
+            node._starved.clear()
 
 
 def test_replica_decisions_go_through_replica_scaler(ray_start_regular):

@@ -129,6 +129,7 @@ def test_autoscaler_scales_tpu_slices(fake_gcloud, ray_start_regular):
     assert prov.non_terminated_nodes(), "no slice launched for TPU demand"
     with node.lock:
         node.pending_tasks.clear()
+        node._starved.clear()
     scaler.update()  # demand gone + idle_timeout 0 -> scale back down
     assert prov.non_terminated_nodes() == []
 

@@ -7,6 +7,7 @@ the object plane.
 """
 
 import gc
+import os
 
 import numpy as np
 import pytest
@@ -150,6 +151,10 @@ def test_cpp_unit_tests_under_asan():
     sys.stdout.write(out.stdout[-1000:])
     assert out.returncode == 0, out.stderr[-2000:]
     assert "ALL OK" in out.stdout
+    # the RefIndex (head refcount hot maps) suite ran too — including
+    # the concurrent batch add/remove churn, the race profile of a
+    # GIL-released submission wave
+    assert "refs concurrent churn OK" in out.stdout
 
 
 @pytest.mark.slow
@@ -188,3 +193,130 @@ def test_cpp_capacity_vs_close_under_tsan():
             os.environ["RAY_TPU_STORE_TSAN"] = env_before
     assert path is not None and path.endswith("_tsan.so")
     assert os.path.exists(path)
+
+
+# ---------------------------------------------------------------------------
+# RefIndex: the head registry's hot maps in C++ (+ the Python twin)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not available(), reason="native store core unavailable")
+def test_refindex_binding_lifecycle():
+    """ctypes binding contract: ensure/add/remove batches over packed
+    oids, erase-at-zero atomic with the decrement, pins clamped at 0."""
+    from ray_tpu._private.native import RefIndex
+
+    ix = RefIndex()
+    oids = [bytes([i]) * 16 for i in (1, 2, 3)]
+    packed = b"".join(oids)
+    ix.ensure(packed, 3, 0)
+    ix.ensure(packed, 3, 0)  # setdefault: second call is a no-op
+    ix.add(packed, 3, 1, 2)  # +2 task_arg each
+    count, sealed, pins = ix.get(oids[0])
+    assert (count, sealed) == (3, False)
+    assert pins[0] == 1 and pins[1] == 2
+    assert ix.size() == 3
+
+    # sealed + decrement-to-zero erases atomically and reports the oid
+    assert ix.seal(oids[0]) == 0
+    dead = ix.remove(oids[0], 1, 1, 2)
+    assert dead == []
+    dead = ix.remove(oids[0], 1, 0, 1)
+    assert dead == [oids[0]]
+    assert ix.get(oids[0]) is None
+
+    # unsealed entries linger negative; seal() then reclaims (returns 1)
+    assert ix.remove(oids[1], 1, 0, 5) == []
+    count, sealed, pins = ix.get(oids[1])
+    assert count == -2 and pins[0] == 0  # pins clamp at zero
+    assert ix.seal(oids[1]) == 1
+    assert not ix.contains(oids[1])
+
+    counts, pin_rows = ix.get_batch(packed, 3)
+    assert counts[0] is None and counts[1] is None and counts[2] == 3
+    assert pin_rows[2][1] == 2
+    ix.clear()
+    assert ix.size() == 0
+
+
+def test_registry_parity_native_vs_python_refs():
+    """The pure-Python ref index is a drop-in twin of the C one: the
+    same lifecycle script must produce identical audit rows and
+    identical survivors through the full ObjectRegistry surface."""
+    import importlib
+
+    import ray_tpu._private.object_store as osm
+
+    def run(flag):
+        os.environ["RAY_TPU_NATIVE_REFS"] = flag
+        try:
+            reg = osm.ObjectRegistry()
+            a, b, c = (bytes([9, i]) * 8 for i in (1, 2, 3))
+            reg.create_pending_batch([a, b, c])
+            reg.seal(a, osm.ObjectLocation(inline=b"A"), owner="w1",
+                     owner_kind="worker")
+            reg.seal(b, osm.ObjectLocation(inline=b"BB"), contained=[a],
+                     owner="w1", owner_kind="worker")
+            reg.add_refs([a, b], reason="task_arg")
+            reg.remove_refs([a], reason="handle")  # containment keeps a
+            rows = {r["object_id"]: (r["ref_count"], r["pins"],
+                                     r["pin_reason"]) for r in
+                    reg.memory_audit()}
+            summary = reg.owner_summary()
+            # drop everything: b's deletion cascades to a
+            reg.remove_refs([a, b], reason="task_arg")
+            reg.remove_refs([b], reason="handle")
+            survivors = (reg.contains(a), reg.contains(b), reg.contains(c))
+            reg.shutdown()
+            return type(reg._refs).__name__, rows, summary, survivors
+        finally:
+            os.environ.pop("RAY_TPU_NATIVE_REFS", None)
+
+    name_native, rows_n, sum_n, surv_n = run("1")
+    name_py, rows_p, sum_p, surv_p = run("0")
+    assert name_py == "_PyRefs"
+    if name_native != "_NativeRefs":
+        pytest.skip("native refs unavailable in this environment")
+    assert rows_n == rows_p
+    assert sum_n == sum_p
+    assert surv_n == surv_p == (False, False, True)
+
+
+def test_registry_full_lifecycle_on_python_refs():
+    """A real cluster runs end-to-end with RAY_TPU_NATIVE_REFS=0 (the
+    no-toolchain fallback): puts, tasks, refcount-driven reclamation."""
+    import subprocess
+    import sys
+
+    code = r"""
+import gc
+import ray_tpu
+from ray_tpu._private.worker import global_worker
+
+ray_tpu.init(num_cpus=2, num_tpus=0)
+assert type(global_worker.node.registry._refs).__name__ == "_PyRefs"
+
+@ray_tpu.remote
+def double(x):
+    return x * 2
+
+assert ray_tpu.get([double.remote(i) for i in range(16)], timeout=120) \
+    == [i * 2 for i in range(16)]
+ref = ray_tpu.put(b"z" * 4096)
+assert ray_tpu.get(ref) == b"z" * 4096
+oid = ref.binary()
+reg = global_worker.node.registry
+del ref
+gc.collect()
+global_worker.flush_removals()
+import time
+deadline = time.time() + 10
+while reg.contains(oid) and time.time() < deadline:
+    time.sleep(0.1)
+assert not reg.contains(oid), "refcount reclamation broken on _PyRefs"
+ray_tpu.shutdown()
+print("PYREFS_OK")
+"""
+    env = dict(os.environ, RAY_TPU_NATIVE_REFS="0")
+    proc = subprocess.run([sys.executable, "-c", code], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert "PYREFS_OK" in proc.stdout, proc.stderr[-3000:]

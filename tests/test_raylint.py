@@ -90,6 +90,21 @@ def test_r1_protocol_fixture():
     assert analyze(cfg, rules=["R1"]) == []
 
 
+def test_r1_packed_codec_table_skew():
+    """A frame type present in _FRAME_IDS/_PACK but missing from _UNPACK
+    fails R1 (packed-codec parity — both wire directions, same contract
+    as the Envelope arms)."""
+    cfg = _fixture_config(
+        package="r1_packed",
+        head_handler_modules=("r1_packed/hub.py",),
+        packed_codec_module="r1_packed/codec.py")
+    findings = analyze(cfg, rules=["R1"])
+    packed = [f for f in findings if f.detail.startswith("packed-")]
+    assert [f.detail for f in packed] == ["packed-table-skew:_UNPACK:beta"], \
+        "\n".join(f.render() for f in findings)
+    _assert_rule_matches(cfg, "R1", ["r1_packed/codec.py"], [])
+
+
 def test_r1_catches_removed_handler(repo_project):
     """The acceptance mutation: delete one real dispatch arm from a
     copy of node.py and R1 must flag every sender of that type."""
@@ -422,8 +437,13 @@ def test_lockwitness_live_cluster_cycle_free(tmp_path):
 
             assert ray_tpu.get([f.remote(i) for i in range(12)]) == \\
                 [i + 1 for i in range(12)]
-            c = Counter.remote()
-            assert ray_tpu.get([c.inc.remote() for _ in range(5)])[-1] == 5
+            # actors on distinct dispatch shards — submit/complete take
+            # shard locks alone, while the kill below nests head lock ->
+            # shard lock; the witness must see both patterns stay acyclic
+            actors = [Counter.remote() for _ in range(3)]
+            for c in actors:
+                assert ray_tpu.get([c.inc.remote() for _ in range(5)])[-1] == 5
+            ray_tpu.kill(actors[0])
             ref = ray_tpu.put(b"x" * (1 << 18))
             assert len(ray_tpu.get(ref)) == 1 << 18
             metrics.Counter("raylint_witness_test_total", "coverage").inc()
@@ -448,6 +468,10 @@ def test_lockwitness_live_cluster_cycle_free(tmp_path):
     assert marked, f"no snapshot line in drive output:\n{proc.stdout}"
     edges = json.loads(marked[-1].split(" ", 1)[1])["edges"]
     assert edges, "witness saw no nested acquisitions — is it on?"
+    # the sharded dispatch is live coverage, not theory: at least one
+    # nested acquisition must involve a shard lock (head -> shard, or
+    # shard -> a leaf like the outbox/registry locks)
+    assert any("node.shard" in e for e in edges), edges
     reports = glob.glob(os.path.join(report_dir, "*.json"))
     assert reports == [], (
         f"lock-order cycles reported: "

@@ -1,18 +1,20 @@
-"""Wire-protocol codec tests (ray_tpu/_private/wire.py + the protobuf
-IDL in ray_tpu/protocol/ray_tpu.proto — reference src/ray/protobuf/).
+"""Wire-protocol codec tests (ray_tpu/_private/wire.py + the packed hot
+codec in packed_wire.py + the protobuf IDL in ray_tpu/protocol/
+ray_tpu.proto — reference src/ray/protobuf/).
 
 The end-to-end proof is the whole suite: RAY_TPU_WIRE defaults to
-"proto", so every cluster test already runs over the typed envelope.
-These tests pin the codec contract itself: dict->proto->dict identity
-for every typed arm, the pickle fallback, version rejection, and
-legacy-frame sniffing.
+"proto", so every cluster test already runs over the typed wire (packed
+hot frames + Envelope long tail).  These tests pin the codec contracts
+themselves: dict->wire->dict identity for every typed arm in BOTH typed
+encodings, the pickle fallback, oversize gating per hot frame type,
+codec/IDL parity, version rejection, and legacy-frame sniffing.
 """
 
 import pickle
 
 import pytest
 
-from ray_tpu._private import wire
+from ray_tpu._private import packed_wire, wire
 from ray_tpu._private.object_store import ObjectLocation
 from ray_tpu.protocol import ray_tpu_pb2 as pb
 
@@ -32,7 +34,8 @@ SHM_LOC = ObjectLocation(shm_name="seg", size=128, node_id="n2",
                          arena_path="/dev/shm/arena", arena_off=4096,
                          arena_key=b"k")
 
-TYPED_MESSAGES = [
+# Hot frames: the packed codec owns these in proto mode (magic 0xB1).
+PACKED_MESSAGES = [
     {"type": "submit_batch",
      "batch": [("task", FULL_SPEC),
                ("actor_task", {"task_id": b"t2", "name": "A.m",
@@ -50,10 +53,12 @@ TYPED_MESSAGES = [
      "worker_pid": 42},
     {"type": "seal", "oid": b"o", "loc": ObjectLocation(spilled_path="/s", size=9),
      "contained": [b"c"]},
-    {"type": "add_ref", "oids": [b"a", b"b"]},
-    {"type": "remove_ref", "oids": [b"a"]},
-    {"type": "kv_put", "ns": "fn", "key": b"k", "value": b"v" * 100},
-    {"type": "kv_get", "ns": "fn", "key": b"k", "req_id": 9},
+    # the packed ref arms carry the pin reason (the Envelope RefUpdate
+    # schema predates it)
+    {"type": "add_ref", "oids": [b"a", b"b"], "reason": "handle"},
+    {"type": "remove_ref", "oids": [b"a"], "reason": "task_arg"},
+    {"type": "metrics_report", "origin": "worker-1",
+     "metrics": {"gauges": {"rss_mb": 41.5}}},
     {"type": "get_locations", "oids": [b"o1", b"o2"], "timeout": None,
      "req_id": 3},
     {"type": "wait", "oids": [b"o"], "num_returns": 1, "timeout": 2.5,
@@ -64,8 +69,16 @@ TYPED_MESSAGES = [
     {"type": "reply", "req_id": 4, "ready": [],
      "locations": {}},  # wait that timed out with nothing ready
     {"type": "reply", "req_id": 5, "timeout": True},
+]
+
+# Typed-but-not-hot frames: these keep the protobuf Envelope arm.
+ENVELOPE_MESSAGES = [
+    {"type": "kv_put", "ns": "fn", "key": b"k", "value": b"v" * 100},
+    {"type": "kv_get", "ns": "fn", "key": b"k", "req_id": 9},
     {"type": "ping"},
 ]
+
+TYPED_MESSAGES = PACKED_MESSAGES + ENVELOPE_MESSAGES
 
 
 @pytest.mark.parametrize("msg", TYPED_MESSAGES,
@@ -74,13 +87,38 @@ def test_typed_roundtrip_identity(msg):
     assert wire.decode(wire.encode(msg)) == msg
 
 
-@pytest.mark.parametrize("msg", TYPED_MESSAGES,
+@pytest.mark.parametrize("msg", PACKED_MESSAGES, ids=lambda m: m["type"])
+def test_hot_frames_take_the_packed_arm(msg):
+    # a silent fallback to the Envelope (or pickle) still roundtrips and
+    # would regress the hot-path cost unnoticed — pin the encoding
+    frame = wire.encode(msg)
+    assert frame[:1] == packed_wire.MAGIC_BYTE, msg["type"]
+    assert frame[1] == packed_wire.PACKED_VERSION
+
+
+@pytest.mark.parametrize("msg", PACKED_MESSAGES, ids=lambda m: m["type"])
+def test_hot_frames_envelope_arm_still_works(msg):
+    # RAY_TPU_WIRE=envelope (and any pre-packed peer): the same hot
+    # frames must round-trip through the protobuf arm.  The ref arms
+    # with a reason fall back to pickle there (RefUpdate predates pin
+    # reasons and would silently drop them).
+    frame = wire.encode(msg, packed=False)
+    assert wire.decode(frame) == msg
+    reason = msg.get("reason", "handle")
+    if msg["type"] in ("add_ref", "remove_ref") and reason != "handle":
+        assert frame[:1] == b"\x80"
+    elif msg["type"] == "metrics_report":
+        assert frame[:1] == b"\x80"  # no Envelope arm for metrics
+    else:
+        assert frame[:1] == b"\x08"
+
+
+@pytest.mark.parametrize("msg", ENVELOPE_MESSAGES,
                          ids=lambda m: m["type"] + str(m.get("req_id", "")))
-def test_typed_messages_do_not_use_pickle(msg):
-    # every typed message — including all three reply shapes on the
-    # ray.get/ray.wait RTT path — must actually take a typed arm; a
-    # silent fallback to pickle still roundtrips and would otherwise
-    # regress unnoticed
+def test_envelope_messages_do_not_use_pickle(msg):
+    # every typed long-tail message — including all three reply shapes
+    # on the ray.get/ray.wait RTT path — must actually take the typed
+    # Envelope arm
     frame = wire.encode(msg)
     assert frame[:1] == b"\x08", msg["type"]
     env = pb.Envelope.FromString(frame)
@@ -170,6 +208,108 @@ def test_serialize_raise_falls_back(monkeypatch):
     assert wire.decode(frame) == msg
 
 
+_OVERSIZE_MESSAGES = [
+    {"type": "submit_batch",
+     "batch": [("task", dict(FULL_SPEC, args_blob=b"A" * 2048))]},
+    {"type": "execute", "spec": dict(FULL_SPEC, args_blob=b"A" * 2048)},
+    {"type": "task_done",
+     "seals": [(b"r1", ObjectLocation(inline=b"A" * 2048), [])],
+     "spec_ref": {"task_id": b"t", "return_ids": [b"r1"],
+                  "is_actor_creation": None, "actor_id": None, "name": "f"},
+     "failed": False, "error_str": None, "exec_start": 0.0, "exec_end": 0.0,
+     "worker_pid": 1},
+    {"type": "seal", "oid": b"o", "loc": ObjectLocation(inline=b"A" * 2048),
+     "contained": []},
+    {"type": "add_ref", "oids": [b"A" * 2048], "reason": "handle"},
+    {"type": "remove_ref", "oids": [b"A" * 2048], "reason": "handle"},
+    {"type": "metrics_report", "origin": "w",
+     "metrics": {"blob": "A" * 2048}},
+    {"type": "get_locations", "oids": [b"A" * 2048], "timeout": None,
+     "req_id": 3},
+    {"type": "wait", "oids": [b"A" * 2048], "num_returns": 1,
+     "timeout": None, "req_id": 4},
+    {"type": "reply", "req_id": 5,
+     "locations": {b"o": ObjectLocation(inline=b"A" * 2048)}},
+]
+
+
+@pytest.mark.parametrize("msg", _OVERSIZE_MESSAGES, ids=lambda m: m["type"])
+def test_oversize_packed_frame_falls_back_per_type(msg, monkeypatch):
+    """The >2 GiB interop gate covers EVERY packed arm: an oversize
+    payload in any hot frame type must land on the raw-pickle arm (no
+    cap there) and round-trip — exercised with the cap lowered so the
+    test doesn't allocate 2 GiB.  The Envelope fallback chain is gated
+    too, so the frame can never reach a peer unparseable."""
+    monkeypatch.setattr(packed_wire, "_MAX_FRAME", 1 << 10)
+    monkeypatch.setattr(wire, "_PB_MAX_FRAME", 1 << 10)
+    frame = wire.encode(msg)
+    assert frame[:1] == b"\x80", msg["type"]
+    assert wire.decode(frame) == msg
+    # under the gate the packed arm still wins for the same type
+    small = next(m for m in PACKED_MESSAGES if m["type"] == msg["type"])
+    assert wire.encode(small)[:1] == packed_wire.MAGIC_BYTE
+
+
+def test_packed_version_rejection():
+    frame = bytearray(wire.encode(PACKED_MESSAGES[-1]))
+    assert frame[:1] == packed_wire.MAGIC_BYTE
+    frame[1] = packed_wire.PACKED_VERSION + 1
+    with pytest.raises(wire.WireDecodeError):
+        wire.decode(bytes(frame))
+    frame[1] = packed_wire.PACKED_VERSION
+    frame[2] = 0xEE  # unknown frame id
+    with pytest.raises(wire.WireDecodeError):
+        wire.decode(bytes(frame))
+
+
+def test_packed_tables_in_lockstep():
+    """A frame type added to the codec but not the decoder (or vice
+    versa) is a silent wire break; raylint R1 gates this statically, the
+    test pins it at runtime."""
+    assert packed_wire._PACK.keys() == packed_wire._UNPACK.keys()
+    assert packed_wire._PACK.keys() == packed_wire._FRAME_IDS.keys()
+    ids = list(packed_wire._FRAME_IDS.values())
+    assert len(ids) == len(set(ids))  # frame ids collide -> misdecode
+
+
+def test_packed_spec_table_matches_proto_descriptor():
+    """The packed TaskSpec layout is generated from the IDL: every field
+    table entry must match the .proto field number and name, so codec
+    and schema cannot drift apart (the 'generated from ray_tpu.proto'
+    contract)."""
+    by_number = {f.number: f for f in pb.TaskSpec.DESCRIPTOR.fields}
+    for key, (number, kind) in packed_wire._SPEC_FIELDS.items():
+        f = by_number[number]
+        assert f.name == key, (key, number, f.name)
+    assert by_number[packed_wire._EXTRA_FIELD].name == "extra"
+    # presence bits are field-number-derived: no two fields may share one
+    numbers = [n for n, _ in packed_wire._SPEC_FIELDS.values()]
+    assert len(numbers) == len(set(numbers))
+
+
+def test_wire_mode_selection(monkeypatch):
+    import io
+
+    class _FakeConn:
+        def send_bytes(self, b):
+            self.sent = b
+
+    for mode, first_bytes in (
+        (None, (packed_wire.MAGIC_BYTE,)),        # default IS proto
+        ("proto", (packed_wire.MAGIC_BYTE,)),
+        ("envelope", (b"\x08",)),
+        ("pickle", (b"\x80",)),
+    ):
+        if mode is None:
+            monkeypatch.delenv("RAY_TPU_WIRE", raising=False)
+        else:
+            monkeypatch.setenv("RAY_TPU_WIRE", mode)
+        conn = wire.wrap(_FakeConn())
+        conn.send({"type": "seal", "oid": b"o",
+                   "loc": ObjectLocation(inline=b"x"), "contained": []})
+        assert conn._conn.sent[:1] in first_bytes, mode
+
+
 def test_legacy_pickle_frame_sniffing():
     # a RAY_TPU_WIRE=pickle peer's frame (raw pickle starts 0x80) decodes
     frame = pickle.dumps({"type": "pong"})
@@ -209,16 +349,16 @@ def test_pickled_envelope_arm_still_decodes():
     assert wire.decode(env.SerializeToString()) == {"type": "x", "v": 1}
 
 
-def test_default_wire_cluster_end_to_end():
-    """A cluster in the DEFAULT send encoding (raw pickle frames; the
-    suite otherwise forces RAY_TPU_WIRE=proto) runs tasks/actors/puts.
-    Covers the production default's send path and the always-sniffing
-    receive invariant."""
+def test_pickle_wire_cluster_end_to_end():
+    """A cluster in the raw-pickle send encoding (RAY_TPU_WIRE=pickle —
+    the pre-flip default, still fully supported) runs tasks/actors/puts.
+    Covers the pickle send path and the always-sniffing receive
+    invariant now that the DEFAULT is the typed wire."""
     import os
     import subprocess
     import sys
 
-    env = {k: v for k, v in os.environ.items() if k != "RAY_TPU_WIRE"}
+    env = dict(os.environ, RAY_TPU_WIRE="pickle")
     proc = subprocess.run([sys.executable, "-c", """
 import ray_tpu
 ray_tpu.init(num_cpus=2)
@@ -245,13 +385,13 @@ print("DEFAULT_WIRE_OK")
 
 
 def test_mixed_mode_peers_interoperate():
-    """A proto-sending driver joins a default (pickle-sending) head:
-    both directions work because every receiver sniffs."""
+    """A proto-sending driver joins a pickle-sending head: both
+    directions work because every receiver sniffs."""
     import os
     import subprocess
     import sys
 
-    env = {k: v for k, v in os.environ.items() if k != "RAY_TPU_WIRE"}
+    env = dict(os.environ, RAY_TPU_WIRE="pickle")
     proc = subprocess.run([sys.executable, "-c", """
 import os, subprocess, sys
 import ray_tpu
