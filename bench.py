@@ -308,6 +308,37 @@ def run_ingest_bench() -> dict:
         ray_tpu.shutdown()
 
 
+def _synthetic_atari_ppo(n_workers: int, n_envs: int, frag: int,
+                         num_sgd_iter: int, has_tpu: bool):
+    """Shared scaffold for the RL benches: synthetic-Atari PPO fed by the
+    chip-resident PolicyServer over frame-stack transport.  Returns
+    ``(algo, server)`` — the caller must hold the server handle alive for
+    the run (a dropped handle reaps the actor)."""
+    from ray_tpu.rllib import PPOConfig, serve_policy, synthetic_atari_creator
+
+    cfg = (
+        PPOConfig()
+        .environment(env_creator=synthetic_atari_creator,
+                     env_config={"episode_len": 400})
+        .rollouts(num_rollout_workers=n_workers, num_envs_per_worker=n_envs,
+                  rollout_fragment_length=frag)
+        .training(
+            train_batch_size=n_workers * n_envs * frag,
+            sgd_minibatch_size=256 if has_tpu else 32,
+            num_sgd_iter=num_sgd_iter,
+            fcnet_hiddens=(256,) if has_tpu else (32,),
+            entropy_coeff=0.01,
+        )
+        .debugging(seed=0)
+    ).to_dict()
+    server, overrides = serve_policy(
+        cfg, obs_dim=84 * 84 * 4, num_actions=6, obs_shape=(84, 84, 4),
+        num_tpus=1 if has_tpu else 0, max_concurrency=4 * n_workers,
+        frame_stack_transport=True)
+    cfg.update(overrides)
+    return cfg.pop("_algo_class")(config=cfg), server
+
+
 def run_rl_bench() -> dict:
     """RLlib north star (BASELINE config 4 shape): PPO on Atari-shaped
     synthetic frames — parallel rollout workers stepping 84x84x4 uint8
@@ -318,31 +349,12 @@ def run_rl_bench() -> dict:
     import time
 
     import ray_tpu
-    from ray_tpu.rllib import PPOConfig, serve_policy, synthetic_atari_creator
 
     has_tpu = bool(int(os.environ.get("RAY_TPU_BENCH_TPUS", "1")))
     ray_tpu.init(num_cpus=12, num_tpus=1 if has_tpu else 0)
     n_workers, n_envs, frag = (4, 64, 16) if has_tpu else (2, 4, 8)
-    cfg = (
-        PPOConfig()
-        .environment(env_creator=synthetic_atari_creator,
-                     env_config={"episode_len": 400})
-        .rollouts(num_rollout_workers=n_workers, num_envs_per_worker=n_envs,
-                  rollout_fragment_length=frag)
-        .training(
-            train_batch_size=n_workers * n_envs * frag,
-            sgd_minibatch_size=256 if has_tpu else 32,
-            num_sgd_iter=4, fcnet_hiddens=(256,) if has_tpu else (32,),
-            entropy_coeff=0.01,
-        )
-        .debugging(seed=0)
-    ).to_dict()
-    server, overrides = serve_policy(
-        cfg, obs_dim=84 * 84 * 4, num_actions=6, obs_shape=(84, 84, 4),
-        num_tpus=1 if has_tpu else 0, max_concurrency=4 * n_workers,
-        frame_stack_transport=True)
-    cfg.update(overrides)
-    algo = cfg.pop("_algo_class")(config=cfg)
+    algo, server = _synthetic_atari_ppo(
+        n_workers, n_envs, frag, num_sgd_iter=4, has_tpu=has_tpu)
     try:
         algo.step()  # warmup: XLA compiles (sample fwd + SGD fwd/bwd)
         t0 = time.perf_counter()
@@ -365,6 +377,133 @@ def run_rl_bench() -> dict:
     if rew == rew:  # episode metrics exist once episodes complete
         out["rl_episode_reward_mean"] = round(rew, 2)
     return out
+
+
+def _rl_span_attribution(t_start: float) -> dict:
+    """Fold the flight recorder's ``rllib`` spans (emitted by every
+    rollout worker's sample loop and the PolicyServer) into phase shares:
+    rollout env CPU vs connector transforms vs PolicyServer inference
+    compute vs transport (worker-observed inference wait minus server
+    compute) vs GAE postprocess.  This is how the scaling knee is
+    ATTRIBUTED, not guessed."""
+    from ray_tpu.experimental.state.api import list_events
+
+    rollout = {"env_s": 0.0, "infer_s": 0.0, "connector_s": 0.0,
+               "postprocess_s": 0.0, "wall_s": 0.0, "env_steps": 0}
+    server_infer_s = 0.0
+    for ev in list_events(limit=10_000, source="rllib"):
+        if ev.get("ts", 0.0) < t_start:
+            continue
+        data = ev.get("data") or {}
+        if ev.get("message") == "rollout sample":
+            for k in ("env_s", "infer_s", "connector_s", "postprocess_s"):
+                rollout[k] += float(data.get(k) or 0.0)
+            rollout["wall_s"] += float(ev.get("span_dur") or 0.0)
+            rollout["env_steps"] += int(data.get("env_steps") or 0)
+        elif ev.get("message") == "policy inference":
+            server_infer_s += float(ev.get("span_dur") or 0.0)
+    transport_s = max(0.0, rollout["infer_s"] - server_infer_s)
+    shares = {
+        "rollout_env_cpu": rollout["env_s"],
+        "connectors": rollout["connector_s"],
+        "policy_server_inference": min(server_infer_s, rollout["infer_s"]),
+        "transport": transport_s,
+        "postprocess": rollout["postprocess_s"],
+    }
+    total = sum(shares.values())
+    out = {k: (round(v / total, 3) if total else 0.0)
+           for k, v in shares.items()}
+    # no matching spans (events disabled / ring evicted): say so instead
+    # of letting dict ordering pick a fake bottleneck — the row's whole
+    # point is that the knee is ATTRIBUTED, not guessed
+    out["bottleneck"] = max(shares, key=shares.get) if total else "unattributed"
+    out["rollout_wall_s"] = round(rollout["wall_s"], 2)
+    return out
+
+
+def run_rl_scaling_bench() -> dict:
+    """rl_env_steps_scaling row (ROADMAP item 4): PPO env-steps/s at
+    1/2/4/8 rollout workers feeding the shared PolicyServer on the
+    synthetic Atari env, each count's phase attribution read off the
+    flight recorder, the knee located where marginal scaling collapses
+    and attributed to its dominant phase — plus a single-worker
+    LunarLander-v3 row (the real-env result, local MLP policy)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.rllib import PPOConfig
+
+    has_tpu = bool(int(os.environ.get("RAY_TPU_BENCH_TPUS", "1")))
+    n_envs, frag = (16, 16) if has_tpu else (4, 8)
+    points = []
+    for n_workers in (1, 2, 4, 8):
+        ray_tpu.init(num_cpus=n_workers + 4, num_tpus=1 if has_tpu else 0)
+        try:
+            algo, server = _synthetic_atari_ppo(
+                n_workers, n_envs, frag, num_sgd_iter=2, has_tpu=has_tpu)
+            try:
+                algo.step()  # warmup: XLA compiles on server + workers
+                t0 = time.time()
+                steps0 = algo._timesteps_total
+                tp0 = time.perf_counter()
+                for _ in range(3 if has_tpu else 2):
+                    algo.step()
+                wall = time.perf_counter() - tp0
+                steps = algo._timesteps_total - steps0
+                time.sleep(3.0)  # worker event pushers flush every ~2s
+                attribution = _rl_span_attribution(t0)
+            finally:
+                algo.cleanup()
+        finally:
+            ray_tpu.shutdown()
+        points.append({
+            "workers": n_workers,
+            "env_steps_per_sec": round(steps / wall, 1),
+            "attribution": attribution,
+        })
+    # knee: the last worker count still scaling >= 1.2x over the previous
+    knee = points[0]
+    for prev, cur in zip(points, points[1:]):
+        if cur["env_steps_per_sec"] < 1.2 * prev["env_steps_per_sec"]:
+            break
+        knee = cur
+    row = {
+        "points": points,
+        "knee_workers": knee["workers"],
+        "knee_env_steps_per_sec": knee["env_steps_per_sec"],
+        "knee_bottleneck": knee["attribution"].get("bottleneck"),
+        "envs_per_worker": n_envs,
+        "fragment_length": frag,
+        "env": "synthetic-atari-84x84x4",
+        "host_cpus": os.cpu_count(),
+    }
+
+    # real-env row: single-worker PPO on LunarLander-v3, local MLP policy
+    # (sampling + SGD wall, the reference's timesteps_total / wall)
+    algo = (
+        PPOConfig()
+        .environment("LunarLander-v3")
+        .rollouts(rollout_fragment_length=512, num_envs_per_worker=4)
+        .training(train_batch_size=2048, sgd_minibatch_size=128,
+                  num_sgd_iter=8, lr=3e-4, entropy_coeff=0.01,
+                  gamma=0.999, lambda_=0.98)
+        .debugging(seed=0)
+        .build()
+    )
+    try:
+        algo.train()  # warmup/compile
+        t0 = time.perf_counter()
+        s0 = algo._timesteps_total
+        for _ in range(3):
+            r = algo.train()
+        wall = time.perf_counter() - t0
+        row["lunarlander_single_worker"] = {
+            "env_steps_per_sec": round((algo._timesteps_total - s0) / wall, 1),
+            "episode_reward_mean": round(float(r["episode_reward_mean"]), 1),
+        }
+    finally:
+        algo.cleanup()
+    return {"rl_env_steps_scaling": row}
 
 
 def run_serve_bench() -> dict:
@@ -1764,6 +1903,10 @@ def main() -> None:
     except Exception as e:
         decode_out["rl_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
+        decode_out.update(run_rl_scaling_bench())
+    except Exception as e:
+        decode_out["rl_scaling_error"] = f"{type(e).__name__}: {e}"[:200]
+    try:
         decode_out.update(run_ingest_bench())
     except Exception as e:
         decode_out["ingest_error"] = f"{type(e).__name__}: {e}"[:200]
@@ -1839,5 +1982,32 @@ def main() -> None:
     }))
 
 
+def _rl_scaling_standalone() -> None:
+    """``python bench.py --rl-scaling``: run ONLY the RL scaling row and
+    merge it into BENCH_core.json (same merge-by-metric discipline as
+    ray_perf's scale envelope) — the row is host-CPU-bound, so it belongs
+    with the core rows and must be recordable without a chip."""
+    out = run_rl_scaling_bench()
+    print(json.dumps(out))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_core.json")
+    payload = {"benchmarks": [], "host": "single-node"}
+    if os.path.exists(path):
+        with open(path) as f:
+            payload = json.load(f)
+    rows = [r for r in payload.get("benchmarks", [])
+            if r.get("metric") != "rl_env_steps_scaling"]
+    row = dict(out["rl_env_steps_scaling"])
+    row["metric"] = "rl_env_steps_scaling"
+    rows.append(row)
+    payload["benchmarks"] = rows
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+    print(f"wrote {path}")
+
+
 if __name__ == "__main__":
-    main()
+    if "--rl-scaling" in sys.argv:
+        _rl_scaling_standalone()
+    else:
+        main()

@@ -59,6 +59,11 @@ KNOWN_SOURCES = (
     # tenant registered/driver spawned/driver died/reaped — what doctor's
     # tenant_killed rule and the tenant-kill chaos scenario read
     "client_proxy",
+    # RL sample/train/inference spans (rllib/rollout_worker.py,
+    # algorithm.py train_one_step, policy_server.py): per-fragment
+    # env/inference/connector/postprocess attribution — what the
+    # rl_env_steps_scaling knee attribution and the timeline read
+    "rllib",
 )
 
 # Kill switch for the whole observability layer (events + hot-path metric

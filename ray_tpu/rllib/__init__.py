@@ -6,8 +6,13 @@ actors gathered in a ``WorkerSet``, ``SampleBatch`` columns, GAE
 postprocessing, and jax algorithm families: PPO/A2C/IMPALA (on-policy,
 V-trace for the latter), DQN (replay + target net), SAC (continuous
 control), with vectorized envs, greedy evaluation, and offline JSON IO.
+
+Env<->policy preprocessing is composable ``connectors`` pipelines (the
+reference's ``rllib/connectors/``), and models plug in through the
+``RLModule`` surface (``core/rl_module``) — see those modules' docs.
 """
 
+from ray_tpu.rllib import connectors
 from ray_tpu.rllib.a2c import A2C, A2CConfig
 from ray_tpu.rllib.algorithm import (
     Algorithm,
@@ -28,6 +33,7 @@ from ray_tpu.rllib.multi_agent import (
 from ray_tpu.rllib.envs import SyntheticAtariEnv, synthetic_atari_creator
 from ray_tpu.rllib.offline import JsonReader, JsonWriter
 from ray_tpu.rllib.policy_server import PolicyServer, RemotePolicy, serve_policy
+from ray_tpu.rllib.rl_module import Columns, DefaultActorCriticModule, RLModule
 from ray_tpu.rllib.sac import SAC, SACConfig, SACPolicy
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.postprocessing import compute_gae
@@ -40,6 +46,10 @@ from ray_tpu.rllib.worker_set import WorkerSet
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
+    "connectors",
+    "RLModule",
+    "DefaultActorCriticModule",
+    "Columns",
     "PPO",
     "PPOConfig",
     "A2C",

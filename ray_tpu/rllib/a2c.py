@@ -15,13 +15,15 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, train_one_step
-from ray_tpu.rllib.models import apply_model
+from ray_tpu.rllib.rl_module import Columns
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
 def make_a2c_loss(vf_loss_coeff: float, entropy_coeff: float):
-    def loss(params, batch):
-        logits, values = apply_model(params, batch[SampleBatch.OBS])
+    def loss(module, params, batch):
+        out = module.forward_train(params, batch[SampleBatch.OBS])
+        logits = out[Columns.ACTION_DIST_INPUTS]
+        values = out[Columns.VF_PREDS]
         logp_all = jax.nn.log_softmax(logits)
         actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
         logp = jnp.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]

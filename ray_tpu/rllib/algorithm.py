@@ -42,6 +42,15 @@ class AlgorithmConfig:
             "fcnet_hiddens": (64, 64),
             "seed": 0,
             "framework": "jax",
+            # env<->policy transform pipelines (rllib/connectors); None =
+            # defaults derived from the spaces.  "observation_filter"
+            # appends running-stat normalization to the default pipeline
+            # (the reference's MeanStdFilter config knob).
+            "agent_connectors": None,
+            "action_connectors": None,
+            "observation_filter": None,
+            # RLModule plugin: factory(ConnectorContext) -> RLModule
+            "_rl_module_factory": None,
         }
 
     # -- fluent sections (reference section names) ---------------------
@@ -78,6 +87,31 @@ class AlgorithmConfig:
     def framework(self, framework: str = "jax") -> "AlgorithmConfig":
         if framework != "jax":
             raise ValueError("only framework='jax' is supported")
+        return self
+
+    def connectors(self, *, agent_connectors=None, action_connectors=None,
+                   observation_filter: Optional[str] = None
+                   ) -> "AlgorithmConfig":
+        """Compose the env<->policy transform pipelines.
+
+        ``agent_connectors``/``action_connectors`` accept a list of
+        connector instances, ``(name, kwargs)`` pairs, or a factory
+        ``fn(ctx) -> connectors``; ``observation_filter="MeanStdFilter"``
+        appends running-stat normalization to the default pipeline."""
+        if agent_connectors is not None:
+            self._config["agent_connectors"] = agent_connectors
+        if action_connectors is not None:
+            self._config["action_connectors"] = action_connectors
+        if observation_filter is not None:
+            self._config["observation_filter"] = observation_filter
+        return self
+
+    def rl_module(self, module_factory) -> "AlgorithmConfig":
+        """Plug a custom model in WITHOUT subclassing Policy:
+        ``module_factory(ctx: ConnectorContext) -> RLModule`` builds the
+        network every policy (rollout workers, learner, PolicyServer)
+        routes its forwards through."""
+        self._config["_rl_module_factory"] = module_factory
         return self
 
     def evaluation(self, *, evaluation_interval: Optional[int] = None,
@@ -188,6 +222,7 @@ class Algorithm(Trainable):
         """Default: sample and do nothing (``algorithm.py:1284`` is
         framework-specific; subclasses override)."""
         batch = self.workers.synchronous_parallel_sample()
+        self.workers.sync_filters()
         self._timesteps_total += batch.count
         return {}
 
@@ -196,36 +231,52 @@ class Algorithm(Trainable):
 
     # -- checkpointing (Trainable currency) ----------------------------
     def save_checkpoint(self) -> Dict:
-        return {
-            "policy_state": self.workers.local_worker.policy.get_state(),
+        worker = self.workers.local_worker
+        state = {
+            "policy_state": worker.policy.get_state(),
             "timesteps_total": self._timesteps_total,
             "config": {k: v for k, v in self.config.items()
                        if isinstance(v, (int, float, str, bool, tuple, list, dict, type(None)))},
         }
+        # connector pipelines (running-stat filters etc.) ride checkpoints
+        getter = getattr(worker, "get_connector_state", None)
+        if getter is not None:
+            state["connector_state"] = getter()
+        return state
 
     def load_checkpoint(self, state: Dict) -> None:
         if "policy_state" in state:
             self.workers.local_worker.policy.set_state(state["policy_state"])
         else:  # older checkpoints carried bare weights
             self.workers.local_worker.set_weights(state["weights"])
+        if state.get("connector_state") is not None:
+            self.workers.local_worker.set_connector_state(
+                state["connector_state"])
+            self.workers.sync_connectors()
         self._timesteps_total = state.get("timesteps_total", 0)
         self.workers.sync_weights()
 
     # -- inference ------------------------------------------------------
-    def compute_single_action(self, obs, explore: bool = False) -> int:
-        """Greedy (or sampled) action for one observation."""
+    def compute_single_action(self, obs, explore: bool = False,
+                              episode_start: bool = False) -> int:
+        """Greedy (or sampled) action for one observation.
+
+        Stateful connectors (frame stacks) track the caller's episode on
+        the shared eval stream: pass ``episode_start=True`` on the first
+        observation of each new episode so their state resets with it."""
         worker = self.workers.local_worker
         policy = worker.policy
-        # the same prep as sampling: images stay [H, W, C] only for
-        # conv-bearing policies, everything else flattens
+        if episode_start:
+            from ray_tpu.rllib.rollout_worker import EVAL_ENV_ID
+
+            worker.agent_connectors.reset(EVAL_ENV_ID)
+        # the same pipeline as sampling (eval stream: frozen statistics)
         obs = worker._prep_obs(obs)[None]
         if explore:
             action, _, _ = policy.compute_actions(obs)
             return int(action[0])
-        from ray_tpu.rllib.models import apply_model
-
-        logits, _ = apply_model(policy.params, obs)
-        return int(np.argmax(np.asarray(logits)[0]))
+        # greedy through the policy's RLModule forward_inference path
+        return int(np.asarray(policy.greedy_action(obs))[0])
 
     def get_policy(self):
         return self.workers.local_worker.policy
@@ -242,6 +293,9 @@ def synchronous_parallel_sample(worker_set: WorkerSet, *, max_env_steps: int) ->
         b = worker_set.synchronous_parallel_sample()
         batches.append(b)
         total += b.count
+    # remote workers' running-stat filters (MeanStdFilter) fold into the
+    # learner's pipelines once per sampling round; no-op without stats
+    worker_set.sync_filters()
     return SampleBatch.concat_samples(batches)
 
 
@@ -256,14 +310,23 @@ def train_one_step(
 ) -> Dict[str, float]:
     """Minibatch SGD epochs over one train batch
     (``execution/train_ops.py:26``)."""
+    import time
+
+    from ray_tpu._private import events
+
+    t_wall = time.perf_counter()
     if hasattr(policy, "train_on_batch"):
         # server-resident learner (policy_server.py): the batch crosses
         # the wire once and every SGD update runs device-side — per-
         # minibatch round trips would dominate on a remote-attached chip
-        return policy.train_on_batch(
+        out = policy.train_on_batch(
             batch, num_sgd_iter=num_sgd_iter,
             sgd_minibatch_size=sgd_minibatch_size,
             required_keys=required_keys, seed=int(rng.integers(1 << 31)))
+        events.emit("rllib", "learner train", entity_id="learner",
+                    span_dur=time.perf_counter() - t_wall,
+                    env_steps=batch.count, server_side=True)
+        return out
     metrics: Dict[str, float] = {}
     count = 0
     mb_size = min(sgd_minibatch_size, batch.count)
@@ -275,4 +338,7 @@ def train_one_step(
             for k, v in out.items():
                 metrics[k] = metrics.get(k, 0.0) + v
             count += 1
+    events.emit("rllib", "learner train", entity_id="learner",
+                span_dur=time.perf_counter() - t_wall,
+                env_steps=batch.count, sgd_minibatches=count)
     return {k: v / max(count, 1) for k, v in metrics.items()}

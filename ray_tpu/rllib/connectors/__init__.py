@@ -1,0 +1,64 @@
+"""ray_tpu.rllib.connectors — composable env<->policy transform pipelines.
+
+The reference separates env->policy preprocessing into connectors
+(``rllib/connectors/``); this package is the minimal-but-real cut:
+``AgentConnector`` pipelines on the observation path, ``ActionConnector``
+pipelines on the action path, a ``ConnectorContext`` carrying spaces and
+config, a transform library (flatten / cast / running-stat normalize /
+frame-stack / clip / unsquash), and ``to_state``/``from_state``
+serialization through a name registry so pipelines ride checkpoints and
+pickle through configs to remote workers and the PolicyServer.
+
+Configs opt in through ``AlgorithmConfig.connectors(...)``; with no spec
+the worker installs defaults equivalent to the old hardwired path.
+"""
+
+from ray_tpu.rllib.connectors.action import (
+    ClipAction,
+    DiscreteAction,
+    UnsquashAction,
+    default_action_connectors,
+)
+from ray_tpu.rllib.connectors.agent import (
+    CastObs,
+    ClipObs,
+    FlattenObs,
+    FrameStackObs,
+    NormalizeObs,
+    default_agent_connectors,
+)
+from ray_tpu.rllib.connectors.connector import (
+    ActionConnector,
+    ActionConnectorPipeline,
+    AgentConnector,
+    AgentConnectorPipeline,
+    Connector,
+    ConnectorContext,
+    ConnectorPipeline,
+    build_pipeline,
+    get_connector_class,
+    register_connector,
+)
+
+__all__ = [
+    "Connector",
+    "AgentConnector",
+    "ActionConnector",
+    "ConnectorContext",
+    "ConnectorPipeline",
+    "AgentConnectorPipeline",
+    "ActionConnectorPipeline",
+    "build_pipeline",
+    "register_connector",
+    "get_connector_class",
+    "FlattenObs",
+    "CastObs",
+    "NormalizeObs",
+    "FrameStackObs",
+    "ClipObs",
+    "DiscreteAction",
+    "UnsquashAction",
+    "ClipAction",
+    "default_agent_connectors",
+    "default_action_connectors",
+]

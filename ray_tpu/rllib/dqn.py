@@ -16,18 +16,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, synchronous_parallel_sample
-from ray_tpu.rllib.models import apply_model
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.replay_buffer import ReplayBuffer
+from ray_tpu.rllib.rl_module import Columns
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
 def make_dqn_loss():
     """Huber TD loss on Q(s, a) vs precomputed targets (the target-network
-    max lives outside the loss, computed with the frozen params)."""
+    max lives outside the loss, computed with the frozen params).  The
+    logits head of the module's forward doubles as the Q-value head."""
 
-    def loss(params, batch):
-        q_all, _ = apply_model(params, batch[SampleBatch.OBS])
+    def loss(module, params, batch):
+        q_all = module.forward_train(
+            params, batch[SampleBatch.OBS])[Columns.ACTION_DIST_INPUTS]
         actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
         q = jnp.take_along_axis(q_all, actions[:, None], axis=-1)[:, 0]
         td = q - batch[SampleBatch.VALUE_TARGETS]
@@ -63,17 +65,19 @@ class DQNPolicy(JaxPolicy):
         self._steps = 0
         self._np_rng = np.random.default_rng(kwargs.get("seed", 0) or 0)
 
+        module = self.module
+
         @jax.jit
         def _td_targets(target_params, next_obs, rewards, dones, gamma):
-            q_next, _ = apply_model(target_params, next_obs)
+            q_next = module.forward_train(
+                target_params, next_obs)[Columns.ACTION_DIST_INPUTS]
             return rewards + gamma * (1.0 - dones) * q_next.max(axis=-1)
 
         self._td_targets_jit = _td_targets
 
         @jax.jit
         def _q(params, obs):
-            q_all, _ = apply_model(params, obs)
-            return q_all
+            return module.forward_train(params, obs)[Columns.ACTION_DIST_INPUTS]
 
         self._q_jit = _q
 

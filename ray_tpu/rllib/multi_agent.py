@@ -10,12 +10,22 @@ observations per policy so each tick is one batched forward per policy.
 
 from __future__ import annotations
 
+import copy
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
 from ray_tpu.rllib.algorithm import Algorithm, train_one_step
+from ray_tpu.rllib.connectors import (
+    ActionConnectorPipeline,
+    AgentConnectorPipeline,
+    ConnectorContext,
+    DiscreteAction,
+    NormalizeObs,
+    build_pipeline,
+    default_agent_connectors,
+)
 from ray_tpu.rllib.policy import JaxPolicy
 from ray_tpu.rllib.postprocessing import compute_gae
 from ray_tpu.rllib.ppo import PPOConfig
@@ -104,7 +114,17 @@ class MultiAgentRolloutWorker:
         seed = int(config.get("seed") or 0) + worker_index
 
         loss_factory = config.get("_loss_factory")
+        module_factory = config.get("_rl_module_factory")
         self.policies: Dict[str, JaxPolicy] = {}
+        # per-policy connector pipelines (agents map onto their policy's
+        # pipelines; episode state inside a pipeline is keyed by agent id,
+        # so two agents sharing a policy never share a frame stack).
+        # A config spec applies to every policy; None installs the same
+        # defaults as the single-agent worker.
+        self.agent_connectors: Dict[str, AgentConnectorPipeline] = {}
+        self.action_connectors: Dict[str, ActionConnectorPipeline] = {}
+        agent_spec = config.get("agent_connectors")
+        action_spec = config.get("action_connectors")
         if not self.env.agents:
             raise ValueError(
                 "MultiAgentEnv must list its agent ids in `.agents` at "
@@ -120,25 +140,60 @@ class MultiAgentRolloutWorker:
             obs_space = self.env.observation_space(agent)
             act_space = self.env.action_space(agent)
             obs_shape = tuple(obs_space.shape)
+            ctx = ConnectorContext(
+                obs_shape=obs_shape, obs_dim=int(np.prod(obs_shape)),
+                num_actions=int(act_space.n), discrete=True, config=config)
+            conv = len(obs_shape) == 3
+            # per-policy deepcopy: a spec may carry connector INSTANCES,
+            # and stateful ones (NormalizeObs) must not be shared across
+            # policies with independent obs streams (or shapes)
+            pipe = build_pipeline(
+                AgentConnectorPipeline, ctx,
+                copy.deepcopy(agent_spec)
+                if isinstance(agent_spec, (list, tuple)) else agent_spec)
+            if agent_spec is None:
+                for c in default_agent_connectors(ctx, conv):
+                    pipe.append(c)
+                if config.get("observation_filter") == "MeanStdFilter":
+                    # same knob as the single-agent worker
+                    pipe.append(NormalizeObs())
+            else:
+                # an explicit pipeline may reshape the policy's input
+                # (frame stacking); size the policy off a zeros probe
+                probe = pipe(np.zeros(obs_shape, np.float32),
+                             env_id="__probe__", training=False)
+                pipe.reset("__probe__")
+                ctx.obs_shape = tuple(probe.shape)
+                ctx.obs_dim = int(np.prod(probe.shape))
+            self.agent_connectors[pid] = pipe
+            apipe = build_pipeline(
+                ActionConnectorPipeline, ctx,
+                copy.deepcopy(action_spec)
+                if isinstance(action_spec, (list, tuple)) else action_spec)
+            if action_spec is None:
+                apipe.append(DiscreteAction())
+            self.action_connectors[pid] = apipe
             self.policies[pid] = JaxPolicy(
-                int(np.prod(obs_shape)),
-                int(act_space.n),
+                ctx.obs_dim,
+                ctx.num_actions,
                 lr=config.get("lr", 5e-4),
                 hiddens=tuple(config.get("fcnet_hiddens", (64, 64))),
                 seed=seed * 131 + i,
                 loss_fn=loss_factory(config) if loss_factory else None,
                 grad_clip=config.get("grad_clip", 0.5),
-                obs_shape=obs_shape if len(obs_shape) == 3 else None,
+                obs_shape=ctx.obs_shape if len(ctx.obs_shape) == 3 else None,
+                **({"module": module_factory(ctx)} if module_factory else {}),
             )
-        self._conv = {
-            pid: "conv" in p.params for pid, p in self.policies.items()
-        }
         self.gamma = config.get("gamma", 0.99)
         self.lambda_ = config.get("lambda_", 0.95)
         self.fragment_length = config.get("rollout_fragment_length", 200)
 
         self._obs, _ = self.env.reset(seed=seed)
         self._trails: Dict[Any, _AgentTrail] = {}
+        # fragment-boundary obs already transformed with real episode
+        # state; the next fragment's first tick reuses it (the
+        # single-agent worker's ``prepped`` cache analog)
+        self._boundary_prepped: Dict[Any, np.ndarray] = {}
         self._eps_id = worker_index * 1_000_000
         self._episode_reward = 0.0
         self._episode_len = 0
@@ -149,11 +204,17 @@ class MultiAgentRolloutWorker:
 
     # -- helpers --------------------------------------------------------
     def _prep_for_policy(self, pid: str, obs) -> np.ndarray:
-        o = np.asarray(obs, np.float32)
-        return o if self._conv[pid] else o.reshape(-1)
+        """Single-obs inference path (``compute_single_action``): the
+        policy's agent pipeline on a dedicated stream, statistics
+        frozen."""
+        return self.agent_connectors[pid](
+            obs, env_id="__inference__", training=False)
 
-    def _prep(self, agent, obs) -> np.ndarray:
-        return self._prep_for_policy(self.mapping_fn(agent), obs)
+    def _prep(self, agent, obs, training: bool = True) -> np.ndarray:
+        """One obs through the agent's policy pipeline, episode state
+        keyed by agent id."""
+        return self.agent_connectors[self.mapping_fn(agent)](
+            obs, env_id=agent, training=training)
 
     def _trail(self, agent) -> _AgentTrail:
         t = self._trails.get(agent)
@@ -181,7 +242,8 @@ class MultiAgentRolloutWorker:
             prepped: Dict[Any, np.ndarray] = {}
             for agent, obs in self._obs.items():
                 by_pid.setdefault(self.mapping_fn(agent), []).append(agent)
-                prepped[agent] = self._prep(agent, obs)
+                pre = self._boundary_prepped.pop(agent, None)
+                prepped[agent] = self._prep(agent, obs) if pre is None else pre
             actions: Dict[Any, Any] = {}
             logps: Dict[Any, float] = {}
             vfs: Dict[Any, float] = {}
@@ -193,8 +255,9 @@ class MultiAgentRolloutWorker:
                     logps[a] = lps[j]
                     vfs[a] = vs[j]
             prev_obs = self._obs
-            obs, rewards, terms, truncs, _ = self.env.step(
-                {a: int(actions[a]) for a in actions})
+            obs, rewards, terms, truncs, _ = self.env.step({
+                a: self.action_connectors[self.mapping_fn(a)](actions[a])
+                for a in actions})
             all_term = bool(terms.get("__all__"))
             all_done = all_term or bool(truncs.get("__all__"))
             for agent in prev_obs:
@@ -218,6 +281,9 @@ class MultiAgentRolloutWorker:
                 if term or trunc:
                     bootstrap = 0.0 if term else self._bootstrap(agent, t.last_obs)
                     close_trail(agent, t, bootstrap)
+                    # this agent's episode ended: fresh connector episode
+                    # state (frame stacks) for its next life
+                    self.agent_connectors[self.mapping_fn(agent)].reset(agent)
             self._episode_len += 1
             if all_done:
                 for agent, t in self._trails.items():
@@ -230,21 +296,44 @@ class MultiAgentRolloutWorker:
                 self._episode_len = 0
                 self._eps_id += 1
                 self._obs, _ = self.env.reset()
+                self._boundary_prepped.clear()
+                for pipe in self.agent_connectors.values():
+                    pipe.reset()
             else:
                 self._obs = obs
-        # fragment boundary: bootstrap open trails with v(current obs)
+        # fragment boundary: bootstrap open trails with v(current obs).
+        # A live agent's boundary obs goes through its pipeline ONCE with
+        # real episode state and is cached for the next fragment's first
+        # tick — a training=False peek would still advance frame-stack
+        # state, so the next fragment's _prep of the same obs would
+        # duplicate the frame for the rest of the episode.
         for agent, t in self._trails.items():
             if t.cols[SampleBatch.OBS]:
-                close_trail(agent, t, self._bootstrap(agent, self._obs.get(
-                    agent, t.last_obs)))
+                if agent in self._obs:
+                    pre = self._prep(agent, self._obs[agent])
+                    self._boundary_prepped[agent] = pre
+                    pid = self.mapping_fn(agent)
+                    boot = float(self.policies[pid].value(pre[None])[0])
+                else:
+                    # agent absent from the boundary obs dict: there is no
+                    # new obs to transform, and re-pushing last_obs would
+                    # duplicate a frame already in its connector episode
+                    # state — bootstrap with the trail's own v(s_T) (the
+                    # mid-fragment truncation convention in compute_gae)
+                    boot = float(t.cols[SampleBatch.VF_PREDS][-1])
+                close_trail(agent, t, boot)
         return MultiAgentBatch({
             pid: SampleBatch.concat_samples(parts)
             for pid, parts in segments.items() if parts
         })
 
     def _bootstrap(self, agent, obs) -> float:
+        # training=False: the bootstrap peek must not double-count the
+        # obs in running statistics (the sample loop already saw it or
+        # will see it next fragment)
         pid = self.mapping_fn(agent)
-        return float(self.policies[pid].value(self._prep(agent, obs)[None])[0])
+        return float(self.policies[pid].value(
+            self._prep(agent, obs, training=False)[None])[0])
 
     # -- WorkerSet surface ---------------------------------------------
     def get_metrics(self) -> Dict[str, Any]:
@@ -257,6 +346,42 @@ class MultiAgentRolloutWorker:
             "episodes_total": self._episodes_total,
             "worker_steps": self._total_steps,
         }
+
+    def get_connector_state(self) -> Dict[str, Any]:
+        return {
+            "agent": {pid: p.to_state()
+                      for pid, p in self.agent_connectors.items()},
+            "action": {pid: p.to_state()
+                       for pid, p in self.action_connectors.items()},
+        }
+
+    def set_connector_state(self, state: Dict[str, Any]) -> bool:
+        # cached boundary transforms came from the replaced pipelines
+        self._boundary_prepped.clear()
+        for pid, s in state.get("agent", {}).items():
+            self.agent_connectors[pid].set_state(s)
+        for pid, s in state.get("action", {}).items():
+            self.action_connectors[pid].set_state(s)
+        return True
+
+    # -- distributed filter sync (stats only; episode state untouched) --
+    def pop_connector_stat_deltas(self):
+        return {pid: p.pop_stat_deltas()
+                for pid, p in self.agent_connectors.items()}
+
+    def apply_connector_stat_deltas(self, deltas) -> bool:
+        for pid, d in (deltas or {}).items():
+            self.agent_connectors[pid].apply_stat_deltas(d)
+        return True
+
+    def get_connector_stat_states(self):
+        return {pid: p.get_stat_states()
+                for pid, p in self.agent_connectors.items()}
+
+    def set_connector_stat_states(self, states) -> bool:
+        for pid, s in (states or {}).items():
+            self.agent_connectors[pid].set_stat_states(s)
+        return True
 
     def get_weights(self) -> Dict[str, Any]:
         return {pid: p.get_weights() for pid, p in self.policies.items()}
@@ -274,13 +399,19 @@ class MultiAgentRolloutWorker:
         rewards = []
         for ep in range(num_episodes):
             obs, _ = self.env.reset(seed=977 + ep)
+            # eval episodes must not inherit frame-stack residue from
+            # training (ep 0) or the previous eval episode (ep 1..);
+            # training episode state is rebuilt by the full reset below
+            for pipe in self.agent_connectors.values():
+                pipe.reset()
             total, steps = 0.0, 0
             while steps < max_steps_per_episode:
                 acts = {}
                 for agent, o in obs.items():
                     pid = self.mapping_fn(agent)
-                    acts[agent] = int(self.policies[pid].greedy_action(
-                        self._prep(agent, o)[None])[0])
+                    acts[agent] = self.action_connectors[pid](
+                        self.policies[pid].greedy_action(
+                            self._prep(agent, o, training=False)[None])[0])
                 obs, rs, terms, truncs, _ = self.env.step(acts)
                 total += float(sum(rs.values()))
                 steps += 1
@@ -290,6 +421,9 @@ class MultiAgentRolloutWorker:
         # the shared env was disturbed: fresh training episode state
         self._obs, _ = self.env.reset()
         self._trails.clear()
+        self._boundary_prepped.clear()
+        for pipe in self.agent_connectors.values():
+            pipe.reset()
         self._episode_reward = 0.0
         self._episode_len = 0
         return {"episode_reward_mean": float(np.mean(rewards)),
@@ -337,6 +471,9 @@ class MultiAgentPPO(Algorithm):
             batches.append(b)
             total += b.count
         batch = MultiAgentBatch.concat_samples(batches)
+        # remote workers' running-stat filters fold into the learner's
+        # per-policy pipelines; no-op without stats
+        self.workers.sync_filters()
         self._timesteps_total += batch.count
         learner: Dict[str, Dict[str, float]] = {}
         for pid, pb in batch.policy_batches.items():
@@ -355,17 +492,22 @@ class MultiAgentPPO(Algorithm):
         return {"info": {"learner": learner}}
 
     def save_checkpoint(self) -> Dict:
+        worker = self.workers.local_worker
         return {
             "policy_state": {
-                pid: p.get_state()
-                for pid, p in self.workers.local_worker.policies.items()
+                pid: p.get_state() for pid, p in worker.policies.items()
             },
+            "connector_state": worker.get_connector_state(),
             "timesteps_total": self._timesteps_total,
         }
 
     def load_checkpoint(self, state: Dict) -> None:
         for pid, s in state["policy_state"].items():
             self.workers.local_worker.policies[pid].set_state(s)
+        if state.get("connector_state") is not None:
+            self.workers.local_worker.set_connector_state(
+                state["connector_state"])
+            self.workers.sync_connectors()
         self._timesteps_total = state.get("timesteps_total", 0)
         self.workers.sync_weights()
 
@@ -379,12 +521,18 @@ class MultiAgentPPO(Algorithm):
         return policies[policy_id]
 
     def compute_single_action(self, obs, policy_id: Optional[str] = None,
-                              explore: bool = False) -> int:
+                              explore: bool = False,
+                              episode_start: bool = False) -> int:
         worker = self.workers.local_worker
         policies = worker.policies
         if policy_id is None and len(policies) == 1:
             policy_id = next(iter(policies))
         policy = self.get_policy(policy_id)
+        if episode_start:
+            # stateful connectors (frame stacks) track the caller's
+            # episode on the shared inference stream — same contract as
+            # the single-agent Algorithm.compute_single_action
+            worker.agent_connectors[policy_id].reset("__inference__")
         # the worker's prep, so inference matches sampling exactly
         o = worker._prep_for_policy(policy_id, obs)
         if explore:

@@ -5,10 +5,19 @@ compute_actions / learn_on_batch surface of ``TorchPolicyV2``,
 ``torch_policy_v2.py:62``) on the jax substrate: everything that touches
 the accelerator is a pure jitted function over a params pytree, so the same
 policy runs on CPU workers for rollouts and on TPU for learner SGD.
+
+The network itself lives behind the :class:`~ray_tpu.rllib.rl_module.
+RLModule` plugin surface: the policy owns sampling rng, the optimizer, and
+weight currency, and routes every forward — exploration sampling, value
+bootstraps, greedy inference, and the algorithm losses — through the
+module's ``forward_exploration`` / ``forward_train`` / ``forward_inference``.
+Custom JAX models plug in by passing ``module=`` (or the
+``config.rl_module(factory)`` seam) without subclassing this class.
 """
 
 from __future__ import annotations
 
+import inspect
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -16,7 +25,24 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
-from ray_tpu.rllib.models import apply_model, init_actor_critic, init_conv_actor_critic
+from ray_tpu.rllib.rl_module import Columns, DefaultActorCriticModule, RLModule
+
+
+def bind_loss(loss_fn: Callable, module: RLModule) -> Callable:
+    """Normalize a loss to ``(params, batch)``.
+
+    In-repo loss factories produce ``loss(module, params, batch)`` so the
+    forward goes through the RLModule; two-arg ``loss(params, batch)``
+    closures (pre-module custom losses) still work unchanged.
+    """
+    try:
+        n = len([p for p in inspect.signature(loss_fn).parameters.values()
+                 if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)])
+    except (TypeError, ValueError):
+        n = 2
+    if n >= 3:
+        return lambda params, batch: loss_fn(module, params, batch)
+    return loss_fn
 
 
 class JaxPolicy:
@@ -31,29 +57,29 @@ class JaxPolicy:
         loss_fn: Optional[Callable] = None,
         grad_clip: Optional[float] = 0.5,
         obs_shape: Optional[tuple] = None,
+        module: Optional[RLModule] = None,
     ):
         self.obs_dim = obs_dim
         self.num_actions = num_actions
         self._rng = jax.random.PRNGKey(seed)
-        if obs_shape is not None and len(obs_shape) == 3:
-            # image observations -> CNN (the ModelCatalog conv path); the
-            # caller's hiddens become the post-conv dense trunk
-            self.params = init_conv_actor_critic(
-                jax.random.PRNGKey(seed + 1), tuple(obs_shape), num_actions,
-                hiddens=tuple(hiddens),
-            )
-        else:
-            self.params = init_actor_critic(
-                jax.random.PRNGKey(seed + 1), obs_dim, num_actions, hiddens
-            )
+        if module is None:
+            # catalog default: MLP, or CNN when an image obs_shape is given
+            module = DefaultActorCriticModule(
+                obs_dim, num_actions, hiddens=tuple(hiddens),
+                obs_shape=obs_shape if obs_shape and len(obs_shape) == 3
+                else None)
+        self.module = module
+        self.params = module.init(jax.random.PRNGKey(seed + 1))
         tx = [optax.clip_by_global_norm(grad_clip)] if grad_clip else []
         self.optimizer = optax.chain(*tx, optax.adam(lr))
         self.opt_state = self.optimizer.init(self.params)
-        self._loss_fn = loss_fn  # (params, batch_dict) -> (loss, metrics)
+        self._loss_fn = bind_loss(loss_fn, module) if loss_fn else None
 
         @jax.jit
         def _sample(params, rng, obs):
-            logits, value = apply_model(params, obs)
+            out = module.forward_exploration(params, obs)
+            logits = out[Columns.ACTION_DIST_INPUTS]
+            value = out[Columns.VF_PREDS]
             action = jax.random.categorical(rng, logits, axis=-1)
             logp = jax.nn.log_softmax(logits)
             action_logp = jnp.take_along_axis(logp, action[:, None], axis=-1)[:, 0]
@@ -61,18 +87,17 @@ class JaxPolicy:
 
         @jax.jit
         def _value(params, obs):
-            _, value = apply_model(params, obs)
-            return value
+            return module.forward_train(params, obs)[Columns.VF_PREDS]
 
         @jax.jit
         def _greedy(params, obs):
-            logits, _ = apply_model(params, obs)
-            return jnp.argmax(logits, axis=-1)
+            out = module.forward_inference(params, obs)
+            return jnp.argmax(out[Columns.ACTION_DIST_INPUTS], axis=-1)
 
         @jax.jit
         def _action_logp(params, obs, actions):
-            logits, _ = apply_model(params, obs)
-            logp = jax.nn.log_softmax(logits)
+            out = module.forward_train(params, obs)
+            logp = jax.nn.log_softmax(out[Columns.ACTION_DIST_INPUTS])
             return jnp.take_along_axis(
                 logp, actions.astype(jnp.int32)[:, None], axis=-1
             )[:, 0]
@@ -82,12 +107,13 @@ class JaxPolicy:
         self._greedy_jit = _greedy
         self._action_logp_jit = _action_logp
         self._update_jit = None
-        if loss_fn is not None:
+        if self._loss_fn is not None:
+            bound_loss = self._loss_fn
 
             @jax.jit
             def _update(params, opt_state, batch):
                 (loss, metrics), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
+                    bound_loss, has_aux=True
                 )(params, batch)
                 updates, opt_state = self.optimizer.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)

@@ -22,9 +22,12 @@ the algorithms, and checkpointing are unchanged (``_policy_class`` seam).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
+
+from ray_tpu._private import events
 
 
 class PolicyServer:
@@ -48,6 +51,16 @@ class PolicyServer:
                 "hiddens", tuple(algo_config.get("fcnet_hiddens", (64, 64))))
             kwargs.setdefault("grad_clip", algo_config.get("grad_clip", 0.5))
             kwargs.setdefault("seed", int(algo_config.get("seed") or 0))
+            module_factory = algo_config.get("_rl_module_factory")
+            if module_factory is not None and "module" not in kwargs:
+                # same RLModule plugin seam as RolloutWorker: the server-
+                # resident policy routes its forwards through the module
+                from ray_tpu.rllib.connectors import ConnectorContext
+
+                obs_shape = tuple(kwargs.get("obs_shape") or (obs_dim,))
+                kwargs["module"] = module_factory(ConnectorContext(
+                    obs_shape=obs_shape, obs_dim=obs_dim,
+                    num_actions=num_actions, config=dict(algo_config)))
         self.policy = JaxPolicy(obs_dim, num_actions, **kwargs)
         # serializes rng splits and param updates; device dispatch happens
         # inside, readbacks outside, so concurrent callers overlap the
@@ -81,6 +94,7 @@ class PolicyServer:
         import jax
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
         p = self.policy
         with self._lock:
             p._rng, key = jax.random.split(p._rng)
@@ -88,7 +102,12 @@ class PolicyServer:
             for x in (a, lp, v):
                 if hasattr(x, "copy_to_host_async"):
                     x.copy_to_host_async()
-        return np.asarray(a), np.asarray(lp), np.asarray(v)
+        out = np.asarray(a), np.asarray(lp), np.asarray(v)
+        # server-side compute span: a rollout worker's infer_s minus the
+        # sum of these is the transport share of its inference wait
+        events.emit("rllib", "policy inference", entity_id="policy-server",
+                    span_dur=time.perf_counter() - t0, batch=len(out[0]))
+        return out
 
     # -- frame-stack transport -----------------------------------------
     def start_rollout(self, worker_id: int, n_envs: int) -> bool:
@@ -130,6 +149,7 @@ class PolicyServer:
         import jax
         import jax.numpy as jnp
 
+        t_start = time.perf_counter()
         p = self.policy
         with self._lock:
             ro = self._rollouts.get(worker_id)
@@ -159,6 +179,9 @@ class PolicyServer:
             for x in (a, lp, v):
                 if hasattr(x, "copy_to_host_async"):
                     x.copy_to_host_async()
+        events.emit("rllib", "policy inference", entity_id="policy-server",
+                    span_dur=time.perf_counter() - t_start,
+                    batch=len(new_frames), stacked=True)
         return np.asarray(a), np.asarray(lp), np.asarray(v), tick
 
     def peek_obs(self, worker_id: int) -> Optional[np.ndarray]:
@@ -172,9 +195,16 @@ class PolicyServer:
     def value(self, obs: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp
 
+        t0 = time.perf_counter()
         with self._lock:
             v = self.policy._value_jit(self.policy.params, jnp.asarray(obs))
-        return np.asarray(v)
+        out = np.asarray(v)
+        # bootstrap value calls count into the workers' infer_s; without
+        # this span their server-side compute would read as "transport"
+        # in the scaling-knee attribution
+        events.emit("rllib", "policy inference", entity_id="policy-server",
+                    span_dur=time.perf_counter() - t0, batch=len(out))
+        return out
 
     def greedy_action(self, obs: np.ndarray) -> np.ndarray:
         import jax.numpy as jnp

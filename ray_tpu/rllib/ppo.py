@@ -16,16 +16,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig, train_one_step
-from ray_tpu.rllib.models import apply_model
+from ray_tpu.rllib.rl_module import Columns
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
 def make_ppo_loss(clip_param: float, vf_clip_param: float,
                   vf_loss_coeff: float, entropy_coeff: float):
-    """Loss factory; the returned closure is jitted inside JaxPolicy."""
+    """Loss factory; the returned closure is jitted inside JaxPolicy,
+    with the forward routed through the policy's RLModule."""
 
-    def loss(params, batch):
-        logits, values = apply_model(params, batch[SampleBatch.OBS])
+    def loss(module, params, batch):
+        out = module.forward_train(params, batch[SampleBatch.OBS])
+        logits = out[Columns.ACTION_DIST_INPUTS]
+        values = out[Columns.VF_PREDS]
         logp_all = jax.nn.log_softmax(logits)
         actions = batch[SampleBatch.ACTIONS].astype(jnp.int32)
         logp = jnp.take_along_axis(logp_all, actions[:, None], axis=-1)[:, 0]

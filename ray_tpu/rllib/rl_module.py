@@ -1,0 +1,98 @@
+"""RLModule: the model plugin surface (``rl_module.py:23`` analog).
+
+The reference's RLModule separates "what the network computes" from "how
+the policy samples/learns": a module exposes ``forward_inference`` (the
+greedy serving path), ``forward_exploration`` (the sampling path) and
+``forward_train`` (the loss path), and algorithms are written against
+those three.  Here the same split lands on the jax substrate: a module is
+a STATELESS description — ``init(rng) -> params`` plus pure forward
+functions over the params pytree — so every forward jits, params remain a
+plain optimizer-visible pytree, and one module serves CPU rollout workers
+and the chip-resident PolicyServer alike.
+
+Custom JAX models plug in WITHOUT subclassing Policy::
+
+    class MyModule(RLModule):
+        def init(self, rng): ...
+        def forward_train(self, params, obs):
+            return {Columns.ACTION_DIST_INPUTS: logits,
+                    Columns.VF_PREDS: value}
+
+    config.rl_module(lambda ctx: MyModule(ctx.obs_dim, ctx.num_actions))
+
+The factory rides the config dict to every rollout worker and the
+PolicyServer; ``JaxPolicy`` routes acting, value bootstraps, greedy
+inference, and every algorithm loss through the module's forwards.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+from ray_tpu.rllib.models import (
+    apply_model,
+    init_actor_critic,
+    init_conv_actor_critic,
+)
+
+
+class Columns:
+    """Forward-output keys (the reference's ``core.columns.Columns``)."""
+
+    ACTION_DIST_INPUTS = "action_dist_inputs"
+    VF_PREDS = "vf_preds"
+
+
+class RLModule:
+    """Base plugin: pure functions over a params pytree.
+
+    ``forward_train`` is the only required forward — exploration and
+    inference default to it, which is correct for any shared-trunk
+    actor-critic.  Override them when the paths genuinely differ
+    (e.g. dropout off at inference, exploration noise heads).
+
+    Every forward MUST be jax-traceable (no python side effects on data):
+    they run under ``jax.jit`` inside sampling, loss, and server-side SGD.
+    """
+
+    def init(self, rng) -> Any:
+        """Build the params pytree."""
+        raise NotImplementedError
+
+    def forward_train(self, params, obs) -> Dict[str, Any]:
+        """Loss-path forward: must return ``Columns.ACTION_DIST_INPUTS``
+        (logits / dist params) and ``Columns.VF_PREDS``."""
+        raise NotImplementedError
+
+    def forward_exploration(self, params, obs) -> Dict[str, Any]:
+        """Sampling-path forward (stochastic acting)."""
+        return self.forward_train(params, obs)
+
+    def forward_inference(self, params, obs) -> Dict[str, Any]:
+        """Greedy serving-path forward (evaluation, PolicyServer)."""
+        return self.forward_exploration(params, obs)
+
+
+class DefaultActorCriticModule(RLModule):
+    """The catalog's MLP/CNN actor-critic as a module: what every policy
+    uses when no custom module is configured.  Picklable by construction
+    (plain python scalars), so it rides config dicts to remote workers."""
+
+    def __init__(self, obs_dim: int, num_actions: int,
+                 hiddens: Tuple[int, ...] = (64, 64),
+                 obs_shape: Optional[Tuple[int, ...]] = None):
+        self.obs_dim = int(obs_dim)
+        self.num_actions = int(num_actions)
+        self.hiddens = tuple(int(h) for h in hiddens)
+        self.obs_shape = tuple(obs_shape) if obs_shape else None
+
+    def init(self, rng) -> Any:
+        if self.obs_shape is not None and len(self.obs_shape) == 3:
+            return init_conv_actor_critic(
+                rng, self.obs_shape, self.num_actions, hiddens=self.hiddens)
+        return init_actor_critic(
+            rng, self.obs_dim, self.num_actions, self.hiddens)
+
+    def forward_train(self, params, obs) -> Dict[str, Any]:
+        logits, value = apply_model(params, obs)
+        return {Columns.ACTION_DIST_INPUTS: logits, Columns.VF_PREDS: value}

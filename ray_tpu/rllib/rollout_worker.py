@@ -7,17 +7,42 @@ collects fixed-size sample fragments, postprocesses each episode segment
 at its boundary (GAE for on-policy learners; raw transitions for
 replay-based ones), and exposes get/set_weights for learner sync.  Runs
 inline (local worker) or as an actor (``num_rollout_workers > 0``).
+
+Env<->policy preprocessing is NOT hardwired here: the observation path is
+an :class:`AgentConnectorPipeline` and the action path an
+:class:`ActionConnectorPipeline` (``rllib/connectors/``).  With no config
+spec the worker installs defaults equivalent to the old behavior
+(flatten+float32 for MLPs, uint8 [H, W, C] copies for CNNs, unsquash/clip
+on continuous actions); configs compose richer pipelines (running-stat
+normalization, frame stacking) through ``AlgorithmConfig.connectors``.
+Each raw observation is transformed EXACTLY ONCE (cached per env as
+``prepped``), so stateful connectors see the true episode stream.
 """
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ray_tpu._private import events
+from ray_tpu.rllib.connectors import (
+    ActionConnectorPipeline,
+    AgentConnectorPipeline,
+    ConnectorContext,
+    NormalizeObs,
+    build_pipeline,
+    default_action_connectors,
+    default_agent_connectors,
+)
 from ray_tpu.rllib.postprocessing import compute_gae
 from ray_tpu.rllib.sample_batch import SampleBatch
+
+# the env_id stream used by evaluation / single-obs inference, so
+# stateful connectors never mix it with training envs (0..num_envs-1)
+EVAL_ENV_ID = -1
 
 
 def _default_env_creator(env_name: str):
@@ -29,11 +54,13 @@ def _default_env_creator(env_name: str):
 class _EnvState:
     """Per-env rollout bookkeeping (column buffers + episode stats)."""
 
-    __slots__ = ("env", "obs", "cols", "episode_reward", "episode_len", "eps_id")
+    __slots__ = ("env", "obs", "prepped", "cols", "episode_reward",
+                 "episode_len", "eps_id")
 
     def __init__(self, env, obs, keys, eps_id):
         self.env = env
-        self.obs = obs
+        self.obs = obs  # raw (frame-stack transport reads raw frames)
+        self.prepped = None  # connector-transformed, one transform per obs
         self.cols: Dict[str, List] = {k: [] for k in keys}
         self.episode_reward = 0.0
         self.episode_len = 0
@@ -52,17 +79,27 @@ class RolloutWorker:
         )
         self.num_envs = max(1, int(config.get("num_envs_per_worker", 1)))
         probe_env = self._make_env()
-        self._obs_shape = tuple(probe_env.observation_space.shape)
-        obs_dim = int(np.prod(probe_env.observation_space.shape))
-        space = probe_env.action_space
-        self._discrete = hasattr(space, "n")
-        if self._discrete:
-            num_actions = int(space.n)
-            self._action_low = self._action_high = None
-        else:
-            num_actions = int(np.prod(space.shape))
-            self._action_low = np.asarray(space.low, np.float32)
-            self._action_high = np.asarray(space.high, np.float32)
+        self.ctx = ConnectorContext.from_env(probe_env, config)
+        self._obs_shape = self.ctx.obs_shape
+        # An EXPLICIT agent pipeline may change the policy's input shape
+        # (frame stacking widens it); probe with a zeros observation so
+        # the policy — and the ctx custom RLModules size off — see the
+        # TRANSFORMED shape.  Default pipelines preserve dims, so the
+        # ctx keeps the env's shape when no spec is given.
+        agent_spec = config.get("agent_connectors")
+        explicit_agent_pipe = None
+        if agent_spec is not None:
+            explicit_agent_pipe = build_pipeline(
+                AgentConnectorPipeline, self.ctx, agent_spec)
+            probe = explicit_agent_pipe(
+                np.zeros(self._obs_shape, np.float32),
+                env_id="__probe__", training=False)
+            explicit_agent_pipe.reset("__probe__")
+            self.ctx.obs_shape = tuple(probe.shape)
+            self.ctx.obs_dim = int(np.prod(probe.shape))
+        policy_obs_shape = self.ctx.obs_shape
+        obs_dim = self.ctx.obs_dim
+        num_actions = self.ctx.num_actions
         seed = int(config.get("seed") or 0) + worker_index
 
         from ray_tpu.rllib.policy import JaxPolicy
@@ -75,10 +112,15 @@ class RolloutWorker:
         pk_factory = config.get("_policy_kwargs_factory")
         extra = (dict(pk_factory(config)) if pk_factory
                  else dict(config.get("_policy_kwargs") or {}))
-        if len(self._obs_shape) == 3 and policy_cls is JaxPolicy:
+        module_factory = config.get("_rl_module_factory")
+        if module_factory is not None:
+            # RLModule plugin seam: custom JAX models drop in without
+            # subclassing Policy — the factory sizes itself off the ctx
+            extra.setdefault("module", module_factory(self.ctx))
+        if len(policy_obs_shape) == 3 and policy_cls is JaxPolicy:
             # image observations -> the catalog's CNN (catalog.py:195
             # dispatch); subclass policies keep their own model choices
-            extra.setdefault("obs_shape", self._obs_shape)
+            extra.setdefault("obs_shape", policy_obs_shape)
         self.policy = policy_cls(
             obs_dim,
             num_actions,
@@ -91,9 +133,27 @@ class RolloutWorker:
         )
         # obs stay [H, W, C] only when the BUILT policy actually carries a
         # conv net — a flat-MLP policy (DQN/SAC on image envs) gets
-        # flattened observations instead of a shape crash
+        # flattened observations instead of a shape crash.  A CUSTOM
+        # module on an image env keeps [H, W, C] too (its params carry no
+        # "conv" key to sniff; a custom module wanting flat input on an
+        # image env passes explicit agent_connectors).
         p = getattr(self.policy, "params", None)
-        self._conv = isinstance(p, dict) and "conv" in p
+        self._conv = (isinstance(p, dict) and "conv" in p) or (
+            module_factory is not None and len(policy_obs_shape) == 3)
+        # -- connector pipelines: THE sample path -----------------------
+        if explicit_agent_pipe is not None:
+            self.agent_connectors = explicit_agent_pipe
+        else:
+            self.agent_connectors = AgentConnectorPipeline(
+                self.ctx, default_agent_connectors(self.ctx, self._conv))
+            if config.get("observation_filter") == "MeanStdFilter":
+                self.agent_connectors.append(NormalizeObs())
+        self.action_connectors = build_pipeline(
+            ActionConnectorPipeline, self.ctx,
+            config.get("action_connectors"))
+        if config.get("action_connectors") is None:
+            for c in default_action_connectors(self.ctx):
+                self.action_connectors.append(c)
         self._store_next_obs = bool(config.get("_store_next_obs"))
         # on-policy learners want GAE + behavior logp/vf columns; replay
         # learners want raw transitions; IMPALA wants transitions AND the
@@ -141,7 +201,9 @@ class RolloutWorker:
         for i in range(self.num_envs):
             env = probe_env if i == 0 else self._make_env()
             obs, _ = env.reset(seed=seed * 10_000 + i)
-            self._envs.append(_EnvState(env, obs, keys, self._next_eps_id()))
+            es = _EnvState(env, obs, keys, self._next_eps_id())
+            es.prepped = self.agent_connectors(obs, env_id=i)
+            self._envs.append(es)
         self._episode_rewards: deque = deque(maxlen=100)
         self._episode_lengths: deque = deque(maxlen=100)
         self._episodes_total = 0
@@ -157,26 +219,53 @@ class RolloutWorker:
         self._eps_counter += 1
         return self._eps_counter
 
-    def _prep_obs(self, o) -> np.ndarray:
-        """Image obs keep [H, W, C] for the CNN — and keep uint8 pixels
-        uint8 (the policy casts device-side; 4x less transport); flat obs
-        flatten to float32.  Always copies: envs that return their internal
-        frame buffer would otherwise alias every stored row."""
-        if self._conv:
-            return np.array(o)
-        return np.asarray(o, np.float32).reshape(-1)
+    def _prep_obs(self, o, env_id: Any = EVAL_ENV_ID,
+                  training: bool = False) -> np.ndarray:
+        """One obs through the agent pipeline on the EVALUATION stream:
+        statistics frozen, episode state keyed off the training envs.
+        The sample loop does NOT come through here — it transforms each
+        env's stream inline (one transform per raw obs, cached)."""
+        return self.agent_connectors(o, env_id=env_id, training=training)
 
     def _env_action(self, action: np.ndarray):
-        """Policy output -> what env.step accepts.  Continuous policies act
-        in the canonical [-1, 1] box (tanh squash); rescale to the env's
-        bounds so full-range actions are reachable (clip only when a bound
-        is infinite and rescaling is undefined)."""
-        if self._discrete:
-            return int(action)
-        lo, hi = self._action_low, self._action_high
-        if np.all(np.isfinite(lo)) and np.all(np.isfinite(hi)):
-            return lo + (np.clip(action, -1.0, 1.0) + 1.0) * (hi - lo) / 2.0
-        return np.clip(action, lo, hi)
+        """Policy output -> what env.step accepts (the action-connector
+        pipeline: int cast for discrete, unsquash from the canonical
+        [-1, 1] box or clip for continuous)."""
+        return self.action_connectors(action)
+
+    # -- connector state (rides checkpoints + worker sync) -------------
+    def get_connector_state(self) -> Dict[str, Any]:
+        return {"agent": self.agent_connectors.to_state(),
+                "action": self.action_connectors.to_state()}
+
+    def set_connector_state(self, state: Dict[str, Any]) -> bool:
+        self.agent_connectors.set_state(state["agent"])
+        self.action_connectors.set_state(state["action"])
+        # the rebuilt pipelines invalidate every cached transform: re-prep
+        # each env's current obs on fresh episode state (a restored frame
+        # stack restarts mid-episode with first-frame-repeat semantics,
+        # exactly like a freshly reset env; stats stay frozen — the obs
+        # was already counted once when it entered the stream)
+        for i, es in enumerate(getattr(self, "_envs", ())):
+            self.agent_connectors.reset(i)
+            es.prepped = (None if self._fst else self.agent_connectors(
+                es.obs, env_id=i, training=False))
+        return True
+
+    # -- distributed filter sync (stats only; episode state untouched) --
+    def pop_connector_stat_deltas(self):
+        return self.agent_connectors.pop_stat_deltas()
+
+    def apply_connector_stat_deltas(self, deltas) -> bool:
+        self.agent_connectors.apply_stat_deltas(deltas)
+        return True
+
+    def get_connector_stat_states(self):
+        return self.agent_connectors.get_stat_states()
+
+    def set_connector_stat_states(self, states) -> bool:
+        self.agent_connectors.set_stat_states(states)
+        return True
 
     # ------------------------------------------------------------------
     def sample(self) -> SampleBatch:
@@ -187,8 +276,11 @@ class RolloutWorker:
         batched ``policy.value`` call at the end of the fragment: with a
         remote policy (policy_server.py) per-segment calls would each pay
         a device round trip."""
+        t_wall = time.perf_counter()
+        phase = {"env_s": 0.0, "infer_s": 0.0, "connector_s": 0.0,
+                 "postprocess_s": 0.0}
         segments: List[SampleBatch] = []
-        # segments awaiting a bootstrap value: (cols_snapshot, boot_obs)
+        # segments awaiting a bootstrap value: (cols_snapshot, boot_prepped)
         deferred: List = []
 
         def snapshot(es: _EnvState):
@@ -202,15 +294,24 @@ class RolloutWorker:
                 return
             seg = SampleBatch(snapshot(es))
             if self._postprocess_gae:
+                t0 = time.perf_counter()
                 seg = compute_gae(seg, 0.0, self.gamma, self.lambda_)
+                phase["postprocess_s"] += time.perf_counter() - t0
             segments.append(seg)
 
-        def defer_bootstrap(es: _EnvState, boot_obs):
+        def defer_bootstrap(es: _EnvState, boot_prepped):
             if len(es.cols[SampleBatch.OBS]) == 0:
                 return
-            deferred.append((snapshot(es), self._prep_obs(boot_obs)))
+            deferred.append((snapshot(es), boot_prepped))
+
+        def transform(o, i):
+            t0 = time.perf_counter()
+            out = self.agent_connectors(o, env_id=i)
+            phase["connector_s"] += time.perf_counter() - t0
+            return out
 
         for _ in range(self.fragment_length):
+            t0 = time.perf_counter()
             if self._fst:
                 # newest channel only (uint8 [n, H, W]); the server holds
                 # and advances the full stacks device-side
@@ -225,22 +326,34 @@ class RolloutWorker:
                     np.array([self.worker_index, tick, i], np.int32)
                     for i in range(self.num_envs)])
             else:
-                obs_batch = np.stack(
-                    [self._prep_obs(es.obs) for es in self._envs])
+                obs_batch = np.stack([es.prepped for es in self._envs])
                 actions, logps, vfs = self.policy.compute_actions(obs_batch)
+            phase["infer_s"] += time.perf_counter() - t0
             for i, es in enumerate(self._envs):
                 a = actions[i]
+                t0 = time.perf_counter()
                 next_obs, reward, terminated, truncated, _ = es.env.step(
                     self._env_action(a)
                 )
+                phase["env_s"] += time.perf_counter() - t0
                 es.cols[SampleBatch.OBS].append(obs_batch[i])
                 es.cols[SampleBatch.ACTIONS].append(a)
                 es.cols[SampleBatch.REWARDS].append(np.float32(reward))
                 es.cols[SampleBatch.TERMINATEDS].append(terminated)
                 es.cols[SampleBatch.TRUNCATEDS].append(truncated)
                 es.cols[SampleBatch.EPS_ID].append(es.eps_id)
+                # next_obs continues env i's episode stream; transform it
+                # ONCE here and reuse (NEXT_OBS column, truncation
+                # bootstrap, next tick's policy input).  On a TERMINAL
+                # step the post-terminal obs is discarded by the reset —
+                # skip the transform so a never-used obs can't bias
+                # running statistics — UNLESS the learner consumes it
+                # (replay algorithms read NEXT_OBS even at terminals)
+                next_prepped = None
+                if not self._fst and (not terminated or self._store_next_obs):
+                    next_prepped = transform(next_obs, i)
                 if self._store_next_obs:
-                    es.cols[SampleBatch.NEXT_OBS].append(self._prep_obs(next_obs))
+                    es.cols[SampleBatch.NEXT_OBS].append(next_prepped)
                 if self._keep_behavior_logp:
                     es.cols[SampleBatch.ACTION_LOGP].append(np.float32(logps[i]))
                     es.cols[SampleBatch.VF_PREDS].append(np.float32(vfs[i]))
@@ -248,12 +361,15 @@ class RolloutWorker:
                 es.episode_len += 1
                 self._total_steps += 1
                 es.obs = next_obs
+                es.prepped = next_prepped
                 if terminated or truncated:
                     # terminal: no bootstrap; truncation: bootstrap v(s_T)
                     if terminated:
                         close_terminal(es)
                     else:
-                        defer_bootstrap(es, next_obs)
+                        defer_bootstrap(
+                            es, next_prepped if next_prepped is not None
+                            else transform(next_obs, i))
                     self._episode_rewards.append(es.episode_reward)
                     self._episode_lengths.append(es.episode_len)
                     self._episodes_total += 1
@@ -261,24 +377,44 @@ class RolloutWorker:
                     es.episode_len = 0
                     es.eps_id = self._next_eps_id()
                     es.obs, _ = es.env.reset()
+                    # episode boundary: frame stacks et al. start fresh
+                    self.agent_connectors.reset(i)
+                    es.prepped = (None if self._fst
+                                  else transform(es.obs, i))
                     if self._fst:
                         self._reset_mask[i] = True
-        # fragment ended mid-episode: bootstrap with v(current obs)
-        for es in self._envs:
-            defer_bootstrap(es, es.obs)
+        # fragment ended mid-episode: bootstrap with v(current obs) —
+        # already transformed (prepped) except on the frame-stack
+        # transport path, where obs stay raw until here
+        for i, es in enumerate(self._envs):
+            defer_bootstrap(es, es.prepped if es.prepped is not None
+                            else transform(es.obs, i))
         if deferred:
             if self._postprocess_gae:
+                t0 = time.perf_counter()
                 boots = self.policy.value(
                     np.stack([b for _, b in deferred]))
+                phase["infer_s"] += time.perf_counter() - t0
+                t0 = time.perf_counter()
                 for (seg_cols, _), v in zip(deferred, boots):
                     segments.append(compute_gae(
                         SampleBatch(seg_cols), float(v),
                         self.gamma, self.lambda_))
+                phase["postprocess_s"] += time.perf_counter() - t0
             else:
                 segments.extend(SampleBatch(c) for c, _ in deferred)
         batch = SampleBatch.concat_samples(segments)
         if self._writer is not None:
             self._writer.write(batch)
+        # flight-recorder span: what `ray_tpu trace`/timeline and the
+        # rl_env_steps_scaling knee attribution read (env vs inference vs
+        # connector vs postprocess shares of the fragment wall)
+        events.emit(
+            "rllib", "rollout sample",
+            entity_id=f"rollout-{self.worker_index}",
+            span_dur=time.perf_counter() - t_wall,
+            env_steps=batch.count,
+            **{k: round(v, 6) for k, v in phase.items()})
         return batch
 
     # ------------------------------------------------------------------
@@ -286,13 +422,16 @@ class RolloutWorker:
                           max_steps_per_episode: int = 10_000) -> Dict[str, Any]:
         """Greedy evaluation on a dedicated cached env (``evaluation_config``'s
         explore=False path).  The step cap guards envs with no TimeLimit —
-        training is fragment-bounded but this loop would otherwise hang."""
+        training is fragment-bounded but this loop would otherwise hang.
+        Observations ride the agent pipeline on the EVAL stream (frozen
+        statistics, own episode state reset per episode)."""
         env = getattr(self, "_eval_env", None)
         if env is None:
             env = self._eval_env = self._make_env()
         rewards, lengths = [], []
         for ep in range(num_episodes):
             obs, _ = env.reset(seed=977 + ep)
+            self.agent_connectors.reset(EVAL_ENV_ID)
             total, steps = 0.0, 0
             while steps < max_steps_per_episode:
                 a = self.policy.greedy_action(self._prep_obs(obs)[None])[0]
@@ -303,6 +442,9 @@ class RolloutWorker:
                     break
             rewards.append(total)
             lengths.append(steps)
+        # don't leak the last eval episode's residue (frame stacks) into
+        # a later external compute_single_action stream
+        self.agent_connectors.reset(EVAL_ENV_ID)
         return {
             "episode_reward_mean": float(np.mean(rewards)),
             "episode_len_mean": float(np.mean(lengths)),

@@ -15,6 +15,17 @@ from ray_tpu.rllib.rollout_worker import RolloutWorker
 from ray_tpu.rllib.sample_batch import SampleBatch
 
 
+def _has_stats(blob) -> bool:
+    """True when a stat-states/deltas blob carries anything: per-policy
+    dicts (multi-agent) hold positional lists whose stateless-connector
+    entries are None."""
+    if isinstance(blob, dict):
+        return any(_has_stats(v) for v in blob.values())
+    if isinstance(blob, (list, tuple)):
+        return any(x is not None for x in blob)
+    return blob is not None
+
+
 class WorkerSet:
     def __init__(self, config: Dict[str, Any]):
         self.config = config
@@ -42,6 +53,47 @@ class WorkerSet:
         ref = ray_tpu.put(self.local_worker.get_weights())
         ray_tpu.get(
             [w.set_weights.remote(ref) for w in self.remote_workers], timeout=120
+        )
+
+    def sync_connectors(self) -> None:
+        """Broadcast the local worker's connector-pipeline state (e.g. a
+        restored running-stat filter) to all remotes; a checkpoint restore
+        must not leave remote workers normalizing with fresh statistics."""
+        getter = getattr(self.local_worker, "get_connector_state", None)
+        if getter is None or not self.remote_workers:
+            return
+        state = getter()
+        ray_tpu.get(
+            [w.set_connector_state.remote(state) for w in self.remote_workers],
+            timeout=120,
+        )
+
+    def sync_filters(self) -> None:
+        """Fold remote workers' running-stat deltas (Welford buffers) into
+        the local worker's pipelines and broadcast the merged statistics
+        back (``FilterManager.synchronize`` analog).  Stats only — per-env
+        episode state (frame stacks) is never touched.  Without this the
+        local worker of a ``MeanStdFilter`` run with remote workers keeps
+        n=0 statistics, so evaluation, ``compute_single_action``, and
+        checkpoints would ride fresh filters while training normalized.
+        Skipped entirely when the pipelines carry no statistics."""
+        if not self.remote_workers:
+            return
+        getter = getattr(self.local_worker, "get_connector_stat_states", None)
+        if getter is None or not _has_stats(getter()):
+            return
+        deltas = ray_tpu.get(
+            [w.pop_connector_stat_deltas.remote() for w in self.remote_workers],
+            timeout=120,
+        )
+        for d in deltas:
+            if _has_stats(d):
+                self.local_worker.apply_connector_stat_deltas(d)
+        merged = self.local_worker.get_connector_stat_states()
+        ray_tpu.get(
+            [w.set_connector_stat_states.remote(merged)
+             for w in self.remote_workers],
+            timeout=120,
         )
 
     def sync_global_vars(self, timesteps_total: int) -> None:

@@ -779,7 +779,7 @@ def main(argv=None) -> None:
                    help="filter: scheduler|object_store|streaming|serve|"
                         "train|actor|worker_pool|node|collective|"
                         "serve_llm|compiled_dag|trace|syncer|chaos|"
-                        "autoscaler|perf")
+                        "autoscaler|perf|client_proxy|rllib")
     s.add_argument("--severity", default=None,
                    help="filter: DEBUG|INFO|WARNING|ERROR")
     s.add_argument("--limit", type=int, default=200)
