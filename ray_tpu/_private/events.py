@@ -64,6 +64,12 @@ KNOWN_SOURCES = (
     # env/inference/connector/postprocess attribution — what the
     # rl_env_steps_scaling knee attribution and the timeline read
     "rllib",
+    # continuous-profiling lifecycle (_private/sampling_profiler.py +
+    # node.py ProfileStore retirement): profiler started/stopped, interval
+    # backoff/reset, profile ship failures, dead-origin retirement — the
+    # audit trail for why a window has thin (backed-off) or missing
+    # (retired origin) flamegraph coverage
+    "profile",
 )
 
 # Kill switch for the whole observability layer (events + hot-path metric

@@ -1,29 +1,383 @@
-"""Named locks with an opt-in acquisition-order witness.
+"""Named locks with wait/hold attribution and an opt-in order witness.
 
-``make_lock("node.registry")`` is a plain ``threading.Lock`` (or RLock)
-in production.  Under ``RAY_TPU_LOCKWITNESS=1`` it returns a
-:class:`~ray_tpu.devtools.raylint.lockwitness.WitnessLock` proxy that
-feeds the global lock-order graph, so a tier-1 test can drive a live
-cluster and assert the whole run was deadlock-order-clean.  The env
-check happens once at lock creation — the hot path never pays for the
-feature it isn't using.
+``make_lock("node.registry")`` returns a :class:`_TimedLock` — a thin
+proxy whose common path is a bare delegation to the C lock (one slot
+load + one branch).  Timing runs on a DUTY CYCLE: a module metronome
+arms every proxy for ``_ARM_BURST_S`` out of every ``_ARM_INTERVAL_S``
+(~2.4% duty), and only armed acquires pay the non-blocking probe +
+``perf_counter`` pair that measures a contended wait and the hold that
+caused it.  :func:`lock_stats` scales the armed-window raw aggregates
+by the measured wall/armed ratio, so the rows are unbiased estimates of
+the process-wide totals — the metronome's phase is uncorrelated with
+lock traffic, which is what makes sampled-window totals extrapolate.
+
+The head's dispatch path acquires these locks ~14x per task, so the
+DISARMED path cost is what the 1%-of-throughput budget for the whole
+profiling plane is spent on; that is why ``__enter__``/``__exit__`` are
+hand-leaned (zero-arg C acquire, no ``*exc`` tuple, no nested Python
+call) rather than aliases of ``acquire``/``release``.
+
+Aggregates live in a module registry (:func:`lock_stats`) and are
+published as per-lock gauges by the continuous profiler's ship tick, so
+a hot lock's wait/hold ratio is a TSDB trend the doctor's
+``lock_contention`` rule can read — measured wait time, not a guess,
+behind "transport" and "core-bound" labels.
+
+Modes (env read per ``make_lock`` call — lock CREATION is rare, never on
+a hot path):
+
+- default: duty-cycle contended-wait timing as above
+  (``RAY_TPU_LOCKTIME=0`` turns the proxy off entirely and returns raw
+  ``threading.Lock`` objects; ``RAY_TPU_LOCKTIME_BURST_S`` /
+  ``RAY_TPU_LOCKTIME_INTERVAL_S`` tune the duty cycle);
+- ``RAY_TPU_LOCKPROF=1``: full capture — EVERY acquire timed exactly
+  (blocking ones via a perf_counter pair, no duty cycle, no scaling),
+  hold timed on every release;
+- ``RAY_TPU_LOCKWITNESS=1``: the raylint
+  :class:`~ray_tpu.devtools.raylint.lockwitness.WitnessLock` proxy that
+  feeds the global lock-order graph (tier-1 deadlock-order gate);
+  witness mode replaces timing — stacking proxies would double the
+  per-acquire cost in the mode tests drive hardest.
 """
 
 from __future__ import annotations
 
 import os
 import threading
+import time
+import weakref
+from typing import Dict, Optional
+
+# Locks acquired many times per TASK (not per control message) sit under
+# the 1%-of-throughput overhead budget for the whole profiling plane;
+# the metrics-registry lock guards nanosecond-scale dict writes under
+# every Counter.inc/Gauge.set and can never reveal a dispatch stall —
+# timing it costs more than its signal is worth.
+_UNTIMED = frozenset(("metrics.registry",))
+
+# Duty cycle of the timing window.  50ms every 2s keeps the armed
+# fraction at ~2.4% — the probe+perf_counter cost only ever applies to
+# that slice, and the wall/armed scale in lock_stats() undoes the
+# sampling.
+_ARM_BURST_S = float(os.environ.get("RAY_TPU_LOCKTIME_BURST_S", "0.05"))
+_ARM_INTERVAL_S = float(os.environ.get("RAY_TPU_LOCKTIME_INTERVAL_S", "2.0"))
+
+# name -> aggregate timing row.  Plain dict guarded by a RAW lock (the
+# stats lock itself must never be a timed lock).
+_stats_lock = threading.Lock()
+_stats: Dict[str, dict] = {}
+
+# Every live default-mode proxy, so the metronome can flip their _armed
+# flag without the proxies polling a clock on the hot path.
+_instances: "weakref.WeakSet[_TimedLock]" = weakref.WeakSet()
+
+_armed_total_s = 0.0           # completed armed time this epoch
+_armed_since: Optional[float] = None  # perf_counter of the open armed window
+_timing_t0: Optional[float] = None    # epoch start (first make_lock / reset)
+_manual_armed: Optional[bool] = None  # arm_timing() pin; None = metronome
+_metronome: Optional[threading.Thread] = None
+_metronome_pid: Optional[int] = None
+
+
+def _stat_row(name: str) -> dict:
+    with _stats_lock:
+        row = _stats.get(name)
+        if row is None:
+            row = _stats[name] = {
+                "acquires": 0, "contended": 0,
+                "wait_s": 0.0, "hold_s": 0.0,
+                "max_wait_s": 0.0, "max_hold_s": 0.0,
+            }
+        return row
+
+
+def _arm(on: bool) -> None:
+    global _armed_since, _armed_total_s
+    now = time.perf_counter()
+    with _stats_lock:
+        if on and _armed_since is None:
+            _armed_since = now
+        elif not on and _armed_since is not None:
+            _armed_total_s += now - _armed_since
+            _armed_since = None
+        proxies = list(_instances)
+    for lk in proxies:
+        lk._armed = on
+
+
+def arm_timing(on: Optional[bool]) -> None:
+    """Pin the timing window open (``True``) or shut (``False``) — the
+    metronome leaves a pinned state alone, so a test can hold timing on
+    while it hammers a lock.  ``None`` disarms and hands control back to
+    the metronome."""
+    global _manual_armed
+    _manual_armed = None if on is None else bool(on)
+    _arm(bool(on) if on is not None else False)
+
+
+def timing_scale() -> float:
+    """wall-time / armed-time since the epoch began — the factor that
+    turns armed-window raw aggregates into process-wide estimates."""
+    with _stats_lock:
+        armed = _armed_total_s
+        if _armed_since is not None:
+            armed += time.perf_counter() - _armed_since
+        t0 = _timing_t0
+    if t0 is None or armed <= 0.0:
+        return 1.0
+    return max(1.0, (time.perf_counter() - t0) / armed)
+
+
+def _metronome_loop(pid: int) -> None:
+    while pid == os.getpid():
+        time.sleep(_ARM_INTERVAL_S)
+        if _manual_armed is None:
+            _arm(True)
+        time.sleep(_ARM_BURST_S)
+        if _manual_armed is None:
+            _arm(False)
+
+
+def _ensure_metronome() -> None:
+    """Start (or restart after fork — forked children inherit the module
+    state but not the thread) the arming metronome.  Called from
+    ``make_lock``; lock creation is rare, never on a hot path."""
+    global _metronome, _metronome_pid, _timing_t0
+    global _armed_total_s, _armed_since
+    pid = os.getpid()
+    with _stats_lock:
+        if (_metronome is not None and _metronome_pid == pid
+                and _metronome.is_alive()):
+            return
+        if _timing_t0 is None or _metronome_pid != pid:
+            # fresh epoch: a forked child must not inherit the parent's
+            # armed-time accounting, it never observed those windows
+            _timing_t0 = time.perf_counter()
+            _armed_total_s = 0.0
+            _armed_since = None
+        _metronome_pid = pid
+        _metronome = threading.Thread(
+            target=_metronome_loop, args=(pid,), daemon=True,
+            name="ray_tpu-lock-metronome")
+        _metronome.start()
+
+
+def lock_stats() -> Dict[str, dict]:
+    """Aggregate wait/hold rows per named lock since process start.
+
+    Default-mode rows are duty-cycle ESTIMATES: raw armed-window
+    aggregates scaled by the measured wall/armed ratio (``max_*`` stay
+    raw — an observed extreme is a fact, not a rate).  Under
+    ``RAY_TPU_LOCKPROF=1`` every acquire was timed, so rows are exact
+    and no scale applies."""
+    scale = 1.0 if os.environ.get("RAY_TPU_LOCKPROF") else timing_scale()
+    with _stats_lock:
+        rows = {name: dict(row) for name, row in _stats.items()}
+    if scale != 1.0:
+        for row in rows.values():
+            row["acquires"] = int(row["acquires"] * scale)
+            row["contended"] = int(row["contended"] * scale)
+            row["wait_s"] *= scale
+            row["hold_s"] *= scale
+    return rows
+
+
+def reset_lock_stats() -> None:
+    """Clear the rows AND restart the scaling epoch, so post-reset rows
+    estimate post-reset traffic only (proxies created before the reset
+    keep their orphaned rows — create locks after resetting)."""
+    global _armed_total_s, _armed_since, _timing_t0
+    with _stats_lock:
+        _stats.clear()
+        now = time.perf_counter()
+        _timing_t0 = now
+        _armed_total_s = 0.0
+        if _armed_since is not None:
+            _armed_since = now
+
+
+def publish_lock_metrics() -> None:
+    """Fold the aggregates into per-lock gauges (rides the continuous
+    profiler's publish tick; workers' copies reach the head — and the
+    TSDB — over the ordinary metrics_report path)."""
+    rows = lock_stats()
+    if not rows:
+        return
+    from ray_tpu.util.metrics import Gauge
+
+    wait = Gauge("ray_tpu_lock_wait_s",
+                 "cumulative measured wait on a named lock")
+    hold = Gauge("ray_tpu_lock_hold_s",
+                 "cumulative measured hold behind contended acquires")
+    contended = Gauge("ray_tpu_lock_contended_total",
+                      "contended acquires of a named lock")
+    for name, row in rows.items():
+        tags = {"lock": name}
+        wait.set(round(row["wait_s"], 6), tags=tags)
+        hold.set(round(row["hold_s"], 6), tags=tags)
+        contended.set(row["contended"], tags=tags)
+
+
+class _TimedLock:
+    """Duty-cycled contended-wait timing proxy.  Disarmed (the ~97.6%
+    common case): ``__enter__`` is one slot load, one branch, and a
+    ZERO-arg call into the C acquire; ``__exit__`` takes the exc triple
+    positionally (no tuple packing) and calls the bound C release
+    directly.  Armed: a non-blocking probe first, and — only when the
+    lock turns out contended, which is exactly when the time is worth
+    measuring — a ``perf_counter`` pair around the blocking acquire."""
+
+    __slots__ = ("_inner", "_inner_acquire", "_inner_release", "_row",
+                 "_t0", "_armed", "__weakref__")
+
+    def __init__(self, lock, name: str):
+        self._inner = lock
+        self._inner_acquire = lock.acquire
+        self._inner_release = lock.release
+        self._row = _stat_row(name)
+        self._t0 = None  # hold-start of the acquire being timed
+        self._armed = False
+        with _stats_lock:
+            _instances.add(self)
+
+    def __enter__(self):
+        if self._armed:
+            return self._timed_acquire(True, -1)
+        return self._inner_acquire()
+
+    def __exit__(self, t, v, tb):
+        if self._t0 is not None:
+            self._finish_hold()
+        self._inner_release()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if self._armed:
+            return self._timed_acquire(blocking, timeout)
+        return self._inner_acquire(blocking, timeout)
+
+    def release(self) -> None:
+        if self._t0 is not None:
+            self._finish_hold()
+        self._inner_release()
+
+    def _timed_acquire(self, blocking: bool, timeout: float) -> bool:
+        row = self._row
+        row["acquires"] += 1
+        if self._inner_acquire(False):
+            return True
+        if not blocking:
+            return False
+        t0 = time.perf_counter()
+        got = self._inner_acquire(True, timeout)
+        t1 = time.perf_counter()
+        if not got:
+            return False
+        wait = t1 - t0
+        row["contended"] += 1
+        row["wait_s"] += wait
+        if wait > row["max_wait_s"]:
+            row["max_wait_s"] = wait
+        if self._t0 is None:  # outermost timed acquire (RLock reentry)
+            self._t0 = t1
+        return True
+
+    def _finish_hold(self) -> None:
+        t0 = self._t0
+        if t0 is None:
+            return
+        self._t0 = None
+        held = time.perf_counter() - t0
+        row = self._row
+        row["hold_s"] += held
+        if held > row["max_hold_s"]:
+            row["max_hold_s"] = held
+
+    def locked(self) -> bool:
+        # parity with threading.Lock.locked (RLocks lack it; mirror that)
+        if self._inner_acquire(False):
+            self._inner_release()
+            return False
+        return True
+
+    # --- threading.Condition protocol -----------------------------------
+    # Condition(make_lock(..., rlock=True)) must see the C RLock's owner
+    # tracking; its nonblocking-probe fallback reads a held REENTRANT
+    # lock as "not owned" and cond.wait() then refuses to wait.  The
+    # cond-wait release/reacquire pair is deliberately untimed: the gap
+    # is dominated by waiting for the notify, not by lock contention.
+
+    def _is_owned(self):
+        inner = self._inner
+        if hasattr(inner, "_is_owned"):
+            return inner._is_owned()
+        if inner.acquire(False):
+            inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        if self._t0 is not None:
+            self._finish_hold()
+        inner = self._inner
+        if hasattr(inner, "_release_save"):
+            return inner._release_save()
+        inner.release()
+        return None
+
+    def _acquire_restore(self, state):
+        inner = self._inner
+        if hasattr(inner, "_acquire_restore"):
+            inner._acquire_restore(state)
+        else:
+            inner.acquire()
+
+
+class _FullTimedLock(_TimedLock):
+    """``RAY_TPU_LOCKPROF=1``: every acquire timed exactly — blocking
+    ones via a perf_counter pair, no duty cycle, no scaling.  Costs a
+    timing pair per acquire; that is the point of opting in."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self._full_acquire(True, -1)
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        return self._full_acquire(blocking, timeout)
+
+    def _full_acquire(self, blocking: bool, timeout: float) -> bool:
+        t0 = time.perf_counter()
+        got = self._inner_acquire(blocking, timeout)
+        t1 = time.perf_counter()
+        row = self._row
+        row["acquires"] += 1
+        if not got:
+            return False
+        wait = t1 - t0
+        row["contended"] += 1
+        row["wait_s"] += wait
+        if wait > row["max_wait_s"]:
+            row["max_wait_s"] = wait
+        if self._t0 is None:
+            self._t0 = t1
+        return True
+
 
 def make_lock(name: str, *, rlock: bool = False):
-    """A named Lock/RLock, witness-wrapped when RAY_TPU_LOCKWITNESS=1.
-
-    The env var is read per call so tests can enable the witness after
-    import; lock CREATION is rare (never on a hot path), only the
-    acquire/release fast path matters and that stays native when off.
+    """A named Lock/RLock with the timing proxy of the active mode (see
+    module docstring).  ``RAY_TPU_LOCKTIME=0`` restores raw native locks;
+    the env checks happen per call so tests can flip modes after import.
     """
     lock = threading.RLock() if rlock else threading.Lock()
     if os.environ.get("RAY_TPU_LOCKWITNESS"):
         from ray_tpu.devtools.raylint.lockwitness import wrap_lock
 
         return wrap_lock(name, lock)
-    return lock
+    if os.environ.get("RAY_TPU_LOCKTIME", "1") in ("0", "false", "no"):
+        return lock
+    if os.environ.get("RAY_TPU_LOCKPROF"):
+        return _FullTimedLock(lock, name)
+    if name in _UNTIMED:
+        return lock
+    _ensure_metronome()
+    return _TimedLock(lock, name)

@@ -811,6 +811,20 @@ class Node:
         self._traces_local_seq = 0
         self._traces_fold_lock = make_lock("node.traces_fold")
         self._dispatch_n = 0  # dispatch-event sampling counter
+        # continuous profiling plane: every process's ContinuousProfiler
+        # batch-ships folded stacks over its existing control connection
+        # (profile_report frames); they land here.  The head samples
+        # itself straight into the store — no loopback connection.
+        from ray_tpu.util.profile_store import ProfileStore
+
+        self.profile_store = ProfileStore()
+        self._head_profiler = None
+        from ray_tpu._private import sampling_profiler as _sp
+
+        if _sp.continuous_enabled():
+            self._head_profiler = _sp.ContinuousProfiler(
+                "head", ingest_fn=self.profile_store.ingest,
+                closed_fn=lambda: self._shutdown).start()
         self.dashboard = None
         dash_port = int(os.environ.get("RAY_TPU_DASHBOARD_PORT", "0"))
         if dash_port >= 0:
@@ -1639,6 +1653,28 @@ class Node:
             if tsdb_mod.ENABLED:
                 self.tsdb.ingest(msg["origin"], msg["metrics"])
                 self._fold_resource_report(msg["origin"], msg["metrics"])
+        elif mtype == "profile_report":
+            self.profile_store.ingest(msg["origin"], msg.get("buckets", []),
+                                      msg.get("meta"))
+        elif mtype == "list_profiles":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self.profile_store.stats()})
+        elif mtype == "get_profile":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self.profile_store.query(
+                                   msg.get("window_s", 300.0),
+                                   origin=msg.get("origin"))})
+        elif mtype == "profile_diff":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self.profile_store.diff(
+                                   msg.get("window_a", 600.0),
+                                   msg.get("window_b", 60.0),
+                                   origin=msg.get("origin"))})
+        elif mtype == "profile_ledger":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self._profile_ledger(
+                                   msg.get("window_s", 300.0),
+                                   tasks=msg.get("tasks"))})
         elif mtype == "list_metrics":
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
                                "value": self.tsdb.list_metrics()})
@@ -4326,6 +4362,15 @@ class Node:
                 self.tsdb.ingest("head", head_registry().snapshot())
                 if not stalled:
                     self.tsdb.expire_stale(self._tsdb_expiry_s)
+                    # profile rings age on the TSDB's clock: staged decay
+                    # every tick, whole origins retired on the history
+                    # horizon once their pushes stop
+                    self.profile_store.prune()
+                    for origin in self.profile_store.retire_stale(
+                            self._tsdb_expiry_s):
+                        events_mod.emit(
+                            "profile", "profile origin retired",
+                            severity="DEBUG", entity_id=origin)
             except Exception:
                 logger.debug("tsdb sampler tick failed", exc_info=True)
 
@@ -4417,6 +4462,40 @@ class Node:
                     row["local"] = False
                     row["ts"] = now
 
+    def _profile_ledger(self, window_s: float,
+                        tasks: Optional[int] = None) -> dict:
+        """The per-task CPU cost ledger over the trailing window: the
+        store's duty-cycle class rates joined with the task lane.  Only
+        task-path processes enter the sum — the head (which also hosts
+        the in-process driver) and the workers; node agents and proxied
+        tenant drivers profile too but their cycles are not per-task
+        cost.  ``tasks`` defaults to the FINISHED delta the TSDB saw
+        over the window (callers that counted exactly — the bench —
+        pass their own)."""
+        with self.lock:
+            worker_origins = {w.worker_id.hex() for w in self.workers.values()}
+        roles = {"head": "head"}
+        for row in self.profile_store.stats():
+            if row["origin"] in worker_origins:
+                roles[row["origin"]] = "worker"
+        if tasks is None:
+            tasks = 0
+            try:
+                res = self.tsdb.query(
+                    "ray_tpu_tasks", window_s=window_s,
+                    tags={"state": "FINISHED"}, agg="max")
+                points = [v for s in res.get("series", [])
+                          for _, v in s.get("points", []) if v is not None]
+                if points:
+                    tasks = int(max(points) - min(points))
+            except Exception:
+                pass
+            if not tasks:
+                with self.gcs.lock:
+                    tasks = sum(1 for t in self.gcs.tasks.values()
+                                if t.state == "FINISHED")
+        return self.profile_store.cost_ledger(window_s, tasks, roles)
+
     def refresh_runtime_gauges(self) -> None:
         """Refresh the head's runtime gauges (store/arena occupancy, task
         states, queue depth, owner-pinned bytes...) — shared by the
@@ -4452,6 +4531,14 @@ class Node:
         Gauge("ray_tpu_sched_queue_depth",
               "tasks pending cluster-wide (not yet staged on a node)").set(
             n_pending)
+        # cluster-wide share of busy samples inside serialization frames —
+        # the trend behind doctor's serialization_hot rule
+        try:
+            Gauge("ray_tpu_profile_serialization_frac",
+                  "fraction of sampled busy time spent serializing").set(
+                round(self.profile_store.serialization_frac(300.0), 4))
+        except Exception:
+            pass
         for src, n in self.events.counts().items():
             Gauge("ray_tpu_events_recorded",
                   "flight-recorder events held per source").set(
@@ -4865,6 +4952,11 @@ class Node:
     def shutdown(self) -> None:
         self._shutdown = True
         self._tsdb_stop.set()
+        if self._head_profiler is not None:
+            try:
+                self._head_profiler.stop()
+            except Exception:
+                pass
         try:
             self._dump_head_events()  # final increment of the crash trail
         except Exception:

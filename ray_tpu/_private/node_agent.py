@@ -136,6 +136,17 @@ class NodeAgent:
             self._send, origin=self.node_id,
             closed_fn=lambda: self._shutdown).start()
 
+        # continuous flamegraphs for the agent process itself, shipped
+        # over the same head connection (workers on this host each run
+        # their own)
+        from ray_tpu._private import sampling_profiler as _sp
+
+        self.cont_profiler = None
+        if _sp.continuous_enabled():
+            self.cont_profiler = _sp.ContinuousProfiler(
+                f"agent:{self.node_id}", send_fn=self._send,
+                closed_fn=lambda: self._shutdown).start()
+
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
                                          name="agent-monitor")
         self._monitor.start()
@@ -368,6 +379,11 @@ class NodeAgent:
         self._shutdown = True
         if self.syncer is not None:
             self.syncer.stop()
+        if self.cont_profiler is not None:
+            try:
+                self.cont_profiler.stop()
+            except Exception:
+                pass
         try:
             self.events_pusher.stop()
         except Exception:

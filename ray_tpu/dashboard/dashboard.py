@@ -72,13 +72,26 @@ _INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
    font-size:11px;padding:10px;border-radius:8px;white-space:pre-wrap;
    max-height:70vh;overflow:auto}
  tr.click{cursor:pointer}
+ #flamegraph{position:relative;background:#fff;border:1px solid #e3e5ea;
+   border-radius:8px;overflow:hidden;margin-bottom:10px}
+ #flamegraph .frame{position:absolute;height:16px;line-height:16px;
+   font-size:10px;font-family:monospace;overflow:hidden;white-space:nowrap;
+   border-right:1px solid rgba(255,255,255,.55);box-sizing:border-box;
+   padding-left:2px;cursor:default}
 </style></head><body>
 <header><h1>ray_tpu</h1><span id="hdr"></span></header>
 <div id="cards"></div>
 <nav id="nav"></nav>
 <main><table id="tbl"><thead></thead><tbody></tbody></table>
 <div id="logpane" style="display:none"><div id="streams"></div>
-<div id="logview"></div></div></main>
+<div id="logview"></div></div>
+<div id="flamepane" style="display:none">
+<div style="font-size:12px;color:#667;padding:4px 0">always-on profiler,
+ trailing 10&nbsp;min, all origins merged &middot; hover a frame for counts
+ &middot; <a href="/api/profile/continuous?window=600&amp;format=collapsed">
+ folded stacks</a></div>
+<div id="flamegraph"></div>
+<table id="ftbl"><thead></thead><tbody></tbody></table></div></main>
 <div id="detail"><button class="x" onclick="hideDetail()">close</button>
 <h3 id="dtitle"></h3><pre id="dbody"></pre></div>
 <div id="foot">auto-refresh 2s &middot; JSON API: /api/&lt;table&gt;[/&lt;id&gt;],
@@ -92,10 +105,12 @@ _INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
  <a href="/api/top">/api/top</a>,
  <a href="/api/perf">/api/perf</a> (step phases/MFU/compiles/HBM),
  /api/grafana_dashboard,
- /api/profile?duration=3[&amp;worker_id=][&amp;format=collapsed], /metrics</div>
+ /api/profile?duration=3[&amp;worker_id=][&amp;format=collapsed],
+ /api/profile/continuous?window=300[&amp;origin=][&amp;diff_a=&amp;diff_b=],
+ /metrics</div>
 <script>
 const TABS=["nodes","actors","tasks","workers","objects","placement_groups",
-            "jobs","serve","events","traces","metrics","logs"];
+            "jobs","serve","events","traces","metrics","flame","logs"];
 const ID_FIELD={nodes:"node_id",actors:"actor_id",tasks:"task_id",
  workers:"worker_id",placement_groups:"pg_id",jobs:"job_id",
  traces:"trace_id"};
@@ -206,8 +221,52 @@ async function renderLogs(){
  });
  if(!streams.length)box.textContent="(no log streams yet)";
 }
+function flameColor(s){let h=0;for(let i=0;i<s.length;i++)
+ h=(h*31+s.charCodeAt(i))>>>0;
+ return `hsl(${18+h%42},${55+h%30}%,${60+h%14}%)`;}
+async function renderFlame(){
+ // icicle flamegraph straight from the ProfileStore's folded stacks
+ // (root at the top); every origin's history is already head-side, so
+ // this costs one fetch — no sampling is triggered
+ document.getElementById("tbl").style.display="none";
+ document.getElementById("logpane").style.display="none";
+ const pane=document.getElementById("flamepane");pane.style.display="block";
+ const p=await (await fetch("/api/profile/continuous?window=600")).json();
+ const root={n:0,kids:{}};
+ for(const [stack,n] of Object.entries(p.folded||{})){
+  root.n+=n;let cur=root;
+  for(const f of stack.split("|"))
+   {cur=cur.kids[f]??(cur.kids[f]={n:0,kids:{}});cur.n+=n;}}
+ const g=document.getElementById("flamegraph");g.textContent="";
+ let maxd=0;
+ const place=(node,x0,x1,d)=>{
+  maxd=Math.max(maxd,d);if(d>48)return;let x=x0;
+  for(const [f,k] of Object.entries(node.kids).sort((a,b)=>b[1].n-a[1].n)){
+   const w=(x1-x0)*k.n/node.n;
+   if(w<0.15){x+=w;continue;}
+   const el=document.createElement("div");
+   el.className="frame";el.textContent=f;
+   el.title=`${f}  ${k.n} samples (${(100*k.n/root.n).toFixed(1)}%)`;
+   el.style.left=x+"%";el.style.width=w+"%";el.style.top=(d*17)+"px";
+   el.style.background=flameColor(f);
+   g.appendChild(el);
+   place(k,x,x+w,d+1);x+=w;}};
+ if(root.n)place(root,0,100,0);
+ else g.textContent=" (no continuous-profile samples yet)";
+ g.style.height=(Math.min(maxd+1,49)*17+4)+"px";
+ const rows=p.stats||[];
+ const thead=document.querySelector("#ftbl thead"),
+       tbody=document.querySelector("#ftbl tbody");
+ if(!rows.length){thead.innerHTML="";tbody.innerHTML=
+  "<tr><td>(no origins reporting)</td></tr>";return;}
+ const cols=Object.keys(rows[0]);
+ thead.innerHTML="<tr>"+cols.map(c=>`<th>${esc(c)}</th>`).join("")+"</tr>";
+ tbody.innerHTML=rows.map(r=>"<tr>"+cols.map(c=>
+  `<td>${esc(cell(r[c]))}</td>`).join("")+"</tr>").join("");
+}
 async function render(){
  [...nav.children].forEach(b=>b.classList.toggle("on",b.textContent===tab));
+ if(tab!=="flame")document.getElementById("flamepane").style.display="none";
  try{
   const s=await (await fetch("/api/cluster_status")).json();
   document.getElementById("hdr").textContent=
@@ -221,6 +280,7 @@ async function render(){
    `<div class=card><b>${v}</b><small>${k}</small></div>`).join("");
   if(tab==="logs"){await renderLogs();return;}
   if(tab==="metrics"){await renderMetrics();return;}
+  if(tab==="flame"){await renderFlame();return;}
   document.getElementById("logpane").style.display="none";
   document.getElementById("tbl").style.display="";
   const url=tab==="serve"?"/api/serve/applications":"/api/"+tab+"?limit=200";
@@ -300,6 +360,34 @@ class Dashboard:
             return
         if path == "/metrics":
             self._send(req, self._metrics_text(), ctype="text/plain; version=0.0.4")
+            return
+        if path == "/api/profile/continuous":
+            # the always-on plane: merged history from the head's
+            # ProfileStore (no new sampling — it is already there).
+            # ?window=300[&origin=][&format=collapsed]; add
+            # &diff_a=600&diff_b=60 for a differential profile
+            store = self.node.profile_store
+            origin = qs.get("origin", [None])[0]
+            fmt = qs.get("format", ["json"])[0]
+            if "diff_a" in qs or "diff_b" in qs:
+                d = store.diff(
+                    window_a=float(qs.get("diff_a", ["600"])[0]),
+                    window_b=float(qs.get("diff_b", ["60"])[0]),
+                    origin=origin)
+                if fmt == "collapsed":
+                    self._send(req, d["collapsed"],
+                               ctype="text/plain; charset=utf-8")
+                else:
+                    self._send(req, json.dumps(_jsonable(d)))
+                return
+            window = float(qs.get("window", ["300"])[0])
+            if fmt == "collapsed":
+                self._send(req, store.collapsed(window, origin=origin),
+                           ctype="text/plain; charset=utf-8")
+                return
+            prof = store.query(window, origin=origin)
+            prof["stats"] = store.stats()
+            self._send(req, json.dumps(_jsonable(prof)))
             return
         if path == "/api/profile":
             # on-demand sampling profile (py-spy/profile_manager.py analog):

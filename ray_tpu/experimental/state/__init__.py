@@ -1,4 +1,5 @@
 from ray_tpu.experimental.state.api import (
+    get_profile,
     get_trace,
     list_actors,
     list_events,
@@ -6,9 +7,12 @@ from ray_tpu.experimental.state.api import (
     list_nodes,
     list_objects,
     list_placement_groups,
+    list_profiles,
     list_tasks,
     list_traces,
     list_workers,
+    profile_diff,
+    profile_ledger,
     summarize_actors,
     summarize_events,
     summarize_state,
@@ -21,4 +25,5 @@ __all__ = [
     "list_placement_groups", "list_workers", "list_jobs", "list_events",
     "list_traces", "get_trace", "summarize_tasks", "summarize_actors",
     "summarize_events", "summarize_traces", "summarize_state",
+    "list_profiles", "get_profile", "profile_diff", "profile_ledger",
 ]

@@ -189,6 +189,53 @@ def query_metric(name: str, window_s: float = 3600.0, step_s: float = 0.0,
     return value
 
 
+# ---------------------------------------------------------------------------
+# continuous profiling (head ProfileStore)
+# ---------------------------------------------------------------------------
+
+def list_profiles() -> List[dict]:
+    """One row per origin with retained continuous-profile history in
+    the head's ProfileStore: bucket counts (fine + decayed coarse),
+    bytes, total samples, GIL-pressure estimate, push age, and the
+    origin's current sampling cadence."""
+    return _client().request({"type": "list_profiles"})["value"]
+
+
+def get_profile(window_s: float = 300.0,
+                origin: Optional[str] = None) -> dict:
+    """Merged folded stacks over the trailing window (cluster-wide, or
+    one origin's) from the always-on profiler — ``folded`` maps
+    ``|``-joined root→leaf stacks to sample counts, plus the duty-cycle
+    denominators (``ticks``/``busy_ticks``) the cost ledger divides by."""
+    return _client().request(
+        {"type": "get_profile", "window_s": window_s,
+         "origin": origin})["value"]
+
+
+def profile_diff(window_a: float = 600.0, window_b: float = 60.0,
+                 origin: Optional[str] = None) -> dict:
+    """Differential profile: the trailing ``window_b`` seconds against
+    the ``window_a``-long baseline before it, counts scaled to the same
+    span.  ``collapsed`` holds flamegraph.pl ``difffolded`` lines
+    (``stack countA countB``); ``delta`` the per-stack change."""
+    return _client().request(
+        {"type": "profile_diff", "window_a": window_a,
+         "window_b": window_b, "origin": origin})["value"]
+
+
+def profile_ledger(window_s: float = 300.0,
+                   tasks: Optional[int] = None) -> dict:
+    """The per-task CPU cost ledger: sampled stacks joined with the task
+    lane into driver-submit / head-dispatch / worker-exec / serialize /
+    lock-wait / GIL-wait microsecond columns that sum to the measured
+    per-task wall (``tasks`` overrides the TSDB-derived task count when
+    the caller counted exactly)."""
+    msg = {"type": "profile_ledger", "window_s": window_s}
+    if tasks is not None:
+        msg["tasks"] = tasks
+    return _client().request(msg)["value"]
+
+
 def memory_summary(limit: int = 200) -> dict:
     """Object-ownership audit (``ray memory`` analog): sealed object-store
     bytes attributed per owner (driver/worker/actor), pin-reason

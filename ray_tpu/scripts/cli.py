@@ -19,7 +19,11 @@ Reference: ``python/ray/scripts/scripts.py`` (cluster lifecycle) and
     memory [--limit N --json]                  object-ownership audit (`ray memory`)
     metrics [NAME] [--window S --step S]       TSDB directory / time-series query
     perf [--window S --json]                   step-phase breakdown, MFU, compiles, HBM
-    profile [--duration N --worker-id HEX]     sampling profile via the dashboard
+    profile [--duration N --worker-id HEX]     on-demand sampling profile
+    profile --live [--window S --origin O]     always-on flamegraph (folded stacks)
+    profile diff WINDOW_A WINDOW_B             differential folded stacks
+    profile ledger [--window S]                per-task CPU cost ledger
+    profile list                               origins with profile history
     serve-status                               serve deployments + autoscaling
     lint [--rule R4 --json --update-baseline]  raylint static-analysis gate
 """
@@ -619,12 +623,72 @@ def cmd_perf(args) -> None:
 
 
 def cmd_profile(args) -> None:
-    """On-demand sampling profile via the dashboard's /api/profile —
-    ``--format collapsed`` emits folded stacks for speedscope /
-    flamegraph.pl."""
+    """Profiles, on demand and continuous.
+
+    Default: dense on-demand sampling via the dashboard's /api/profile.
+    ``--live`` reads the always-on plane instead (head ProfileStore —
+    no new sampling, the history is already there); ``profile diff A B``
+    emits differential folded stacks between the trailing B seconds and
+    the A-second baseline before them; ``profile ledger`` prints the
+    per-task CPU cost columns; ``profile list`` the origins with
+    retained history.  ``--format collapsed`` (default for the
+    continuous modes) is speedscope / flamegraph.pl ready."""
     import urllib.request
 
     rt = _connect()
+    mode = args.rest[0] if args.rest else None
+    if mode not in (None, "diff", "ledger", "list"):
+        raise SystemExit(f"unknown profile mode {mode!r} "
+                         "(expected: diff, ledger, list)")
+    if args.live or mode in ("diff", "ledger", "list"):
+        from ray_tpu.experimental.state import api as state
+
+        if mode == "list":
+            rows = state.list_profiles()
+            print(json.dumps(rows, indent=2))
+            return
+        if mode == "ledger":
+            led = state.profile_ledger(window_s=args.window)
+            if args.format == "json":
+                print(json.dumps(led, indent=2))
+                return
+            wall = led["per_task_wall_us"]
+            print(f"per-task CPU ledger over the last {led['window_s']:.0f}s "
+                  f"({led['tasks']} tasks, {wall:.1f}us wall/task):")
+            for col, us in led["columns"].items():
+                pct = 100.0 * us / wall if wall else 0.0
+                print(f"  {col:20s} {us:10.2f}us  {pct:5.1f}%")
+            print(f"  {'sum':20s} {led['sum_us']:10.2f}us  "
+                  f"{led['sum_over_wall'] * 100:5.1f}%  (exactness check)")
+            print(f"  overlapped worker CPU (pipelined, not on the wall): "
+                  f"{led['overlapped_worker_cpu_us']:.2f}us/task")
+            return
+        if mode == "diff":
+            if len(args.rest) != 3:
+                raise SystemExit(
+                    "usage: ray_tpu profile diff WINDOW_A WINDOW_B "
+                    "(seconds; trailing B vs the A-long baseline before it)")
+            d = state.profile_diff(window_a=float(args.rest[1]),
+                                   window_b=float(args.rest[2]),
+                                   origin=args.origin)
+            body = (json.dumps(d, indent=2) if args.format == "json"
+                    else d["collapsed"])
+        else:  # --live
+            q = state.get_profile(window_s=args.window, origin=args.origin)
+            if args.format == "json":
+                body = json.dumps(q, indent=2)
+            else:
+                body = "\n".join(
+                    f"{stack.replace('|', ';')} {n}"
+                    for stack, n in sorted(q["folded"].items(),
+                                           key=lambda kv: -kv[1]))
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(body + "\n")
+            print(f"wrote profile to {args.out}")
+        else:
+            print(body)
+        return
     snap = rt._private.worker.global_worker.client.request(
         {"type": "state_snapshot"})["value"]
     dash = snap.get("dashboard")
@@ -639,7 +703,7 @@ def cmd_profile(args) -> None:
               file=sys.stderr)
         duration = 30.0
     url = ("http://%s:%d/api/profile?duration=%s&format=%s"
-           % (dash[0], dash[1], duration, args.format))
+           % (dash[0], dash[1], duration, args.format or "json"))
     if args.worker_id:
         url += f"&worker_id={args.worker_id}"
     with urllib.request.urlopen(url, timeout=duration + 60) as resp:
@@ -874,11 +938,25 @@ def main(argv=None) -> None:
     s.set_defaults(fn=cmd_perf)
 
     s = sub.add_parser(
-        "profile", help="sampling profile of the head or a worker")
-    s.add_argument("--duration", type=float, default=3.0)
+        "profile",
+        help="profiles: on-demand sampling, the always-on plane "
+             "(--live / diff / ledger / list)")
+    s.add_argument("rest", nargs="*",
+                   help="mode: diff WINDOW_A WINDOW_B | ledger | list "
+                        "(none: on-demand or --live)")
+    s.add_argument("--live", action="store_true",
+                   help="read the continuous profiler's history instead "
+                        "of sampling on demand")
+    s.add_argument("--window", type=float, default=300.0,
+                   help="trailing window seconds for --live/ledger")
+    s.add_argument("--origin", default=None,
+                   help="one origin ('head', worker id hex, "
+                        "'agent:<node>', 'tenant-<job>'); default: all")
+    s.add_argument("--duration", type=float, default=3.0,
+                   help="on-demand sampling duration")
     s.add_argument("--worker-id", default=None, help="worker id hex")
     s.add_argument("--format", choices=["json", "collapsed"],
-                   default="json")
+                   default=None)
     s.add_argument("--out", default=None, help="write to file")
     s.set_defaults(fn=cmd_profile)
 

@@ -149,6 +149,16 @@ def main() -> None:
         state["pusher"] = events_mod.EventsPusher(
             conn.send, origin=f"tenant-{job_id}",
             closed_fn=done.is_set).start()
+        # proxied drivers profile too — their submit-side stacks are the
+        # one part of the task path the head can't see from its own
+        # sampler (the pusher's dedicated head conn keeps profile frames
+        # out of the spliced relay stream)
+        from ray_tpu._private import sampling_profiler as _sp
+
+        if _sp.continuous_enabled():
+            state["profiler"] = _sp.ContinuousProfiler(
+                f"tenant-{job_id}", send_fn=conn.send,
+                closed_fn=done.is_set).start()
         events_mod.emit(
             "client_proxy", "tenant driver online", severity="INFO",
             entity_id=job_id, pid=os.getpid(),
@@ -164,12 +174,13 @@ def main() -> None:
     # either side went away: drop both ends.  Closing the head conn is
     # what triggers the head's tenant reap; closing the client conn is
     # what tells the tenant its session died.
-    pusher = state["pusher"]
-    if pusher is not None:
-        try:
-            pusher.stop()
-        except Exception:  # noqa: BLE001 — final ship is best-effort
-            pass
+    for key in ("profiler", "pusher"):
+        stoppable = state.get(key)
+        if stoppable is not None:
+            try:
+                stoppable.stop()
+            except Exception:  # noqa: BLE001 — final ship is best-effort
+                pass
     for c in (down, up, state["pusher_conn"]):
         if c is None:
             continue

@@ -66,6 +66,18 @@ MFU_MIN_LEVEL = 0.02         # earlier-mean floor (CPU dev noise guard)
 TENANT_REAP_STUCK_S = 10.0   # death with no reap for this long = wedged
 TENANT_KILL_RECENT_S = 120.0  # explained incident stays visible this long
 
+# -- continuous-profiling thresholds (signals only the always-on sampler
+# and the lock-timing plane can express) -------------------------------------
+GIL_SATURATION_FRAC = 0.35   # sustained off-GIL fraction to call a process
+                             # core-bound (healthy loaded heads sit < 0.2)
+GIL_MIN_POINTS = 4           # sustained means: the whole trailing stretch
+LOCK_WAIT_MIN_S = 1.0        # measured wait a lock must accumulate over the
+                             # window before its ratio is worth reading
+LOCK_WAIT_HOLD_RATIO = 2.0   # waiters paid >= 2x the hold behind them: a
+                             # convoy, not incidental contention
+SERIALIZATION_HOT_FRAC = 0.35  # share of sampled busy time inside
+                               # serialization frames to flag
+
 
 def _finding(rule: str, severity: str, summary: str,
              evidence: Sequence[dict], remedy: str) -> dict:
@@ -715,11 +727,129 @@ def _trend_rule_mfu_regression(series_map):
         "pressure, or a straggler rank")
 
 
+def _trend_rule_gil_saturation(series_map):
+    """A process whose continuous profiler keeps reporting high tick
+    lateness is core-bound: its threads sit runnable behind the GIL.
+    This is the measured number behind ROADMAP's "core-bound" label —
+    sustained, not one hot burst."""
+    worst = None
+    for s in series_map.get("ray_tpu_gil_lateness_frac", ()):
+        pts = s.get("points") or []
+        if len(pts) < GIL_MIN_POINTS:
+            continue
+        tail = pts[-GIL_MIN_POINTS:]
+        if min(p[1] for p in tail) < GIL_SATURATION_FRAC:
+            continue
+        mean = sum(p[1] for p in tail) / len(tail)
+        row = {"tags": s.get("tags", {}), "mean_frac": round(mean, 3),
+               "window_points": len(pts)}
+        if worst is None or mean > worst["mean_frac"]:
+            worst = row
+    if worst is None:
+        return None
+    who = worst["tags"].get("origin", "a process")
+    return _finding(
+        "gil_saturation", "WARNING",
+        f"{who} spends {worst['mean_frac'] * 100:.0f}% of sampled wall "
+        "waiting for the GIL — the process is core-bound, threads will "
+        "not help",
+        [worst],
+        "one interpreter core is the ceiling: move work into more "
+        "worker processes, or — if this is the head — ROADMAP item 3 "
+        "(native dispatch) is the structural fix; `ray_tpu profile "
+        "--live --origin <who>` shows which frames own the core")
+
+
+def _trend_rule_lock_contention(series_map):
+    """A named lock whose measured wait outruns the hold behind it is a
+    convoy: threads queue faster than the critical section drains.
+    make_lock's timing plane measures both sides, so the ratio is
+    arithmetic, not inference."""
+    # cumulative gauges: the window's cost is last - first per series
+    def _delta(name, tags):
+        for s in series_map.get(name, ()):
+            if s.get("tags") == tags:
+                pts = s.get("points") or []
+                if len(pts) >= 2:
+                    return max(0.0, pts[-1][1] - pts[0][1])
+        return 0.0
+
+    worst = None
+    for s in series_map.get("ray_tpu_lock_wait_s", ()):
+        pts = s.get("points") or []
+        if len(pts) < 2:
+            continue
+        tags = s.get("tags", {})
+        wait = max(0.0, pts[-1][1] - pts[0][1])
+        if wait < LOCK_WAIT_MIN_S:
+            continue
+        hold = _delta("ray_tpu_lock_hold_s", tags)
+        ratio = wait / max(hold, 1e-6)
+        if ratio < LOCK_WAIT_HOLD_RATIO:
+            continue
+        row = {"tags": tags, "wait_s": round(wait, 3),
+               "hold_s": round(hold, 3), "ratio": round(ratio, 1)}
+        if worst is None or wait > worst["wait_s"]:
+            worst = row
+    if worst is None:
+        return None
+    name = worst["tags"].get("lock", "?")
+    if name.startswith(("node.", "profile_store")):
+        remedy = (
+            "the head control plane is convoying on its own lock — "
+            "ROADMAP item 3 (native dispatch: refcounts and dispatch "
+            "off the GIL) is the structural fix; until then shrink the "
+            "critical section or shard the state it guards")
+    else:
+        remedy = (
+            "threads queue on this lock faster than its critical "
+            "section drains: shrink what runs under it, shard the "
+            "guarded state, or hand the work to a single owner thread "
+            "(RAY_TPU_LOCKPROF=1 captures every acquire for the trace)")
+    return _finding(
+        "lock_contention", "WARNING",
+        f"lock {name}: threads waited {worst['wait_s']:.1f}s behind "
+        f"{worst['hold_s']:.1f}s of holds ({worst['ratio']:.0f}x) over "
+        "the window — a convoy",
+        [worst], remedy)
+
+
+def _trend_rule_serialization_hot(series_map):
+    """Serialization frames owning a large share of all sampled busy
+    time means the cluster ships bytes instead of doing work — the
+    continuous profiler sees it cluster-wide, without anyone asking for
+    a profile."""
+    for s in series_map.get("ray_tpu_profile_serialization_frac", ()):
+        pts = s.get("points") or []
+        if len(pts) < GIL_MIN_POINTS:
+            continue
+        tail = pts[-GIL_MIN_POINTS:]
+        if min(p[1] for p in tail) < SERIALIZATION_HOT_FRAC:
+            continue
+        mean = sum(p[1] for p in tail) / len(tail)
+        ev = {"tags": s.get("tags", {}), "serialize_frac": round(mean, 3),
+              "window_points": len(pts)}
+        return _finding(
+            "serialization_hot", "WARNING",
+            f"{mean * 100:.0f}% of sampled busy time cluster-wide is "
+            "serialization — the workload ships bytes instead of "
+            "computing",
+            [ev],
+            "pass object refs instead of values, move big transfers "
+            "onto the data plane (ROADMAP item 5: channel transport), "
+            "and check `ray_tpu profile --live` for the pickle-heavy "
+            "call sites")
+    return None
+
+
 TREND_RULES = (
     _trend_rule_rss_growth,
     _trend_rule_store_leak,
     _trend_rule_queue_climb,
     _trend_rule_mfu_regression,
+    _trend_rule_gil_saturation,
+    _trend_rule_lock_contention,
+    _trend_rule_serialization_hot,
 )
 
 # metric names the live doctor pulls from the TSDB for the trend pass
@@ -729,6 +859,10 @@ TREND_METRICS = (
     "ray_tpu_arena_bytes_used",
     "ray_tpu_sched_queue_depth",
     "ray_tpu_train_step_mfu",
+    "ray_tpu_gil_lateness_frac",
+    "ray_tpu_lock_wait_s",
+    "ray_tpu_lock_hold_s",
+    "ray_tpu_profile_serialization_frac",
 )
 
 
@@ -823,7 +957,9 @@ def render(findings: List[dict]) -> str:
                              "growth_mb", "monotone_frac", "min_depth",
                              "start_depth", "end_depth", "slope_per_min",
                              "steps", "ingest_s", "wall_s", "ingest_frac",
-                             "earlier_mfu", "trailing_mfu", "drop_frac")}
+                             "earlier_mfu", "trailing_mfu", "drop_frac",
+                             "mean_frac", "wait_s", "hold_s",
+                             "serialize_frac", "window_points")}
             out.append(f"  evidence: {desc}")
         if f["count"] > 3:
             out.append(f"  ... {f['count'] - 3} more evidence row(s)")

@@ -1,0 +1,487 @@
+"""Continuous cluster profiling: the always-on sampler, the head
+ProfileStore (rings, decay, retirement, diffs), the duty-cycled lock
+timing plane, the per-task cost ledger, and the three trend doctor
+rules that read them.
+"""
+
+import collections
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import locks as _locks
+from ray_tpu._private import sampling_profiler as sp
+from ray_tpu.util.profile_store import (BUSY_CLASSES, ProfileStore,
+                                        classify_stack)
+
+
+# ---------------------------------------------------------------------------
+# frame folding (pure)
+# ---------------------------------------------------------------------------
+
+def _deep_frame(depth):
+    if depth:
+        return _deep_frame(depth - 1)
+    return sys._getframe()
+
+
+def test_fold_frame_truncates_middle_not_root():
+    """Regression: leaf→root truncation dropped the ROOTS of deep
+    stacks, merging unrelated call trees at whatever mid-call frame
+    landed at the cut.  Deep stacks must keep root-most and leaf-most
+    frames around a mid-stack marker."""
+    frame = _deep_frame(60)
+    shallow_root = sp.fold_frame(sys._getframe(), 128).split("|")[0]
+    folded = sp.fold_frame(frame, 24).split("|")
+    assert len(folded) == 24
+    assert sp.TRUNCATION_MARKER in folded
+    # the root end survives: same outermost frame a shallow fold sees
+    assert folded[0] == shallow_root
+    # the leaf end survives: the recursion's innermost call
+    assert folded[-1].endswith(":_deep_frame")
+    # marker sits mid-stack with real frames on both sides
+    i = folded.index(sp.TRUNCATION_MARKER)
+    assert 0 < i < len(folded) - 1
+    assert folded[i + 1].endswith(":_deep_frame")
+
+
+def test_fold_frame_shallow_stack_untouched():
+    folded = sp.fold_frame(sys._getframe(), 64)
+    assert sp.TRUNCATION_MARKER not in folded
+    assert folded.split("|")[-1].endswith(
+        ":test_fold_frame_shallow_stack_untouched")
+
+
+def test_classify_stack():
+    assert classify_stack("a.py:f|selectors.py:select") == "idle"
+    assert classify_stack("a.py:f|threading.py:wait") == "idle"
+    # serialization nested under dispatch is serialization — the nesting
+    # is what the ledger exists to expose
+    assert classify_stack("node.py:dispatch|pickle.py:dumps") == "serialize"
+    assert classify_stack("client.py:request|node.py:_handle") == "dispatch"
+    assert classify_stack("locks.py:_timed_acquire") == "lock_wait"
+    assert classify_stack("mymodel.py:train_step") == "other"
+
+
+# ---------------------------------------------------------------------------
+# ProfileStore (pure, synthetic time)
+# ---------------------------------------------------------------------------
+
+T0 = 1_700_000_000.0  # aligned epoch: bucket math must be deterministic
+
+
+def _bucket(ts, folded, ticks=100.0, busy=50.0):
+    return {"ts": ts, "folded": dict(folded), "ticks": ticks,
+            "busy_ticks": busy}
+
+
+def test_store_query_window_overlap():
+    st = ProfileStore(bucket_s=60.0)
+    st.ingest("w1", [_bucket(T0, {"a.py:f|b.py:g": 10})], now=T0)
+    # a 5s window INSIDE the 60s bucket must still see it
+    q = st.query(5.0, now=T0 + 30.0)
+    assert q["samples"] == 10 and q["origins"] == ["w1"]
+    # a window that ended before the bucket began must not
+    assert st.query(5.0, now=T0 - 90.0)["samples"] == 0
+
+
+def test_store_byte_cap_decays_fine_to_coarse():
+    st = ProfileStore(bucket_s=10.0, coarse_s=100.0,
+                      max_bytes_per_origin=4096, coarse_top_k=5)
+    for i in range(40):
+        folded = {f"mod{i}.py:fn{j}|leaf{i}_{j}.py:hot": 3 for j in range(8)}
+        st.ingest("w1", [_bucket(T0 + 10.0 * i, folded)], now=T0 + 10.0 * i)
+    row = st.stats(now=T0 + 400.0)[0]
+    assert row["bytes"] <= 4096
+    assert row["coarse_buckets"] >= 1  # pressure folded fine into coarse
+    # the coarse ring keeps top-K + a decay marker, not the full tail
+    q = st.query(1e6, now=T0 + 400.0)
+    assert "(decayed)" in q["folded"]
+    # no samples were lost to the decay, only resolution
+    assert q["samples"] == 40 * 8 * 3
+
+
+def test_store_origin_lru_eviction():
+    st = ProfileStore(max_origins=3)
+    for i, name in enumerate(("a", "b", "c", "d")):
+        st.ingest(name, [_bucket(T0, {"x.py:f": 1})], now=T0 + i)
+    names = {r["origin"] for r in st.stats(now=T0 + 10)}
+    assert names == {"b", "c", "d"}  # oldest push evicted
+
+
+def test_store_prune_ages_fine_then_drops_coarse():
+    st = ProfileStore(bucket_s=10.0, coarse_s=100.0,
+                      fine_retention_s=50.0, coarse_retention_s=300.0)
+    st.ingest("w1", [_bucket(T0, {"x.py:f": 5})], now=T0)
+    st.prune(now=T0 + 100.0)  # past fine retention -> folds to coarse
+    row = st.stats(now=T0 + 100.0)[0]
+    assert row["buckets"] == 0 and row["coarse_buckets"] == 1
+    assert st.query(1e6, now=T0 + 100.0)["samples"] == 5  # still queryable
+    st.prune(now=T0 + 1000.0)  # past coarse retention -> gone
+    assert st.query(1e6, now=T0 + 1000.0)["samples"] == 0
+
+
+def test_store_retires_dead_origins():
+    st = ProfileStore()
+    st.ingest("alive", [_bucket(T0, {"x.py:f": 1})], now=T0)
+    st.ingest("dead", [_bucket(T0, {"x.py:f": 1})], now=T0)
+    st.ingest("alive", [_bucket(T0 + 100, {"x.py:f": 1})], now=T0 + 100)
+    assert st.retire_stale(60.0, now=T0 + 100.0) == ["dead"]
+    assert {r["origin"] for r in st.stats()} == {"alive"}
+
+
+def test_store_diff_scales_baseline_to_recent_span():
+    st = ProfileStore(bucket_s=10.0)
+    # baseline: steady 10 samples/bucket of f; recent: f gone, g hot
+    for i in range(6):
+        st.ingest("w1", [_bucket(T0 + 10.0 * i, {"a.py:f": 10})],
+                  now=T0 + 10.0 * i)
+    st.ingest("w1", [_bucket(T0 + 60.0, {"b.py:g": 30})], now=T0 + 60.0)
+    d = st.diff(60.0, 10.0, now=T0 + 70.0)
+    assert d["samples_a"] == 60 and d["samples_b"] == 30
+    # A scaled to B's span: 60 * (10/60) = 10 -> f delta -10, g delta +30
+    assert d["delta"]["a.py:f"] == pytest.approx(-10.0)
+    assert d["delta"]["b.py:g"] == pytest.approx(30.0)
+    lines = dict()
+    for ln in d["collapsed"].splitlines():
+        stack, a, b = ln.rsplit(" ", 2)
+        lines[stack] = (int(a), int(b))
+    assert lines["a.py:f"] == (10, 0)    # difffolded: countA countB
+    assert lines["b.py:g"] == (0, 30)
+
+
+def test_store_cost_ledger_columns_sum_to_wall():
+    st = ProfileStore(bucket_s=10.0)
+    # head: fully busy (busy == ticks -> util 1.0), half dispatch half
+    # serialize; worker: fully busy too (its CPU overlaps a busy head,
+    # so it must NOT inflate the sum)
+    st.ingest("head", [_bucket(T0, {"node.py:_handle": 50,
+                                    "pickle.py:dumps": 50},
+                               ticks=100.0, busy=100.0)],
+              meta={"lateness_frac": 0.0}, now=T0)
+    st.ingest("w1", [_bucket(T0, {"worker.py:_main_loop|user.py:fn": 80},
+                             ticks=80.0, busy=80.0)], now=T0)
+    led = st.cost_ledger(10.0, tasks=1000,
+                         roles={"head": "head", "w1": "worker"},
+                         now=T0 + 5.0)
+    cols = led["columns"]
+    assert led["per_task_wall_us"] == pytest.approx(10_000.0)
+    assert led["sum_over_wall"] == pytest.approx(1.0, abs=0.01)
+    assert cols["head_dispatch_us"] == pytest.approx(5000.0, rel=0.01)
+    assert cols["serialize_us"] == pytest.approx(5000.0, rel=0.01)
+    # busy head leaves no wall gap: worker CPU reports as overlapped
+    assert cols["worker_exec_us"] == 0.0
+    assert led["overlapped_worker_cpu_us"] == pytest.approx(10_000.0,
+                                                            rel=0.01)
+    # GIL share comes off the top when the head reports lateness
+    st.ingest("head", [], meta={"lateness_frac": 0.5, "ticks": 0}, now=T0)
+    led2 = st.cost_ledger(10.0, tasks=1000, roles={"head": "head"},
+                          now=T0 + 5.0)
+    assert led2["columns"]["gil_wait_us"] > 0
+    assert led2["sum_over_wall"] == pytest.approx(1.0, abs=0.01)
+
+
+def test_store_class_rates_util_uses_busy_ticks():
+    st = ProfileStore(bucket_s=10.0)
+    # 4 GIL-inflated thread stacks per tick but only 30/100 ticks busy:
+    # raw_busy photographs ~4 threads; util must report 0.3
+    st.ingest("w", [_bucket(T0, {"a.py:f": 400}, ticks=100.0, busy=30.0)],
+              now=T0)
+    r = st.class_rates(100.0, origin="w", now=T0 + 5.0)
+    assert r["raw_busy"] == pytest.approx(4.0)
+    assert r["util"] == pytest.approx(0.3)
+    assert set(r["classes"]) == set(BUSY_CLASSES)
+
+
+# ---------------------------------------------------------------------------
+# continuous profiler (in-process, no cluster)
+# ---------------------------------------------------------------------------
+
+def test_continuous_profiler_ships_into_store():
+    st = ProfileStore(bucket_s=1.0)
+    p = sp.ContinuousProfiler("test-origin", ingest_fn=st.ingest,
+                              burst_s=0.03, interval_s=0.05,
+                              period_s=0.002, ship_every_s=0.1)
+    stop = threading.Event()
+
+    def spin():  # give the sampler a busy stack to catch
+        while not stop.is_set():
+            sum(i * i for i in range(500))
+
+    t = threading.Thread(target=spin, daemon=True)
+    t.start()
+    p.start()
+    try:
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            if st.query(60.0).get("samples", 0) > 0:
+                break
+            time.sleep(0.05)
+    finally:
+        p.stop()
+        stop.set()
+        t.join(timeout=2.0)
+    q = st.query(60.0)
+    assert q["samples"] > 0 and q["origins"] == ["test-origin"]
+    assert q["ticks"] > 0  # duty denominators shipped alongside stacks
+    row = st.stats()[0]
+    assert row["period_s"] == pytest.approx(0.002)
+
+
+def test_continuous_profiler_backoff_and_reset():
+    p = sp.ContinuousProfiler("t", ingest_fn=lambda *a, **k: None,
+                              interval_s=0.5, max_interval_s=4.0)
+    static = collections.Counter({"a.py:f|b.py:wait": 5})
+    for _ in range(4):
+        p._adapt(static)
+    assert p._cur_interval > 0.5  # idle process: interval backed off
+    p._adapt(collections.Counter({"a.py:f|c.py:work": 5}))
+    assert p._cur_interval == 0.5  # stacks changed: full cadence again
+
+
+# ---------------------------------------------------------------------------
+# lock timing plane
+# ---------------------------------------------------------------------------
+
+def test_timed_lock_hammer_measures_contention():
+    """Pin the timing window open and hammer one lock from 4 threads:
+    contended waits and the holds behind them must both be measured,
+    and the epoch-scaled acquire estimate must match the true count."""
+    _locks.reset_lock_stats()
+    lk = _locks.make_lock("test.hammer")
+    assert type(lk).__name__ == "_TimedLock"
+    _locks.arm_timing(True)
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-4)
+    n = 20_000
+
+    def hammer():
+        for _ in range(n):
+            with lk:
+                pass
+
+    try:
+        ths = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+        _locks.arm_timing(None)
+    row = _locks.lock_stats()["test.hammer"]
+    assert row["contended"] > 0
+    assert row["wait_s"] > 0 and row["hold_s"] > 0
+    assert row["max_wait_s"] > 0
+    # scaled row / scale = raw armed-window counts; armed covered the
+    # whole hammer, so raw must be ~exact
+    raw = row["acquires"] / _locks.timing_scale()
+    assert raw == pytest.approx(4 * n, rel=0.15)
+
+
+def test_timed_lock_disarmed_is_passthrough():
+    _locks.reset_lock_stats()
+    _locks.arm_timing(False)
+    try:
+        lk = _locks.make_lock("test.quiet")
+        for _ in range(500):
+            with lk:
+                pass
+        assert _locks.lock_stats()["test.quiet"]["acquires"] == 0
+        # lock semantics intact either way
+        assert lk.acquire() is True
+        assert lk.acquire(False) is False
+        lk.release()
+        assert lk.locked() is False
+    finally:
+        _locks.arm_timing(None)
+
+
+def test_full_timed_lock_counts_every_acquire(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LOCKPROF", "1")
+    _locks.reset_lock_stats()
+    lk = _locks.make_lock("test.full")
+    assert type(lk).__name__ == "_FullTimedLock"
+    for _ in range(100):
+        with lk:
+            pass
+    lk.acquire()
+    lk.release()
+    row = _locks.lock_stats()["test.full"]
+    assert row["acquires"] == 101  # exact, no duty scale under LOCKPROF
+
+
+def test_condition_on_timed_rlock():
+    """Condition(make_lock(rlock=True)) must delegate the C RLock's
+    owner tracking — a nonblocking-probe fallback reads a held REENTRANT
+    lock as "not owned" and wait() then refuses to wait."""
+    rlk = _locks.make_lock("test.cond", rlock=True)
+    cond = threading.Condition(rlk)
+    box = []
+
+    def waiter():
+        with cond:
+            while not box:
+                cond.wait(timeout=5.0)
+            box.append("seen")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cond:
+        box.append("x")
+        cond.notify()
+    t.join(timeout=5.0)
+    assert not t.is_alive() and box == ["x", "seen"]
+    with rlk:
+        with rlk:  # reentrancy through the proxy
+            pass
+
+
+def test_reset_lock_stats_restarts_epoch():
+    _locks.arm_timing(True)
+    time.sleep(0.01)
+    _locks.reset_lock_stats()
+    _locks.arm_timing(None)
+    # post-reset: a fresh epoch, not the process-lifetime one
+    assert _locks.timing_scale() < 100.0
+
+
+# ---------------------------------------------------------------------------
+# doctor trend rules
+# ---------------------------------------------------------------------------
+
+def _series(vals, tags=None, step=30.0):
+    return {"tags": tags or {}, "points": [[T0 + i * step, v]
+                                           for i, v in enumerate(vals)]}
+
+
+def test_profiling_doctor_rules_fire_on_induced_pathology():
+    from ray_tpu.util import doctor
+
+    findings = doctor.diagnose_trends({
+        # sustained GIL pressure on the head origin
+        "ray_tpu_gil_lateness_frac": [
+            _series([0.6] * 8, tags={"origin": "head"})],
+        # a convoy: 6s of measured wait behind 0.5s of holds
+        "ray_tpu_lock_wait_s": [
+            _series([1.0 + i for i in range(7)],
+                    tags={"lock": "node.registry"})],
+        "ray_tpu_lock_hold_s": [
+            _series([0.1 + 0.07 * i for i in range(7)],
+                    tags={"lock": "node.registry"})],
+        # the cluster ships bytes instead of computing
+        "ray_tpu_profile_serialization_frac": [_series([0.55] * 8)],
+    })
+    rules = {f["rule"] for f in findings}
+    assert rules == {"gil_saturation", "lock_contention",
+                     "serialization_hot"}
+    gil = next(f for f in findings if f["rule"] == "gil_saturation")
+    assert "head" in gil["summary"]
+    assert "ROADMAP item 3" in gil["remedy"]  # names the structural fix
+    lock = next(f for f in findings if f["rule"] == "lock_contention")
+    assert "node.registry" in lock["summary"]
+    assert "ROADMAP item 3" in lock["remedy"]  # head-plane lock remedy
+    ser = next(f for f in findings if f["rule"] == "serialization_hot")
+    assert "ROADMAP item 5" in ser["remedy"]
+    # render() must format all three without KeyError
+    out = doctor.render(findings)
+    for r in rules:
+        assert r in out
+
+
+def test_profiling_doctor_rules_stay_silent_on_healthy_gates():
+    from ray_tpu.util import doctor
+
+    assert doctor.diagnose_trends({
+        # below-threshold pressure, one hot burst (not sustained),
+        # waits in proportion to holds, serialization share modest
+        "ray_tpu_gil_lateness_frac": [
+            _series([0.1] * 8, tags={"origin": "head"}),
+            _series([0.1, 0.1, 0.9, 0.1, 0.1, 0.1, 0.1, 0.1],
+                    tags={"origin": "w1"})],
+        "ray_tpu_lock_wait_s": [
+            _series([1.0 + 0.5 * i for i in range(7)],
+                    tags={"lock": "node.registry"})],
+        "ray_tpu_lock_hold_s": [
+            _series([1.0 + 0.4 * i for i in range(7)],
+                    tags={"lock": "node.registry"})],
+        "ray_tpu_profile_serialization_frac": [_series([0.2] * 8)],
+    }) == []
+
+
+# ---------------------------------------------------------------------------
+# live cluster: sampler -> ship -> store -> state API/ledger
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def prof_cluster():
+    import os
+
+    env = {"RAY_TPU_METRICS_PUSH_S": "0.5",
+           "RAY_TPU_CONT_PROFILE_INTERVAL_S": "0.2"}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    ray_tpu.init(num_cpus=4, num_tpus=0)
+    yield
+    ray_tpu.shutdown()
+    for k, v in old.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+def test_live_profiles_reach_store_and_state_api(prof_cluster):
+    from ray_tpu.experimental.state import api as state
+
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    deadline = time.time() + 30.0
+    prof = None
+    while time.time() < deadline:
+        ray_tpu.get([f.remote(i) for i in range(50)])
+        prof = state.get_profile(window_s=600.0)
+        if prof["samples"] > 0:
+            break
+        time.sleep(0.2)
+    assert prof and prof["samples"] > 0
+    assert prof["ticks"] > 0
+    assert any(o.startswith("head") for o in prof["origins"])
+    rows = state.list_profiles()
+    assert rows and {"origin", "buckets", "bytes", "samples",
+                     "gil_frac"} <= set(rows[0])
+    d = state.profile_diff(window_a=600.0, window_b=60.0)
+    assert "collapsed" in d and d["samples_b"] >= 0
+    led = state.profile_ledger(window_s=60.0)
+    assert set(led["columns"]) == {
+        "driver_submit_us", "head_dispatch_us", "worker_exec_us",
+        "serialize_us", "lock_wait_us", "gil_wait_us", "other_us"}
+    assert led["sum_us"] == pytest.approx(sum(led["columns"].values()),
+                                          rel=0.01)
+
+
+# ---------------------------------------------------------------------------
+# bench regression gate (slow: re-runs the core rows)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_bench_check_against_committed_baseline():
+    """``python bench.py --check`` re-runs the cheap core rows and
+    compares them to the committed BENCH_core.json inside tolerance
+    bands; a regression (or a failed fresh run) exits nonzero."""
+    import os
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py"), "--check"],
+        capture_output=True, text=True, timeout=2400, cwd=here)
+    assert proc.returncode == 0, (
+        f"bench --check regressed:\n{proc.stdout[-3000:]}\n"
+        f"{proc.stderr[-1000:]}")
