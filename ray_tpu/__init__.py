@@ -18,6 +18,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 from ray_tpu import exceptions
+from ray_tpu._private import log_plane as _log_plane
 from ray_tpu._private.object_ref import ObjectRef, ObjectRefGenerator
 from ray_tpu._private.worker import global_worker
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor
@@ -131,6 +132,18 @@ def init(
         global_worker.node = node
         global_worker.client = client
         global_worker.node_id = node._head_node_id if node else "node-head"
+        # driver log streaming (reference: print_to_stdstream over GCS
+        # pubsub): subscribe to this job's shipped log records and
+        # re-emit them prefixed "(name pid=… node=…)".  RAY_TPU_LOG_TO_DRIVER=0
+        # turns the re-emission off.
+        if (global_worker.job_id
+                and _os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0"):
+            if _log_plane.enabled():
+                try:
+                    client.subscribe(f"logs:{global_worker.job_id}",
+                                     _log_plane.make_driver_log_callback())
+                except Exception:
+                    pass  # log streaming is best-effort, never boot-fatal
         if node is None:
             # external driver: its flight-recorder events (streaming pump,
             # serve router) ship to the head like a worker's do.  The

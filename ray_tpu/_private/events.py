@@ -70,6 +70,11 @@ KNOWN_SOURCES = (
     # audit trail for why a window has thin (backed-off) or missing
     # (retired origin) flamegraph coverage
     "profile",
+    # log plane (_private/log_plane.py + util/log_store.py + node.py):
+    # error/traceback bursts from a single stream, worker-died-with-
+    # uncollected-stderr crash explanations, dead-stream retirement —
+    # what doctor's log_error_burst / worker_stderr_at_death rules read
+    "log",
 )
 
 # Kill switch for the whole observability layer (events + hot-path metric

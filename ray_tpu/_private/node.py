@@ -825,6 +825,31 @@ class Node:
             self._head_profiler = _sp.ContinuousProfiler(
                 "head", ingest_fn=self.profile_store.ingest,
                 closed_fn=lambda: self._shutdown).start()
+        # cluster log plane: local capture files (head, local workers,
+        # job drivers, tenant drivers) tail into the head's bounded
+        # store; node agents ship their workers' files as log_report
+        # frames into the same ingest.  Driver streaming rides pubsub
+        # on "logs:<job>" channels.
+        from ray_tpu._private import log_plane as log_plane_mod
+        from ray_tpu.util.log_store import LogStore
+
+        self.log_store = LogStore(emit_fn=events_mod.emit)
+        self._log_monitor = None
+        self._head_log_handler = None
+        if log_plane_mod.enabled():
+            self._log_monitor = log_plane_mod.LogMonitor(
+                self._head_node_id, ingest_fn=self._ingest_log_report,
+                closed_fn=lambda: self._shutdown)
+            # the head shares the driver's process and cannot dup2 the
+            # user's tty away; its ray_tpu.* logger records mirror into
+            # logs/head.log instead
+            head_log = os.path.join(self.session_dir, "logs", "head.log")
+            self._head_log_handler = log_plane_mod.attach_logger_capture(
+                head_log)
+            self._log_monitor.register(
+                "head", head_log, node=self._head_node_id,
+                pid=os.getpid(), src="I")
+            self._log_monitor.start()
         self.dashboard = None
         dash_port = int(os.environ.get("RAY_TPU_DASHBOARD_PORT", "0"))
         if dash_port >= 0:
@@ -1607,6 +1632,13 @@ class Node:
             jid = self.job_manager.submit(
                 msg["entrypoint"], msg.get("runtime_env"), msg.get("job_id"),
                 msg.get("metadata"))
+            if self._log_monitor is not None:
+                # the job driver's log file joins the tail set, so its
+                # lines reach the store/CLI like any worker's
+                self._log_monitor.register(
+                    f"job-{jid}",
+                    os.path.join(self.session_dir, "jobs", f"{jid}.log"),
+                    node=self._head_node_id, job=jid)
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"], "value": jid})
         elif mtype == "job_info":
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
@@ -1705,6 +1737,17 @@ class Node:
         elif mtype == "get_trace":
             self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
                                "value": self._get_trace(msg["trace_id"])})
+        elif mtype == "log_report":
+            self._ingest_log_report(msg["origin"], msg.get("records") or [],
+                                    msg.get("streams"))
+        elif mtype == "get_log":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self._get_log(msg)})
+        elif mtype == "tail_log":
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": self.log_store.tail_text(
+                                   msg["stream"], msg.get("n", 100),
+                                   bool(msg.get("errors")))})
         elif mtype == "summarize_state":
             try:
                 value = self._summarize_state(msg["what"])
@@ -1757,10 +1800,27 @@ class Node:
                 or (runtime_env or {}).get("conda")):
             proc = self._forkserver.spawn(env, cwd)
             if proc is not None:
+                self._register_worker_log(worker_id, ns.node_id, proc)
                 return proc
-        return subprocess.Popen(
+        proc = subprocess.Popen(
             _worker_argv(runtime_env), env=env, cwd=cwd
         )
+        self._register_worker_log(worker_id, ns.node_id, proc)
+        return proc
+
+    def _register_worker_log(self, worker_id: bytes, node_id: str,
+                             proc) -> None:
+        """A locally spawned worker's capture file joins the head's tail
+        set.  Remote workers are the agents' to tail — registration-based
+        ownership is what keeps each line shipped exactly once when an
+        emulated multi-node run shares one session dir."""
+        if self._log_monitor is None:
+            return
+        self._log_monitor.register(
+            f"worker-{worker_id.hex()}",
+            os.path.join(self.session_dir, "logs",
+                         f"worker-{worker_id.hex()}.log"),
+            node=node_id, pid=getattr(proc, "pid", None))
 
     def _spawn_on_node(
         self,
@@ -1892,6 +1952,8 @@ class Node:
             severity="WARNING" if (spec is not None or h.actor_id) else "INFO",
             entity_id=h.worker_id.hex(), node=h.node_id,
             running_task=(spec or {}).get("name"))
+        self._retire_worker_log(h, reason, busy=spec is not None
+                                or h.actor_id is not None)
         if h.actor_id is not None:
             self._on_actor_worker_death(h, reason)
         elif spec is not None or pipelined:
@@ -4199,6 +4261,11 @@ class Node:
                        for jid, rec in self._jobs.items()]
             out.sort(key=lambda r: r["job_id"])
             return out[:limit], len(out)
+        if what == "logs":
+            # one row per captured stream (worker/job/tenant/head files
+            # the monitors are tailing, retired death tails included)
+            rows = self.log_store.stats()
+            return rows[:limit], len(rows)
         raise ValueError(f"unknown state table {what!r}")
 
     # ------------------------------------------------------------------
@@ -4277,12 +4344,104 @@ class Node:
             return None
         spans = (base["spans"] if base else []) + task_spans
         spans.sort(key=lambda s: s["start"])
+        # the trace's log records (stamped lines whose writer was inside
+        # one of these spans) join the tree — prints become evidence on
+        # the same timeline as the spans that produced them
+        log_rows, _ = self.log_store.query(trace=trace_id, limit=500)
         return {
             "trace_id": trace_id,
             "spans": spans,
+            "logs": log_rows,
             "dropped_spans": (base["dropped_spans"] if base else 0)
             + task_dropped,
         }
+
+    # ------------------------------------------------------------------
+    # log plane (head side)
+    # ------------------------------------------------------------------
+    def _ingest_log_report(self, origin: str, records, metas=None) -> None:
+        """One shipped batch lands in the store; each job's slice then
+        fans out to that job's subscribed drivers over pubsub.  Dict
+        materialization (and actor-name resolution) happens only for
+        channels someone is actually listening on."""
+        by_job = self.log_store.ingest(origin, records, metas)
+        for job, recs in by_job.items():
+            channel = f"logs:{job}"
+            with self.lock:
+                if not self.subscribers.get(channel):
+                    continue
+            out = []
+            meta_cache: Dict[str, dict] = {}
+            for seq, ts, stream, src, task, actor, trace, line in recs:
+                meta = meta_cache.get(stream)
+                if meta is None:
+                    meta = self.log_store.stream_meta(stream)
+                    meta_cache[stream] = meta
+                name = None
+                if actor:
+                    try:
+                        with self.gcs.lock:
+                            a = self.gcs.actors.get(bytes.fromhex(actor))
+                        if a is not None:
+                            name = a.name or a.class_name
+                    except ValueError:
+                        pass
+                out.append({"seq": seq, "ts": ts, "stream": stream,
+                            "src": src, "task": task, "actor": actor,
+                            "trace": trace, "line": line, "name": name,
+                            "pid": meta.get("pid"),
+                            "node": meta.get("node")})
+            self.publish(channel, {"records": out})
+
+    def _retire_worker_log(self, h, reason: str, busy: bool) -> None:
+        """A dead worker's capture file gets one final synchronous drain
+        (local workers only — agents drain remote files BEFORE reporting
+        the death, so the tail is already here), then its ring is
+        retired-but-kept: that is what makes a SIGKILL'd worker's last
+        stderr retrievable from the head after death.  If the tail ends
+        in error output nobody consumed, surface it as the crash
+        explanation (the doctor's worker_stderr_at_death rule)."""
+        stream = f"worker-{h.worker_id.hex()}"
+        if self._log_monitor is not None and h.proc is not None:
+            self._log_monitor.unregister(stream)
+        err_rows, _ = self.log_store.query(stream=stream, errors=True,
+                                           limit=12)
+        self.log_store.retire(stream)
+        if not err_rows:
+            return
+        has_tb = any(r["line"].startswith("Traceback (") for r in err_rows)
+        if not (has_tb or busy):
+            return  # idle reaping with routine stderr chatter is not a crash
+        events_mod.emit(
+            "log", f"worker died with uncollected stderr: {reason}",
+            severity="ERROR" if busy else "WARNING",
+            entity_id=h.worker_id.hex(), node=h.node_id,
+            tail=[r["line"] for r in err_rows][-8:])
+
+    def _get_log(self, msg: dict) -> dict:
+        """Record query for the state API / CLI.  ``job-<id>`` streams
+        fall back to the JobManager's complete on-disk file when the
+        store has nothing (log plane disabled, or the ring aged out) —
+        job driver logs and worker logs stay one surface either way."""
+        rows, cursor = self.log_store.query(
+            stream=msg.get("stream"), job=msg.get("job"),
+            task=msg.get("task"), actor=msg.get("actor"),
+            node=msg.get("node"), pid=msg.get("pid"),
+            trace=msg.get("trace"), grep=msg.get("grep"),
+            errors=bool(msg.get("errors")),
+            since_seq=msg.get("since_seq", 0),
+            limit=msg.get("limit", 1000))
+        stream = msg.get("stream")
+        if not rows and stream and stream.startswith("job-") \
+                and not msg.get("since_seq"):
+            text = self.job_manager.logs(stream[len("job-"):])
+            if text:
+                rows = [{"seq": 0, "ts": None, "stream": stream, "src": "o",
+                         "job": stream[len("job-"):], "task": "",
+                         "actor": "", "trace": "", "line": ln,
+                         "node": self._head_node_id, "pid": None}
+                        for ln in text.splitlines()[-msg.get("limit", 1000):]]
+        return {"records": rows, "cursor": cursor}
 
     def _summarize_state(self, what: str) -> dict:
         """Head-side aggregation for ``summarize_*`` (state_aggregator
@@ -4371,8 +4530,32 @@ class Node:
                         events_mod.emit(
                             "profile", "profile origin retired",
                             severity="DEBUG", entity_id=origin)
+                    for name in self.log_store.retire_stale(
+                            self._tsdb_expiry_s):
+                        events_mod.emit(
+                            "log", "log stream retired",
+                            severity="DEBUG", entity_id=name)
+                    self._scan_tenant_logs()
             except Exception:
                 logger.debug("tsdb sampler tick failed", exc_info=True)
+
+    def _scan_tenant_logs(self) -> None:
+        """Adopt proxied tenant-driver capture files (``tenant-*.log``
+        under the session logs dir).  The proxier spawns those drivers
+        from its own process, so spawn-time registration can't reach this
+        monitor — a narrow glob keeps the registration-based ownership
+        rule intact (nothing else ever writes tenant-*.log there)."""
+        if self._log_monitor is None:
+            return
+        import glob as glob_mod
+
+        known = set(self._log_monitor.streams())
+        pattern = os.path.join(self.session_dir, "logs", "tenant-*.log")
+        for path in glob_mod.glob(pattern):
+            stream = os.path.basename(path)[:-len(".log")]
+            if stream not in known:
+                self._log_monitor.register(stream, path,
+                                           node=self._head_node_id)
 
     def _sample_local_procs(self, sampler) -> None:
         """/proc stats for the head process and every worker whose process
@@ -4955,6 +5138,20 @@ class Node:
         if self._head_profiler is not None:
             try:
                 self._head_profiler.stop()
+            except Exception:
+                pass
+        if self._log_monitor is not None:
+            try:
+                self._log_monitor.stop()  # final drain into the store
+            except Exception:
+                pass
+        if self._head_log_handler is not None:
+            import logging as _logging
+
+            try:
+                _logging.getLogger("ray_tpu").removeHandler(
+                    self._head_log_handler)
+                self._head_log_handler.close()
             except Exception:
                 pass
         try:

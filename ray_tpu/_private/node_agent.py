@@ -147,6 +147,24 @@ class NodeAgent:
                 f"agent:{self.node_id}", send_fn=self._send,
                 closed_fn=lambda: self._shutdown).start()
 
+        # log plane: tail this host's worker capture files (registered at
+        # spawn) and batch-ship them to the head's log store over the
+        # same control connection (log_report frames, the metrics_report
+        # path).  Registration-based — the head tails only ITS local
+        # workers, so shared-session-dir emulation never double-ships.
+        from ray_tpu._private import log_plane as log_plane_mod
+
+        self.log_monitor = None
+        if log_plane_mod.enabled():
+            self.log_monitor = log_plane_mod.LogMonitor(
+                self.node_id, send_fn=self._send,
+                closed_fn=lambda: self._shutdown).start()
+            agent_log = os.environ.get("RAY_TPU_AGENT_LOG")
+            if agent_log and log_plane_mod.redirect_process_output(agent_log):
+                self.log_monitor.register(
+                    f"agent-{self.node_id}", agent_log,
+                    node=self.node_id, pid=os.getpid())
+
         self._monitor = threading.Thread(target=self._monitor_loop, daemon=True,
                                          name="agent-monitor")
         self._monitor.start()
@@ -311,6 +329,10 @@ class NodeAgent:
             return
         with self._lock:
             self.procs[wid] = proc
+        if self.log_monitor is not None and env.get("RAY_TPU_WORKER_LOG"):
+            self.log_monitor.register(
+                f"worker-{wid}", env["RAY_TPU_WORKER_LOG"],
+                node=self.node_id, pid=proc.pid)
 
     def _kill_worker(self, worker_id: str) -> None:
         with self._lock:
@@ -320,6 +342,10 @@ class NodeAgent:
                 proc.kill()
             except Exception:
                 pass
+        if self.log_monitor is not None:
+            # ship whatever the file gained before the head retires the
+            # stream (its kill_worker -> death path runs after this)
+            self.log_monitor.unregister(f"worker-{worker_id}")
 
     def _resource_loop(self) -> None:
         """/proc sampling of agent + workers on the shared deadline grid
@@ -367,6 +393,12 @@ class NodeAgent:
                         dead.append((wid, rc))
                         del self.procs[wid]
             for wid, rc in dead:
+                if self.log_monitor is not None:
+                    # final drain FIRST: the log_report rides the same
+                    # connection, so the head holds the death tail before
+                    # it processes worker_exited (the SIGKILL'd-stderr
+                    # guarantee for remote workers)
+                    self.log_monitor.unregister(f"worker-{wid}")
                 try:
                     self._send({"type": "worker_exited", "worker_id": wid,
                                 "returncode": rc})
@@ -377,6 +409,11 @@ class NodeAgent:
         from ray_tpu._private import shm as shm_mod
 
         self._shutdown = True
+        if self.log_monitor is not None:
+            try:
+                self.log_monitor.stop()  # final ship while the conn lives
+            except Exception:
+                pass
         if self.syncer is not None:
             self.syncer.stop()
         if self.cont_profiler is not None:

@@ -29,6 +29,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import cloudpickle
 
 from ray_tpu._private import events as _events
+from ray_tpu._private import log_plane
 from ray_tpu._private import serialization
 from ray_tpu._private.client import CoreClient
 from ray_tpu._private.config import get_config
@@ -78,8 +79,8 @@ class Worker:
         # runtime_env package uploads are once per unique env per driver
         # (content addressing dedups across drivers at the KV)
         self._prepared_envs: Dict[str, dict] = {}
-        self.current_task_id: Optional[bytes] = None
-        self.current_actor_id: Optional[bytes] = None
+        self._current_task_id: Optional[bytes] = None
+        self._current_actor_id: Optional[bytes] = None
         self.actor_instance: Any = None
         # tenant identity: for drivers, assigned at register_client; for
         # workers, inherited per-task from the executing spec (actor
@@ -107,6 +108,27 @@ class Worker:
         self._dead_handles: "deque[bytes]" = deque()
         self._flusher_started = False
 
+    # task/actor identity are properties so EVERY set site invalidates
+    # the log plane's per-thread stamp cache (print()-path lines carry
+    # the live context without re-deriving it per line)
+    @property
+    def current_task_id(self) -> Optional[bytes]:
+        return self._current_task_id
+
+    @current_task_id.setter
+    def current_task_id(self, value: Optional[bytes]) -> None:
+        self._current_task_id = value
+        log_plane.bump_context_epoch()
+
+    @property
+    def current_actor_id(self) -> Optional[bytes]:
+        return self._current_actor_id
+
+    @current_actor_id.setter
+    def current_actor_id(self, value: Optional[bytes]) -> None:
+        self._current_actor_id = value
+        log_plane.bump_context_epoch()
+
     @property
     def current_job_id(self) -> Optional[str]:
         return _job_ctx.get()
@@ -114,6 +136,7 @@ class Worker:
     @current_job_id.setter
     def current_job_id(self, value: Optional[str]) -> None:
         _job_ctx.set(value)
+        log_plane.bump_context_epoch()
 
     @property
     def current_namespace(self) -> Optional[str]:
@@ -717,6 +740,7 @@ def _execute_task(msg: dict) -> None:
             # namespace-scoped lookups against the actor's own namespace
             w.job_id = spec.get("job_id") or w.job_id
             w.namespace = spec.get("namespace") or w.namespace
+            log_plane.bump_context_epoch()  # job_id is a plain attribute
             results = [None]
         elif spec.get("compiled_graph"):
             # compiled-graph control op (dag/compiled.py): a shipped
@@ -891,26 +915,22 @@ def _split_returns(out: Any, num_returns: int) -> List[Any]:
 
 
 def _redirect_output_to_log() -> None:
-    """Tee this worker's stdout/stderr into its per-worker log file
-    (``RAY_TPU_WORKER_LOG``, set at spawn) so the dashboard log viewer
-    can show it (reference: per-worker log files under the session dir,
-    ``worker_setup_hook`` redirection).  dup2 at the fd level catches
-    subprocess and C-level writes too; self-redirection works for every
-    spawn path, including forkserver forks that inherit the template's
-    fds."""
+    """Redirect this worker's stdout/stderr into its per-worker rotating
+    log file (``RAY_TPU_WORKER_LOG``, set at spawn), stamped with live
+    task/actor/job/trace context so the log plane can correlate plain
+    ``print()`` output (reference: per-worker log files under the session
+    dir + the log monitor's line attribution).  dup2 at the fd level
+    catches subprocess and C-level writes too; self-redirection works for
+    every spawn path, including forkserver forks that inherit the
+    template's fds.  Failures are swallowed inside
+    ``redirect_process_output`` — logging must never block a worker
+    boot."""
     path = os.environ.get("RAY_TPU_WORKER_LOG")
     if not path:
         return
-    try:
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-        os.dup2(fd, 1)
-        os.dup2(fd, 2)
-        os.close(fd)
-        sys.stdout = os.fdopen(1, "w", buffering=1, closefd=False)
-        sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
-    except OSError:
-        pass  # logging must never block a worker boot
+    from ray_tpu._private.log_plane import redirect_process_output
+
+    redirect_process_output(path)
 
 
 def main() -> None:

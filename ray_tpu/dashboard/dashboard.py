@@ -655,6 +655,20 @@ class Dashboard:
                         "bytes": os.path.getsize(lp),
                         "mtime": os.path.getmtime(lp),
                     })
+        # union in the head LogStore's streams: remote-node workers have no
+        # file under THIS session dir, but their shipped rings (and the
+        # death tails of retired streams) are servable all the same
+        store = getattr(node, "log_store", None)
+        if store is not None:
+            seen = {s["stream"] for s in streams}
+            for r in store.stats():
+                if r["stream"] in seen:
+                    continue
+                streams.append({
+                    "stream": r["stream"],
+                    "kind": "retired" if r.get("retired") else "remote",
+                    "bytes": r["bytes"], "mtime": r.get("last_ts") or 0,
+                })
         return streams
 
     def _log_path(self, stream: str):
@@ -676,9 +690,17 @@ class Dashboard:
     def _log_tail(self, stream: str, tail_lines: int):
         import os
 
+        from ray_tpu._private import log_plane
+
         path = self._log_path(stream)
         if path is None:
-            return None
+            # not a local file: serve from the head LogStore ring (a
+            # remote node's worker, or a retired stream's death tail) —
+            # cross-node logs in the same viewer, zero JS changes
+            store = getattr(self.node, "log_store", None)
+            if store is None or stream not in store:
+                return None
+            return "\n".join(store.tail_text(stream, n=tail_lines))
         try:
             size = os.path.getsize(path)
             with open(path, "rb") as f:
@@ -688,7 +710,9 @@ class Dashboard:
         except OSError:
             return None
         lines = data.decode("utf-8", "replace").splitlines()
-        return "\n".join(lines[-tail_lines:])
+        # strip the machine context stamps for human eyes
+        return "\n".join(log_plane.parse_line(ln)[5]
+                         for ln in lines[-tail_lines:])
 
     # -- drill-down --------------------------------------------------------
     def _detail(self, table: str, key: str):

@@ -152,10 +152,53 @@ def list_traces(limit: int = 100) -> List[dict]:
 def get_trace(trace_id: str) -> Optional[dict]:
     """One assembled trace: recorder spans (router admission, channel
     waits, compiled-graph node executions, get waits...) merged with
-    task-table spans (queue + execution attribution), sorted by start.
-    None if the id is unknown."""
+    task-table spans (queue + execution attribution), sorted by start,
+    plus ``logs`` — the trace's stamped log records joined onto the
+    span tree.  None if the id is unknown."""
     return _client().request(
         {"type": "get_trace", "trace_id": trace_id})["value"]
+
+
+# ---------------------------------------------------------------------------
+# log plane (head LogStore — `ray_tpu logs` backend)
+# ---------------------------------------------------------------------------
+
+def list_logs(limit: int = 1000) -> List[dict]:
+    """One row per captured log stream in the head's LogStore (worker /
+    job-driver / tenant-driver / head files the per-node monitors tail):
+    stream name, node, pid, retained lines/bytes, and whether the
+    stream's process already died (``retired`` — its death tail stays
+    queryable until the retirement horizon)."""
+    return _list("logs", limit)
+
+
+def get_log(stream: Optional[str] = None, job: Optional[str] = None,
+            task: Optional[str] = None, actor: Optional[str] = None,
+            node: Optional[str] = None, pid: Optional[int] = None,
+            trace: Optional[str] = None, grep: Optional[str] = None,
+            errors: bool = False, since_seq: int = 0,
+            limit: int = 1000) -> dict:
+    """Filtered log records from the head's store — the ``ray_tpu logs``
+    backend.  Every filter matches the per-line context stamps (so
+    ``task=``/``actor=``/``trace=`` find a plain ``print()`` from inside
+    that execution).  Returns ``{"records", "cursor"}``; pass ``cursor``
+    back as ``since_seq`` to follow the stream incrementally.
+    ``stream="job-<id>"`` falls back to the job driver's complete
+    on-disk log when the ring has nothing."""
+    return _client().request(
+        {"type": "get_log", "stream": stream, "job": job, "task": task,
+         "actor": actor, "node": node, "pid": pid, "trace": trace,
+         "grep": grep, "errors": errors, "since_seq": since_seq,
+         "limit": limit})["value"]
+
+
+def tail_log(stream: str, n: int = 100, errors: bool = False) -> List[str]:
+    """The last ``n`` raw lines of one stream (``errors=True`` keeps only
+    stderr/traceback lines) — works for retired streams too, which is how
+    a SIGKILL'd worker's final stderr is read back after death."""
+    return _client().request(
+        {"type": "tail_log", "stream": stream, "n": n,
+         "errors": errors})["value"]
 
 
 # ---------------------------------------------------------------------------

@@ -11,6 +11,9 @@ Reference: ``python/ray/scripts/scripts.py`` (cluster lifecycle) and
     list {actors,tasks,nodes,objects,workers,placement_groups,jobs}
     submit -- <entrypoint...>                  submit a job
     job-logs <job_id> / job-stop <job_id>
+    logs [STREAM] [--follow --errors --grep P] cluster log plane (tailed
+         [--job J --task T --actor A           worker/driver files, context-
+          --node N --pid P --tail N]           stamped, from the head store)
     timeline [--out FILE]                      chrome-trace of task events
     events [--source S --severity L --limit N] flight-recorder event table
     trace [TRACE_ID]                           span tree + critical path
@@ -169,11 +172,16 @@ def cmd_submit(args) -> None:
 
 
 def cmd_job_logs(args) -> None:
-    sess = _session()
-    from ray_tpu.job_submission import JobSubmissionClient
+    """Job driver logs from the head's log store — the same surface
+    ``ray_tpu logs job-<id>`` reads (one log plane for job drivers and
+    workers; the head falls back to the complete on-disk job file when
+    the ring has aged out)."""
+    _connect()
+    from ray_tpu.experimental.state import api as state
 
-    client = JobSubmissionClient(sess["address"], authkey=bytes.fromhex(sess["authkey"]))
-    print(client.get_job_logs(args.job_id), end="")
+    reply = state.get_log(stream=f"job-{args.job_id}", limit=100_000)
+    for r in reply["records"]:
+        print(r["line"])
 
 
 def cmd_job_stop(args) -> None:
@@ -182,6 +190,59 @@ def cmd_job_stop(args) -> None:
 
     client = JobSubmissionClient(sess["address"], authkey=bytes.fromhex(sess["authkey"]))
     print("stopped" if client.stop_job(args.job_id) else "not running")
+
+
+def cmd_logs(args) -> None:
+    """Cluster log plane (``ray logs`` analog): with no stream and no
+    filters, one row per captured stream in the head's store; otherwise
+    the matching records, each prefixed ``(stream pid=… node=…)``.
+    Every filter matches the per-line context stamps, so ``--task``/
+    ``--actor``/``--job`` find a plain ``print()`` from inside that
+    execution.  ``--follow`` keeps polling the head's cursor."""
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    filtered = any((args.stream, args.job, args.task, args.actor,
+                    args.node, args.pid, args.grep, args.errors))
+    if not filtered and not args.follow:
+        rows = state.list_logs(limit=args.limit)
+        if not rows:
+            print("(no log streams captured yet)")
+            return
+        print(f"{'STREAM':<28} {'NODE':<12} {'PID':>7} {'LINES':>7} "
+              f"{'BYTES':>9}  STATE")
+        for r in rows:
+            print(f"{r['stream']:<28} {str(r.get('node') or '-'):<12} "
+                  f"{str(r.get('pid') or '-'):>7} {r['lines']:>7} "
+                  f"{r['bytes']:>9}  "
+                  f"{'retired' if r.get('retired') else 'live'}")
+        return
+
+    def emit(records):
+        for r in records:
+            print(f"({r['stream']} pid={r.get('pid')}, "
+                  f"node={r.get('node')}) {r['line']}")
+
+    reply = state.get_log(
+        stream=args.stream, job=args.job, task=args.task, actor=args.actor,
+        node=args.node, pid=args.pid, grep=args.grep, errors=args.errors,
+        limit=args.tail)
+    emit(reply["records"])
+    if not args.follow:
+        return
+    cursor = reply["cursor"]
+    try:
+        while True:
+            time.sleep(args.interval)
+            reply = state.get_log(
+                stream=args.stream, job=args.job, task=args.task,
+                actor=args.actor, node=args.node, pid=args.pid,
+                grep=args.grep, errors=args.errors,
+                since_seq=cursor, limit=100_000)
+            emit(reply["records"])
+            cursor = reply["cursor"]
+    except KeyboardInterrupt:
+        pass
 
 
 def cmd_timeline(args) -> None:
@@ -239,6 +300,11 @@ def cmd_trace(args) -> None:
         print(json.dumps(trace, indent=2, default=repr))
     else:
         print(render_trace(trace, analysis))
+        logs = trace.get("logs") or []
+        if logs:
+            print(f"\nlogs ({len(logs)} records stamped with this trace):")
+            for r in logs:
+                print(f"  ({r['stream']}) {r['line']}")
 
 
 def _repo_root() -> str:
@@ -815,7 +881,7 @@ def main(argv=None) -> None:
     s = sub.add_parser("list", help="state API tables")
     s.add_argument("what", choices=["actors", "tasks", "nodes", "objects",
                                     "workers", "placement_groups", "jobs",
-                                    "traces", "slices", "tenants"])
+                                    "traces", "slices", "tenants", "logs"])
     s.add_argument("--limit", type=int, default=100)
     s.set_defaults(fn=cmd_list)
 
@@ -828,6 +894,30 @@ def main(argv=None) -> None:
     s = sub.add_parser("job-logs")
     s.add_argument("job_id")
     s.set_defaults(fn=cmd_job_logs)
+
+    s = sub.add_parser(
+        "logs",
+        help="cluster log plane: stream table, or task/actor/trace-"
+             "correlated records from every node")
+    s.add_argument("stream", nargs="?", default=None,
+                   help="one stream (e.g. worker-<id>, job-<id>, head)")
+    s.add_argument("--follow", "-f", action="store_true",
+                   help="keep polling the head's cursor (Ctrl-C to stop)")
+    s.add_argument("--errors", action="store_true",
+                   help="only stderr/traceback lines")
+    s.add_argument("--grep", default=None, help="substring filter")
+    s.add_argument("--job", default=None)
+    s.add_argument("--task", default=None, help="task id (hex)")
+    s.add_argument("--actor", default=None, help="actor id (hex)")
+    s.add_argument("--node", default=None)
+    s.add_argument("--pid", type=int, default=None)
+    s.add_argument("--tail", type=int, default=1000,
+                   help="max records in the initial page")
+    s.add_argument("--limit", type=int, default=1000,
+                   help="max stream rows in the no-filter table")
+    s.add_argument("--interval", type=float, default=1.0,
+                   help="--follow poll period (s)")
+    s.set_defaults(fn=cmd_logs)
 
     s = sub.add_parser("job-stop")
     s.add_argument("job_id")

@@ -70,6 +70,16 @@ def main() -> None:
     # client's proxy_ready off this line
     print("READY", flush=True)
 
+    # after the handshake nothing reads this process's stdout/stderr —
+    # redirect both into the tenant capture file (set by the proxy when
+    # the session logs dir is local) so the log plane sees this driver
+    # like any worker
+    log_path = os.environ.get("RAY_TPU_DRIVER_LOG")
+    if log_path:
+        from ray_tpu._private.log_plane import redirect_process_output
+
+        redirect_process_output(log_path)
+
     state = {"reg_req_id": None, "job_id": None, "pusher": None,
              "pusher_conn": None}
     done = threading.Event()

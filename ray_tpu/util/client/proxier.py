@@ -132,11 +132,35 @@ class ProxyServer:
             except OSError:
                 pass
 
+    def _tenant_log_path(self) -> Optional[str]:
+        """Capture file for the next tenant driver, when the head's
+        session logs dir is reachable from this host (the common
+        proxy-on-head deployment).  The head's log monitor adopts
+        ``tenant-*.log`` files there by glob — spawn-time registration
+        can't cross processes."""
+        import json
+
+        try:
+            with open("/tmp/ray_tpu/last_session.json") as f:
+                sess_dir = json.load(f).get("session_dir")
+            if not sess_dir:
+                return None
+            log_dir = os.path.join(sess_dir, "logs")
+            if not os.path.isdir(log_dir):
+                return None
+            return os.path.join(
+                log_dir, f"tenant-{os.getpid()}-{len(self.tenants)}.log")
+        except (OSError, ValueError):
+            return None
+
     def _spawn_driver(self, fd: int, namespace: Optional[str]) -> TenantDriver:
         env = dict(os.environ)
         env["RAY_TPU_PROXY_CONN_FD"] = str(fd)
         env["RAY_TPU_PROXY_HEAD"] = self._head_address
         env["RAY_TPU_AUTHKEY"] = self._authkey.hex()
+        log_path = self._tenant_log_path()
+        if log_path:
+            env["RAY_TPU_DRIVER_LOG"] = log_path
         if namespace:
             env["RAY_TPU_PROXY_NAMESPACE"] = namespace
         else:

@@ -369,6 +369,51 @@ def _rule_worker_churn(events, tasks):
         "check the per-worker logs under the session dir")
 
 
+def _rule_log_error_burst(events, tasks):
+    # the log store watches its ingest for error/traceback line bursts
+    # from a single source — a worker spewing exceptions shows up here
+    # before it dies (or without ever dying)
+    rows = _rows(events, "log", prefix="error burst")
+    if not rows:
+        return None
+    srcs = sorted({r.get("entity_id") for r in rows if r.get("entity_id")})
+    return _finding(
+        "log_error_burst", "WARNING",
+        f"error/traceback log bursts from {len(srcs) or len(rows)} "
+        f"source(s): {', '.join(srcs[:4])}",
+        rows,
+        "a process is emitting errors at a high rate: read them with "
+        "`ray_tpu logs <stream> --errors` (or `ray_tpu logs --errors` "
+        "cluster-wide) and check the owning task/actor")
+
+
+def _rule_worker_stderr_at_death(events, tasks):
+    # a worker died AND its shipped stderr tail held a traceback — the
+    # crash explanation is already on the head, surface it next to the
+    # death instead of making the user dig for the file
+    rows = _rows(events, "log",
+                 prefix="worker died with uncollected stderr")
+    if not rows:
+        return None
+    sev = "ERROR" if any(r.get("severity") == "ERROR" for r in rows) \
+        else "WARNING"
+    # pull the first retained tail line into the summary: the point of
+    # this rule is that the evidence IS the explanation
+    tail_hint = ""
+    for r in rows:
+        tail = (r.get("data") or {}).get("tail") or []
+        if tail:
+            tail_hint = f" — last stderr: {tail[-1][:120]!r}"
+            break
+    return _finding(
+        "worker_stderr_at_death", sev,
+        f"{len(rows)} worker(s) died with unread stderr{tail_hint}",
+        rows,
+        "the dead worker's final stderr was captured before the death "
+        "was processed: `ray_tpu logs <stream> --errors` or "
+        "state.tail_log(stream, errors=True) has the full tail")
+
+
 def _rule_slow_node_skew(events, tasks):
     # same task name, >=2 nodes, enough samples each: a node whose mean
     # exec time is SKEW_RATIO x the fastest is dragging the tail
@@ -891,6 +936,8 @@ RULES = (
     _rule_drain_stuck,
     _rule_tenant_killed,
     _rule_worker_churn,
+    _rule_log_error_burst,
+    _rule_worker_stderr_at_death,
     _rule_slow_node_skew,
     _rule_recompile_storm,
     _rule_ingest_bound,

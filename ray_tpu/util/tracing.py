@@ -37,6 +37,7 @@ import time
 from typing import Any, Dict, Iterator, Optional
 
 from ray_tpu._private import events as _events
+from ray_tpu._private import log_plane as _log_plane
 
 # flight-recorder source for span events (one row per closed span)
 TRACE_SOURCE = "trace"
@@ -44,6 +45,19 @@ TRACE_SOURCE = "trace"
 _current: contextvars.ContextVar[Optional[Dict[str, str]]] = contextvars.ContextVar(
     "ray_tpu_trace", default=None
 )
+
+
+def _ctx_set(ctx):
+    """``_current.set`` + log-plane stamp-cache invalidation: every line
+    a thread prints while a context is active must carry its trace id."""
+    token = _current.set(ctx)
+    _log_plane.bump_context_epoch()
+    return token
+
+
+def _ctx_reset(token):
+    _current.reset(token)
+    _log_plane.bump_context_epoch()
 
 # --- id generation --------------------------------------------------------
 # NOT uuid4 per span: uuid4 reads os.urandom every call, and on this
@@ -118,14 +132,14 @@ def trace(name: str, attributes: Optional[dict] = None,
         # tenant identity rides the context: every span of the trace can
         # be attributed to the submitting job (multi-tenant trace audit)
         ctx["job"] = job
-    token = _current.set(ctx)
+    token = _ctx_set(ctx)
     otel_cm = _otel_span(name, attributes)
     t0 = time.perf_counter()
     try:
         with otel_cm:
             yield ctx
     finally:
-        _current.reset(token)
+        _ctx_reset(token)
         emit_span(name, time.perf_counter() - t0, ctx, phase=phase,
                   attributes=attributes)
 
@@ -183,13 +197,13 @@ def adopt(ctx: Optional[Dict[str, str]]) -> Any:
     worker resuming a submitter's trace).  Returns a token for
     :func:`restore`; pass None to clear (a pooled worker must not leak
     the previous task's context)."""
-    return _current.set(ctx)
+    return _ctx_set(ctx)
 
 
 def restore(token: Any) -> None:
     """Undo a matching :func:`adopt` (public inverse — callers must not
     reach into the module's contextvar)."""
-    _current.reset(token)
+    _ctx_reset(token)
 
 
 # attribute keys that would collide with emit parameters or span lineage;
@@ -244,10 +258,10 @@ def span(name: str, phase: str = "span", **data) -> Iterator[Optional[dict]]:
     if ctx is None:
         yield None
         return
-    token = _current.set(ctx)
+    token = _ctx_set(ctx)
     t0 = time.perf_counter()
     try:
         yield ctx
     finally:
-        _current.reset(token)
+        _ctx_reset(token)
         emit_span(name, time.perf_counter() - t0, ctx, phase=phase, **data)
