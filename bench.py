@@ -1966,6 +1966,73 @@ def run_log_plane_overhead() -> dict:
     raise RuntimeError(f"log plane probe failed: {proc.stderr[-2000:]}")
 
 
+_WATCHDOG_BENCH_CODE = """
+import json, os, time
+os.environ["RAY_TPU_DASHBOARD_PORT"] = "-1"
+import ray_tpu
+from ray_tpu._private.worker import global_worker
+
+ray_tpu.init(num_cpus=4)
+
+@ray_tpu.remote
+def noop():
+    return None
+
+# load the head first: several dispatch waves so the event window, task
+# table, and TSDB hold production-shaped state when the tick runs
+for _ in range(5):
+    ray_tpu.get([noop.remote() for _ in range(400)], timeout=600)
+
+node = global_worker.node
+wd = node.watchdog
+assert wd is not None, "watchdog disabled in bench env"
+wd.tick()  # warm the event cursors / doctor window
+N = 200
+t0 = time.perf_counter()
+for _ in range(N):
+    wd.tick()
+dt = time.perf_counter() - t0
+avg_s = dt / N
+cadence = 15.0  # RAY_TPU_WATCHDOG_S default: the production duty cycle
+stats = wd.stats()
+print("WATCHDOGRESULT " + json.dumps({
+    "avg_tick_ms": avg_s * 1e3,
+    "ticks_per_s": N / dt,
+    "cadence_s": cadence,
+    "overhead_pct": avg_s / cadence * 100.0,
+    "doctor_window_rows": stats["doctor_window_rows"],
+}))
+ray_tpu.shutdown()
+"""
+
+
+def run_watchdog_overhead() -> dict:
+    """watchdog_overhead row: one full evaluation tick (event-cursor
+    doctor pass + task-table rules + trend queries + SLO burn-rate over
+    the TSDB) against a loaded head, expressed as the fraction of one
+    core the loop consumes at the PRODUCTION cadence (15 s).  Gated
+    < 1% of a core — the tick is head-local by construction (zero
+    state-API pulls), so this stays milliseconds no matter the cluster
+    history."""
+    proc = subprocess.run(
+        [sys.executable, "-c", _WATCHDOG_BENCH_CODE], capture_output=True,
+        text=True, timeout=600, env=dict(os.environ),
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    for line in proc.stdout.splitlines():
+        if line.startswith("WATCHDOGRESULT "):
+            r = json.loads(line[len("WATCHDOGRESULT "):])
+            return {"watchdog_overhead": {
+                "avg_tick_ms": round(r["avg_tick_ms"], 3),
+                "ticks_per_s": round(r["ticks_per_s"], 1),
+                "cadence_s": r["cadence_s"],
+                "doctor_window_rows": r["doctor_window_rows"],
+                "overhead_pct": round(r["overhead_pct"], 4),
+                "overhead_ok": r["overhead_pct"] < 1.0,
+            }}
+    raise RuntimeError(f"watchdog probe failed: {proc.stderr[-2000:]}")
+
+
 def run_task_cost_breakdown() -> dict:
     """task_cost_breakdown row: the continuous profiler's per-task CPU
     ledger for the no-op task shape at the queued-tasks operating point.
@@ -2385,6 +2452,31 @@ def _log_plane_standalone() -> None:
     print(f"wrote {path}")
 
 
+def _watchdog_standalone() -> None:
+    """``python bench.py --watchdog``: run ONLY the watchdog overhead row
+    and merge it into BENCH_core.json (merge-by-metric, like
+    ``--log-plane``) — the row is pure host CPU, recordable anywhere."""
+    out = run_watchdog_overhead()
+    print(json.dumps(out))
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_core.json")
+    payload = {"benchmarks": [], "host": "single-node"}
+    if os.path.exists(path):
+        with open(path) as f:
+            payload = json.load(f)
+    rows = [r for r in payload.get("benchmarks", [])
+            if r.get("metric") != "watchdog_overhead"]
+    r = out["watchdog_overhead"]
+    row = {"metric": "watchdog_overhead",
+           "value": r["overhead_pct"], "unit": "pct"}
+    row.update({k: v for k, v in r.items() if k != "overhead_pct"})
+    rows.append(row)
+    payload["benchmarks"] = rows
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2)
+    print(f"wrote {path}")
+
+
 def _check_standalone(argv=None) -> int:
     """``python bench.py --check``: re-run the cheap core rows (ray_perf
     ``--quick`` into a temp file — the committed BENCH_core.json is never
@@ -2446,6 +2538,8 @@ if __name__ == "__main__":
         _rl_scaling_standalone()
     elif "--log-plane" in sys.argv:
         _log_plane_standalone()
+    elif "--watchdog" in sys.argv:
+        _watchdog_standalone()
     elif "--check" in sys.argv:
         sys.exit(_check_standalone(
             sys.argv[sys.argv.index("--check") + 1:]))

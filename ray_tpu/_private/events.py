@@ -75,6 +75,12 @@ KNOWN_SOURCES = (
     # uncollected-stderr crash explanations, dead-stream retirement —
     # what doctor's log_error_burst / worker_stderr_at_death rules read
     "log",
+    # watchdog incident lifecycle (util/watchdog.py + util/incidents.py):
+    # every open/ack/escalate/resolve transition of a tracked incident,
+    # carrying the incident id, rule, and entity — the flight-recorder
+    # audit trail `ray_tpu incidents --history` and post-mortem bundles
+    # cross-reference
+    "incident",
 )
 
 # Kill switch for the whole observability layer (events + hot-path metric
@@ -262,6 +268,12 @@ class EventTable:
         self._cap = max(1, int(capacity_per_source))
         self._by_source: Dict[str, deque] = {}
         self._lock = threading.Lock()
+        # monotonically increasing per-row counter + a ring of recent
+        # (version, row) pairs: the watchdog's incremental cursor.  A
+        # reader remembers the version it last saw and `since()` hands it
+        # only the delta — no full-table pull per tick.
+        self._version = 0
+        self._recent: deque = deque(maxlen=self._cap)
 
     def add(self, origin: str, rows: List[dict]) -> None:
         with self._lock:
@@ -274,6 +286,23 @@ class EventTable:
                 if q is None:
                     q = self._by_source[r["source"]] = deque(maxlen=self._cap)
                 q.append(r)
+                self._version += 1
+                self._recent.append((self._version, r))
+
+    def version(self) -> int:
+        """Monotonic ingest counter — unchanged version means no new rows
+        since the caller's last look (the watchdog's cheap no-op check)."""
+        with self._lock:
+            return self._version
+
+    def since(self, cursor: int) -> Tuple[List[dict], int]:
+        """(rows ingested after ``cursor``, new cursor).  Bounded by the
+        recent ring: a reader that falls further behind than the ring
+        keeps only what is still resident (same contract as the per-source
+        rings themselves — old rows are gone either way)."""
+        with self._lock:
+            rows = [r for v, r in self._recent if v > cursor]
+            return rows, self._version
 
     def list(self, limit: int = 1000, source: Optional[str] = None,
              severity: Optional[str] = None) -> List[dict]:

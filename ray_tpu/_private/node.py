@@ -850,6 +850,19 @@ class Node:
                 "head", head_log, node=self._head_node_id,
                 pid=os.getpid(), src="I")
             self._log_monitor.start()
+        # watchdog plane: continuous incremental-doctor + SLO burn-rate
+        # evaluation folding into the incident lifecycle; post-mortem
+        # bundles land under <session>/incidents/<id>/
+        from ray_tpu.util import watchdog as watchdog_mod
+
+        self.watchdog = None
+        if watchdog_mod.enabled():
+            try:
+                self.watchdog = watchdog_mod.Watchdog(self)
+                self.watchdog.start()
+            except Exception:
+                logger.warning("watchdog failed to start:\n%s",
+                               traceback.format_exc())
         self.dashboard = None
         dash_port = int(os.environ.get("RAY_TPU_DASHBOARD_PORT", "0"))
         if dash_port >= 0:
@@ -1748,6 +1761,48 @@ class Node:
                                "value": self.log_store.tail_text(
                                    msg["stream"], msg.get("n", 100),
                                    bool(msg.get("errors")))})
+        elif mtype == "get_incident":
+            wd = self.watchdog
+            if wd is None:
+                value = {"__state_error__": "watchdog disabled"}
+            else:
+                value = wd.incidents.get(msg["incident_id"]) or {
+                    "__state_error__":
+                        f"no incident {msg['incident_id']!r}"}
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": value})
+        elif mtype == "ack_incident":
+            wd = self.watchdog
+            if wd is None:
+                value = {"__state_error__": "watchdog disabled"}
+            else:
+                value = wd.ack(msg["incident_id"]) or {
+                    "__state_error__":
+                        f"no open incident {msg['incident_id']!r}"}
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": value})
+        elif mtype == "doctor_report":
+            # head-side diagnosis: the same incremental path the watchdog
+            # tick runs, against head-local tables — the client never
+            # pulls the event/task rows over the wire
+            try:
+                value = self._doctor_report(
+                    msg.get("trend_window_s", 1800.0))
+            except Exception as e:
+                value = {"__state_error__": str(e)}
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": value})
+        elif mtype == "debug_dump":
+            wd = self.watchdog
+            if wd is None:
+                value = {"__state_error__": "watchdog disabled"}
+            else:
+                try:
+                    value = {"path": wd.debug_dump(msg.get("label"))}
+                except Exception as e:
+                    value = {"__state_error__": str(e)}
+            self._reply(conn, {"type": "reply", "req_id": msg["req_id"],
+                               "value": value})
         elif mtype == "summarize_state":
             try:
                 value = self._summarize_state(msg["what"])
@@ -4266,7 +4321,33 @@ class Node:
             # the monitors are tailing, retired death tails included)
             rows = self.log_store.stats()
             return rows[:limit], len(rows)
+        if what == "incidents":
+            # the watchdog's tracked incident set, open + resolved;
+            # the history deque rides along for `incidents --history`
+            if self.watchdog is None:
+                return [], 0
+            rows = self.watchdog.incidents.list(include_resolved=True)
+            return rows[:limit], len(rows)
+        if what == "slos":
+            if self.watchdog is None:
+                return [], 0
+            rows = self.watchdog.slos()
+            return rows[:limit], len(rows)
         raise ValueError(f"unknown state table {what!r}")
+
+    def _doctor_report(self, trend_window_s: float = 1800.0) -> List[dict]:
+        """Head-side doctor pass over head-local tables — what the
+        ``doctor_report`` RPC serves so `ray_tpu doctor` stops pulling
+        100k event/task rows to the client per invocation."""
+        from ray_tpu.util import doctor as doctor_mod
+
+        try:
+            tasks, _total = self._list_state_page("tasks", 5000)
+        except Exception:
+            tasks = []
+        return doctor_mod.head_report(
+            self.events, events_mod.buffer(), self.tsdb, tasks=tasks,
+            trend_window_s=trend_window_s)
 
     # ------------------------------------------------------------------
     # request traces (state_aggregator + tracing backend analog)
@@ -4722,6 +4803,19 @@ class Node:
                 round(self.profile_store.serialization_frac(300.0), 4))
         except Exception:
             pass
+        # log-plane ship pressure: cumulative records absorbed + source-
+        # side suppression markers (grafana rates these for the "are we
+        # dropping logs" panel)
+        try:
+            lc = self.log_store.counters()
+            Gauge("ray_tpu_log_records_total",
+                  "log records ingested by the head store").set(
+                lc["ingested_total"])
+            Gauge("ray_tpu_log_suppressed_total",
+                  "log records dropped by source-side suppression").set(
+                lc["suppressed_total"])
+        except Exception:
+            pass
         for src, n in self.events.counts().items():
             Gauge("ray_tpu_events_recorded",
                   "flight-recorder events held per source").set(
@@ -5138,6 +5232,11 @@ class Node:
         if self._head_profiler is not None:
             try:
                 self._head_profiler.stop()
+            except Exception:
+                pass
+        if self.watchdog is not None:
+            try:
+                self.watchdog.stop()
             except Exception:
                 pass
         if self._log_monitor is not None:

@@ -190,6 +190,20 @@ def main(quick: bool = False) -> List[Dict]:
             ray_tpu.wait(refs, num_returns=len(refs), timeout=60)
 
         timeit("wait_8_ready", do_wait, min_time_s=min_t, results=results)
+
+        # ------------------------------------ watchdog tick (head-local)
+        # one full evaluation pass — incremental doctor + trend queries +
+        # SLO burn-rate — against the head this run just loaded with
+        # tasks/actors/events.  ops/s so bench --check gates it: a
+        # full-table pull sneaking back into the tick path shows up as a
+        # step-function drop here.
+        from ray_tpu._private.worker import global_worker as _gw
+
+        wd = getattr(_gw.node, "watchdog", None)
+        if wd is not None:
+            wd.tick()  # warm the event cursors / doctor window
+            timeit("watchdog_tick", wd.tick, min_time_s=min_t,
+                   results=results)
     finally:
         ray_tpu.shutdown()
 
@@ -426,12 +440,28 @@ def scale_envelope(quick: bool = False) -> List[Dict]:
         findings = run_doctor()
         errors = [f for f in findings
                   if f.get("severity") in ("ERROR", "CRITICAL")]
+        # watchdog tick against this loaded multi-node head rides along
+        # as a field (the --check-gated watchdog_tick row lives in the
+        # core run; this is the same tick at envelope scale)
+        from ray_tpu._private.worker import global_worker as _gw
+
+        wd = getattr(_gw.node, "watchdog", None)
+        wd_tick_ms = None
+        if wd is not None:
+            wd.tick()  # warm the cursors / doctor window
+            n_ticks = 20 if quick else 100
+            t1 = time.perf_counter()
+            for _ in range(n_ticks):
+                wd.tick()
+            wd_tick_ms = round(
+                (time.perf_counter() - t1) / n_ticks * 1e3, 3)
         record({"metric": "multi_node_envelope", "value": n_nodes,
                 "unit": "nodes", "sustained_s": round(dt, 1),
                 "ops_s": round(done / dt, 1),
                 "doctor_findings": len(findings),
                 "doctor_errors": len(errors),
-                "doctor_clean": not errors})
+                "doctor_clean": not errors,
+                "watchdog_tick_ms": wd_tick_ms})
     finally:
         cluster.shutdown()
 

@@ -338,6 +338,12 @@ class ContinuousProfiler:
               "fraction of the profiler burst wall spent waiting for the "
               "GIL/scheduler (off-GIL pressure estimate)").set(
             round(self.lateness_frac, 4))
+        # duty cycle: what share of wall the profiler spends sampling at
+        # its CURRENT (backed-off) interval — the overhead meter the
+        # grafana profiling row charts
+        Gauge("ray_tpu_profiler_duty_frac",
+              "profiler sampling duty cycle (burst wall / interval)").set(
+            round(self.burst_s / max(self._cur_interval, 1e-9), 5))
         # named-lock wait/hold gauges ride the same publish tick so the
         # lock-timing plane needs no thread of its own
         from ray_tpu._private import locks

@@ -101,6 +101,8 @@ _INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
  <a href="/api/traces">/api/traces</a>[/&lt;id&gt;] (request traces),
  <a href="/api/metrics/list">/api/metrics/list</a>,
  /api/metrics/query?name=&amp;window=&amp;step=,
+ <a href="/api/incidents">/api/incidents</a> (watchdog incidents),
+ <a href="/api/slos">/api/slos</a> (declared SLOs + burn-rate),
  <a href="/api/memory">/api/memory</a> (ownership audit),
  <a href="/api/top">/api/top</a>,
  <a href="/api/perf">/api/perf</a> (step phases/MFU/compiles/HBM),
@@ -110,7 +112,8 @@ _INDEX = """<!doctype html><html><head><title>ray_tpu dashboard</title>
  /metrics</div>
 <script>
 const TABS=["nodes","actors","tasks","workers","objects","placement_groups",
-            "jobs","serve","events","traces","metrics","flame","logs"];
+            "jobs","serve","events","traces","metrics","flame","logs",
+            "incidents"];
 const ID_FIELD={nodes:"node_id",actors:"actor_id",tasks:"task_id",
  workers:"worker_id",placement_groups:"pg_id",jobs:"job_id",
  traces:"trace_id"};
@@ -554,8 +557,9 @@ class Dashboard:
                 generate_grafana_dashboard,
             )
 
-            return generate_grafana_dashboard(self._merged_snapshot(),
-                                              tsdb=node.tsdb)
+            return generate_grafana_dashboard(
+                self._merged_snapshot(), tsdb=node.tsdb,
+                slos=node.watchdog.slos() if node.watchdog else None)
         if what == "logs":
             return self._log_streams()
         if what == "serve/config":

@@ -46,6 +46,21 @@ CORE_METRICS: Dict[str, tuple] = {
     "ray_tpu_llm_itl_s": ("histogram", "LLM inter-token latency"),
     "ray_tpu_llm_prefill_interference_s_total":
         ("counter", "decode-tick seconds billed to prefill"),
+    # continuous-profiling plane (PR 17: sampling_profiler + locks)
+    "ray_tpu_profiler_duty_frac": ("gauge", "profiler duty cycle fraction"),
+    "ray_tpu_gil_lateness_frac": ("gauge", "GIL pressure (tick lateness)"),
+    "ray_tpu_lock_wait_s": ("gauge", "named-lock wait seconds (ewma)"),
+    "ray_tpu_lock_hold_s": ("gauge", "named-lock hold seconds (ewma)"),
+    "ray_tpu_profile_serialization_frac":
+        ("gauge", "profiled time in serialization"),
+    # cluster log plane (PR 19: log ship / suppression pressure)
+    "ray_tpu_log_records_total": ("counter", "log records ingested"),
+    "ray_tpu_log_suppressed_total":
+        ("counter", "log records dropped by rate suppression"),
+    # serve SLO taps (watchdog plane)
+    "ray_tpu_serve_http_p99_s": ("gauge", "serve HTTP p99 (trailing window)"),
+    "ray_tpu_serve_http_requests_total":
+        ("counter", "serve HTTP requests by status class"),
 }
 
 _PANEL_W = 12  # two panels per 24-unit grafana row
@@ -86,8 +101,26 @@ def _panel(panel_id: int, name: str, mtype: str, help_: str,
     }
 
 
+def _apply_slo_threshold(panel: dict, slo: dict) -> None:
+    """Render a declared SLO as a Grafana threshold line on its metric's
+    panel — the same objective the watchdog alerts on, drawn where the
+    operator looks."""
+    # ">=" objectives (floors) alarm BELOW the threshold; "<=" above
+    floor = slo.get("op") == ">="
+    steps = [{"color": "red" if floor else "green", "value": None},
+             {"color": "green" if floor else "red",
+              "value": slo["threshold"]}]
+    defaults = panel["fieldConfig"]["defaults"]
+    defaults["thresholds"] = {"mode": "absolute", "steps": steps}
+    defaults.setdefault("custom", {})["thresholdsStyle"] = {"mode": "line"}
+    panel["description"] = (panel.get("description", "") +
+                            f" | SLO {slo['name']}: {slo.get('op', '<=')} "
+                            f"{slo['threshold']}")
+
+
 def generate_grafana_dashboard(snapshot: Optional[Dict[str, dict]] = None,
-                               tsdb=None) -> dict:
+                               tsdb=None,
+                               slos: Optional[List[dict]] = None) -> dict:
     """Build the dashboard dict from a registry snapshot (defaults to this
     process's registry).  Deterministic layout: core metrics first in
     their declared order, then any extra registered metric sorted by name.
@@ -96,7 +129,11 @@ def generate_grafana_dashboard(snapshot: Optional[Dict[str, dict]] = None,
     every metric with retained HISTORY — including series whose origin
     (a dead worker, a drained node) already expired from the live
     registry, which is exactly when an operator builds the dashboard to
-    investigate."""
+    investigate.
+
+    ``slos`` (rows shaped like ``watchdog.Watchdog.slos()``) draw each
+    declared objective as a threshold line on its metric's panel, so the
+    alerting objective and the dashboard can never disagree."""
     if snapshot is None:
         from ray_tpu.util import metrics as metrics_mod
 
@@ -110,11 +147,18 @@ def generate_grafana_dashboard(snapshot: Optional[Dict[str, dict]] = None,
             extra.setdefault(row["name"], (row["type"], row.get("help", "")))
     for name in sorted(extra):
         metrics[name] = extra[name]
+    # threshold-kind SLOs attach to their metric's panel (ratio SLOs
+    # have no single-series threshold to draw)
+    slo_by_metric = {s["metric"]: s for s in (slos or [])
+                     if s.get("kind", "threshold") == "threshold"}
     panels = []
     for i, (name, (mtype, help_)) in enumerate(metrics.items()):
         x = (i % 2) * _PANEL_W
         y = (i // 2) * _PANEL_H
-        panels.append(_panel(i + 1, name, mtype, help_, x, y))
+        panel = _panel(i + 1, name, mtype, help_, x, y)
+        if name in slo_by_metric:
+            _apply_slo_threshold(panel, slo_by_metric[name])
+        panels.append(panel)
     return {
         "uid": "ray-tpu-default",
         "title": "ray_tpu cluster",

@@ -295,6 +295,70 @@ def top_snapshot() -> dict:
     return _client().request({"type": "top_snapshot"})["value"]
 
 
+# ---------------------------------------------------------------------------
+# watchdog plane (incidents, SLOs, head-side doctor, debug dumps)
+# ---------------------------------------------------------------------------
+
+def list_incidents(limit: int = 1000) -> List[dict]:
+    """The watchdog's tracked incident set — open, acked, and resolved
+    rows with stable ids keyed on (rule, entity), severity, re-open
+    counts, the transition history, and the post-mortem bundle path
+    captured at open.  Empty when the watchdog is disabled."""
+    return _list("incidents", limit)
+
+
+def list_slos(limit: int = 1000) -> List[dict]:
+    """Declared SLOs (defaults + ``slos.json`` + ``add_slo``) with the
+    latest multi-window burn-rate evaluation folded in: per-window
+    value/coverage/breach and the overall ``burning`` verdict."""
+    return _list("slos", limit)
+
+
+def get_incident(incident_id: str) -> dict:
+    """One incident's full record, including its evidence rows and
+    transition history; raises ValueError on an unknown id."""
+    value = _client().request(
+        {"type": "get_incident", "incident_id": incident_id})["value"]
+    if isinstance(value, dict) and "__state_error__" in value:
+        raise ValueError(value["__state_error__"])
+    return value
+
+
+def ack_incident(incident_id: str) -> dict:
+    """Acknowledge an open incident (open → ack): it stops alerting on
+    refresh but still auto-resolves once clear.  Returns the updated
+    record; raises ValueError if the id is unknown or not open."""
+    value = _client().request(
+        {"type": "ack_incident", "incident_id": incident_id})["value"]
+    if isinstance(value, dict) and "__state_error__" in value:
+        raise ValueError(value["__state_error__"])
+    return value
+
+
+def doctor_report(trend_window_s: float = 1800.0) -> List[dict]:
+    """Doctor findings computed HEAD-SIDE over the head's own event /
+    task / TSDB tables — the ``ray_tpu doctor`` backend.  The client
+    receives only the findings, never the 100k-row tables they were
+    diagnosed from."""
+    value = _client().request(
+        {"type": "doctor_report",
+         "trend_window_s": trend_window_s})["value"]
+    if isinstance(value, dict) and "__state_error__" in value:
+        raise ValueError(value["__state_error__"])
+    return value
+
+
+def debug_dump(label: Optional[str] = None) -> str:
+    """One-shot whole-cluster post-mortem bundle written head-side under
+    ``<session>/incidents/`` (log tails, event excerpt, TSDB slices,
+    collapsed profile, memory audit); returns the bundle directory."""
+    value = _client().request(
+        {"type": "debug_dump", "label": label})["value"]
+    if isinstance(value, dict) and "__state_error__" in value:
+        raise ValueError(value["__state_error__"])
+    return value["path"]
+
+
 def perf_summary(window_s: float = 1800.0) -> dict:
     """Performance-observability aggregate (``ray_tpu perf`` backend):
     the step-phase breakdown (phases sum exactly to profiled step wall),

@@ -17,7 +17,11 @@ Reference: ``python/ray/scripts/scripts.py`` (cluster lifecycle) and
     timeline [--out FILE]                      chrome-trace of task events
     events [--source S --severity L --limit N] flight-recorder event table
     trace [TRACE_ID]                           span tree + critical path
-    doctor                                     pathology analysis (exit 1 on findings)
+    doctor [--live]                            pathology analysis (exit 1 on findings;
+                                               --live reads the watchdog's incident set)
+    incidents [--follow --history --ack ID]    watchdog incident lifecycle
+    slo                                        declared SLOs + burn-rate state
+    debug dump                                 write a whole-cluster post-mortem bundle
     top [--interval S --iterations N --sort K] live nodes/workers resource view
     memory [--limit N --json]                  object-ownership audit (`ray memory`)
     metrics [NAME] [--window S --step S]       TSDB directory / time-series query
@@ -384,13 +388,133 @@ def cmd_doctor(args) -> None:
     _connect()
     from ray_tpu.util.doctor import render, run_doctor
 
-    findings.extend(run_doctor())
+    if getattr(args, "live", False):
+        # report from the watchdog's CURRENT incident set instead of
+        # re-diagnosing — what the continuous loop already concluded
+        from ray_tpu.experimental.state import api as state
+
+        findings.extend({
+            "severity": inc["severity"], "rule": inc["rule"],
+            "summary": f"[{inc['state']}] {inc['summary']}",
+            "remedy": inc.get("remedy", ""),
+            "count": inc.get("count", 1),
+            "evidence": [{"incident_id": inc["id"],
+                          "bundle_dir": inc.get("bundle_dir")}],
+        } for inc in state.list_incidents()
+            if inc["state"] in ("open", "ack"))
+    else:
+        findings.extend(run_doctor())
     if args.json:
         print(json.dumps(findings, indent=2, default=repr))
     else:
         print(render(findings))
     if findings:
         sys.exit(1)
+
+
+def _render_incident_row(inc: dict) -> str:
+    age = time.time() - inc.get("opened_at", time.time())
+    flags = ""
+    if inc.get("escalated"):
+        flags += "!"
+    if inc.get("reopen_count"):
+        flags += f" x{inc['reopen_count'] + 1}"
+    return (f"{inc['state']:<9} {inc['severity']:<8} "
+            f"{int(age):>6}s {inc['id'][:48]:<50}{flags:<6} "
+            f"{inc['summary'][:90]}")
+
+
+def cmd_incidents(args) -> None:
+    """Watchdog incident lifecycle: the tracked set, one incident's
+    transition history, ack, or --follow transitions live."""
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    if args.ack:
+        inc = state.ack_incident(args.ack)
+        print(f"acked {inc['id']} ({inc['severity']}: "
+              f"{inc['summary'][:100]})")
+        return
+    if args.history:
+        inc = state.get_incident(args.history)
+        if args.json:
+            print(json.dumps(inc, indent=2, default=repr))
+            return
+        print(_render_incident_row(inc))
+        if inc.get("bundle_dir"):
+            print(f"  bundle: {inc['bundle_dir']}")
+        for h in inc.get("history", []):
+            ts = time.strftime("%H:%M:%S", time.localtime(h["ts"]))
+            print(f"  {ts} {h['transition']:<9} {h.get('summary', '')[:100]}")
+        return
+    seen: dict = {}
+
+    def _page():
+        rows = state.list_incidents(limit=args.limit)
+        rows.sort(key=lambda r: r.get("opened_at", 0.0))
+        return rows
+
+    rows = _page()
+    if args.json:
+        print(json.dumps(rows, indent=2, default=repr))
+        return
+    if not rows:
+        print("no incidents")
+    else:
+        print(f"{'STATE':<9} {'SEV':<8} {'AGE':>7} {'INCIDENT':<56} SUMMARY")
+        for inc in rows:
+            print(_render_incident_row(inc))
+            seen[inc["id"]] = (inc["state"], len(inc.get("history", [])))
+    if not args.follow:
+        return
+    try:
+        while True:
+            time.sleep(args.interval)
+            for inc in _page():
+                key = (inc["state"], len(inc.get("history", [])))
+                if seen.get(inc["id"]) != key:
+                    seen[inc["id"]] = key
+                    print(_render_incident_row(inc))
+    except KeyboardInterrupt:
+        pass
+
+
+def cmd_slo(args) -> None:
+    """Declared SLOs with their live multi-window burn-rate state."""
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    rows = state.list_slos()
+    if args.json:
+        print(json.dumps(rows, indent=2, default=repr))
+        return
+    print(f"{'SLO':<16} {'STATE':<8} {'OBJECTIVE':<44} "
+          f"{'FAST':>10} {'SLOW':>10}")
+    for s in rows:
+        obj = f"{s['metric']} {s.get('op', '<=')} {s['threshold']}"
+        if s.get("kind") == "ratio":
+            obj = f"{s['metric']} ratio <= {s['threshold']}"
+
+        def _w(w):
+            if not w or not w.get("evaluable"):
+                return "no-data"
+            return f"{w['value']}{'*' if w['breach'] else ''}"
+
+        state_s = "BURNING" if s.get("burning") else "ok"
+        print(f"{s['name']:<16} {state_s:<8} {obj:<44} "
+              f"{_w(s.get('fast')):>10} {_w(s.get('slow')):>10}")
+    if any(s.get("burning") for s in rows):
+        sys.exit(1)
+
+
+def cmd_debug(args) -> None:
+    """`debug dump`: one-shot whole-cluster post-mortem bundle."""
+    if args.what != "dump":
+        raise SystemExit(f"unknown debug subcommand {args.what!r}")
+    _connect()
+    from ray_tpu.experimental.state import api as state
+
+    print(state.debug_dump(label=args.label))
 
 
 def _fmt_bytes(n) -> str:
@@ -881,7 +1005,8 @@ def main(argv=None) -> None:
     s = sub.add_parser("list", help="state API tables")
     s.add_argument("what", choices=["actors", "tasks", "nodes", "objects",
                                     "workers", "placement_groups", "jobs",
-                                    "traces", "slices", "tenants", "logs"])
+                                    "traces", "slices", "tenants", "logs",
+                                    "incidents", "slos"])
     s.add_argument("--limit", type=int, default=100)
     s.set_defaults(fn=cmd_list)
 
@@ -954,6 +1079,9 @@ def main(argv=None) -> None:
         help="pathology analysis over recorded events/tasks "
              "(exit 1 on findings)")
     s.add_argument("--json", action="store_true")
+    s.add_argument("--live", action="store_true",
+                   help="report the watchdog's current open incidents "
+                        "instead of re-diagnosing from scratch")
     s.add_argument("--static", action="store_true",
                    help="also run the raylint static gate and fold its "
                         "new findings into the report/exit code")
@@ -962,6 +1090,38 @@ def main(argv=None) -> None:
                         "ray_tpu package's parent, or cwd if the "
                         "baseline lives there)")
     s.set_defaults(fn=cmd_doctor)
+
+    s = sub.add_parser(
+        "incidents",
+        help="watchdog incident lifecycle: tracked set, history, ack, "
+             "or follow transitions live")
+    s.add_argument("--follow", "-f", action="store_true",
+                   help="keep polling and print state transitions")
+    s.add_argument("--ack", default=None, metavar="ID",
+                   help="acknowledge one open incident")
+    s.add_argument("--history", default=None, metavar="ID",
+                   help="one incident's full transition history")
+    s.add_argument("--json", action="store_true")
+    s.add_argument("--limit", type=int, default=200)
+    s.add_argument("--interval", type=float, default=2.0,
+                   help="--follow poll period (s)")
+    s.set_defaults(fn=cmd_incidents)
+
+    s = sub.add_parser(
+        "slo",
+        help="declared SLOs + multi-window burn-rate state (exit 1 "
+             "when any objective is burning)")
+    s.add_argument("--json", action="store_true")
+    s.set_defaults(fn=cmd_slo)
+
+    s = sub.add_parser(
+        "debug",
+        help="debug dump: write a whole-cluster post-mortem bundle "
+             "under <session>/incidents/")
+    s.add_argument("what", choices=["dump"])
+    s.add_argument("--label", default=None,
+                   help="bundle directory name (default dump-<ts>)")
+    s.set_defaults(fn=cmd_debug)
 
     s = sub.add_parser(
         "lint",

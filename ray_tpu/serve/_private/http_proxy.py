@@ -102,6 +102,31 @@ def _build_response(status: int, body: bytes, ctype: str,
     return head if omit_body else head + body
 
 
+# SLO taps: trailing-window p99 gauge + status-class counter, fed by the
+# single request funnel (_execute) both ingress impls share.  Lazy
+# singletons like the router metrics.
+_SLO_METRICS = None
+P99_WINDOW_REQUESTS = 512
+P99_RECOMPUTE_EVERY = 16
+
+
+def _slo_metrics():
+    global _SLO_METRICS
+    if _SLO_METRICS is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        _SLO_METRICS = {
+            "p99": Gauge(
+                "ray_tpu_serve_http_p99_s",
+                "HTTP p99 latency over the trailing request window (s)"),
+            "requests": Counter(
+                "ray_tpu_serve_http_requests_total",
+                "HTTP requests by status class (2xx/4xx/5xx)",
+                tag_keys=("code_class",)),
+        }
+    return _SLO_METRICS
+
+
 class _Reply:
     """What ``_execute`` hands back to the transport layer."""
 
@@ -142,6 +167,11 @@ class HTTPProxyActor:
             "requests": 0, "ok": 0, "retries": 0, "shed": 0,
             "replica_deaths": 0, "deadline_504": 0, "errors": 0,
         }
+        # trailing latency window behind the p99 SLO gauge
+        from collections import deque
+
+        self._lat_window: deque = deque(maxlen=P99_WINDOW_REQUESTS)
+        self._lat_n = 0
         if async_ingress is None:
             async_ingress = async_ingress_enabled()
         self.mode = "asyncio" if async_ingress else "threaded"
@@ -231,6 +261,34 @@ class HTTPProxyActor:
     # -- request path ----------------------------------------------------
     def _execute(self, method: str, raw_path: str,
                  headers: Dict[str, str], body: bytes) -> _Reply:
+        """SLO tap around the request funnel — both ingress impls route
+        through here, so the trailing-window p99 gauge and the
+        status-class counter see every request exactly once (the series
+        the serve_p99 / serve_5xx SLOs burn on)."""
+        t0 = time.perf_counter()
+        reply = self._execute_inner(method, raw_path, headers, body)
+        self._observe_slo(time.perf_counter() - t0, reply.status)
+        return reply
+
+    def _observe_slo(self, latency_s: float, status: int) -> None:
+        code_class = f"{status // 100}xx"
+        m = _slo_metrics()
+        m["requests"].inc(tags={"code_class": code_class})
+        with self._stats_lock:
+            self._lat_window.append(latency_s)
+            self._lat_n += 1
+            snap = (tuple(self._lat_window)
+                    if self._lat_n % P99_RECOMPUTE_EVERY == 0 else None)
+        if snap:
+            # p99 over the trailing window, recomputed every few requests
+            # and sorted outside the lock (sorting 512 floats per request
+            # would be the expensive way)
+            lats = sorted(snap)
+            m["p99"].set(lats[min(len(lats) - 1,
+                                  int(0.99 * (len(lats) - 1)))])
+
+    def _execute_inner(self, method: str, raw_path: str,
+                       headers: Dict[str, str], body: bytes) -> _Reply:
         """Route + execute one request; never raises (transport layers
         only write bytes).  Runs on an executor thread (asyncio ingress)
         or the connection thread (threaded fallback)."""

@@ -21,6 +21,7 @@ is the thin live-cluster wrapper the CLI uses.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence
 
 # finding severities mirror event severities (ERROR > WARNING > INFO)
@@ -958,15 +959,142 @@ def diagnose(events: Sequence[dict],
     return findings
 
 
+class DoctorState:
+    """Incremental doctor evaluation — the watchdog-tick path.
+
+    Instead of re-pulling up to 100k event rows per evaluation, the state
+    holds a bounded trailing window of rows and ``feed()`` pulls only the
+    *delta* since the last look via cursors: the head ``EventTable``'s
+    ingest version and the process-local ring's seq.  ``diagnose()``
+    re-runs the rule set only when new rows arrived (dirty flag) — an
+    idle cluster's tick costs two cursor compares, not a diagnosis.
+
+    Shared by the watchdog tick and the head's ``doctor_report`` RPC so
+    the on-demand CLI and the continuous loop read one path."""
+
+    def __init__(self, window_rows: int = 20_000,
+                 event_window_s: Optional[float] = None):
+        from collections import deque
+
+        self._rows: "deque[dict]" = deque(maxlen=max(100, int(window_rows)))
+        self._table_cursor = 0
+        self._local_seq = 0
+        self._dirty = True
+        self._findings: List[dict] = []
+        # sliding TIME window: with it set, diagnose() only sees rows
+        # newer than now - event_window_s, so a finding whose evidence
+        # aged out goes clear and its incident can auto-resolve.  Without
+        # it (the one-shot RPC path) the full retained window is read.
+        self._event_window_s = event_window_s
+
+    def feed(self, table=None, local=None) -> bool:
+        """Pull event deltas from the head EventTable and/or a local
+        EventBuffer; returns True when anything new arrived."""
+        new = False
+        if table is not None:
+            rows, self._table_cursor = table.since(self._table_cursor)
+            if rows:
+                self._rows.extend(rows)
+                new = True
+        if local is not None:
+            rows = local.since(self._local_seq)
+            if rows:
+                self._local_seq = max(r.get("seq", 0) for r in rows)
+                self._rows.extend(rows)
+                new = True
+        if new:
+            self._dirty = True
+        return new
+
+    def feed_rows(self, rows: Sequence[dict]) -> None:
+        """Direct row injection (tests / custom gathers)."""
+        if rows:
+            self._rows.extend(rows)
+            self._dirty = True
+
+    def diagnose(self, tasks: Sequence[dict] = (),
+                 force: bool = False,
+                 now: Optional[float] = None) -> List[dict]:
+        """Event-rule findings over the current window; cached until the
+        next ``feed()`` delta (``force=True`` re-runs regardless, e.g.
+        when the task table changed without an event).  A time-windowed
+        state re-runs whenever it holds rows — the window's trailing edge
+        moves even when no new event arrives."""
+        if self._event_window_s:
+            if now is None:
+                now = time.time()
+            horizon = now - self._event_window_s
+            # drop aged-out rows for good: the deque is append-only in
+            # time, so popping from the left is exact
+            while self._rows and self._rows[0].get("ts", now) < horizon:
+                self._rows.popleft()
+                self._dirty = True
+            if self._dirty or force or self._findings:
+                # table + local rows interleave slightly out of ts order,
+                # so filter the survivors too (exact window, not just the
+                # deque's left edge)
+                rows = [r for r in self._rows
+                        if r.get("ts", now) >= horizon]
+                self._findings = diagnose(rows, tasks)
+                self._dirty = False
+        elif self._dirty or force:
+            # the window holds table + local rows in arrival order; the
+            # rules themselves sort nothing and tolerate interleaving
+            self._findings = diagnose(list(self._rows), tasks)
+            self._dirty = False
+        return list(self._findings)
+
+    @property
+    def dirty(self) -> bool:
+        return self._dirty
+
+    def window_len(self) -> int:
+        return len(self._rows)
+
+
+def head_report(events_table, local_buffer, tsdb,
+                tasks: Sequence[dict] = (),
+                state: Optional[DoctorState] = None,
+                trend_window_s: float = 1800.0) -> List[dict]:
+    """One full doctor pass over HEAD-LOCAL tables — zero state-API
+    pulls.  ``state`` carries the incremental window between calls (the
+    watchdog's persistent DoctorState); without one, an ephemeral state
+    reads the tables' full retained history (the ``doctor_report`` RPC's
+    cold path, still head-local)."""
+    st = state if state is not None else DoctorState()
+    st.feed(table=events_table, local=local_buffer)
+    findings = st.diagnose(tasks, force=state is None)
+    series_map: Dict[str, list] = {}
+    if tsdb is not None:
+        for name in TREND_METRICS:
+            try:
+                q = tsdb.query(name, window_s=trend_window_s)
+                series_map[name] = q.get("series", [])
+            except Exception:  # noqa: BLE001 — a metric with no samples
+                continue
+    findings = findings + diagnose_trends(series_map)
+    findings.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
+    return findings
+
+
 def run_doctor(limit: int = 100_000,
                trend_window_s: float = 1800.0) -> List[dict]:
-    """Pull the live cluster's event + task tables and diagnose them,
-    then run the trend rules over the head TSDB's recent history (the
-    pathologies only a time series can express)."""
+    """Diagnose the live cluster.  The head runs the full pass over its
+    own tables (one ``doctor_report`` RPC) — the client no longer issues
+    two 100k-row ``list_events``/``list_tasks`` pulls per invocation.
+    Falls back to the legacy client-side pull against a head without the
+    RPC."""
     import warnings
 
     from ray_tpu.experimental.state import api as state
 
+    try:
+        findings = state.doctor_report(trend_window_s=trend_window_s)
+        if isinstance(findings, list):
+            return findings
+    except Exception:  # noqa: BLE001 — old head / proxied client: fall
+        # back to pulling the tables over the state API
+        pass
     with warnings.catch_warnings():
         # the doctor reads capped tables knowingly; the truncation
         # warning is for listings presented as complete views
@@ -1006,7 +1134,8 @@ def render(findings: List[dict]) -> str:
                              "steps", "ingest_s", "wall_s", "ingest_frac",
                              "earlier_mfu", "trailing_mfu", "drop_frac",
                              "mean_frac", "wait_s", "hold_s",
-                             "serialize_frac", "window_points")}
+                             "serialize_frac", "window_points",
+                             "incident_id", "bundle_dir", "threshold")}
             out.append(f"  evidence: {desc}")
         if f["count"] > 3:
             out.append(f"  ... {f['count'] - 3} more evidence row(s)")

@@ -96,6 +96,11 @@ class LogStore:
         self._streams: Dict[str, _Stream] = {}
         self._total_bytes = 0
         self._seq = 0
+        # cumulative ship-pressure counters (never decremented): total
+        # records absorbed and suppression markers among them — the
+        # ray_tpu_log_records_total / _suppressed_total gauge sources
+        self._ingested_total = 0
+        self._suppressed_total = 0
         self._lock = threading.Lock()
 
     # -- ingest ---------------------------------------------------------
@@ -124,6 +129,9 @@ class LogStore:
                     st.meta.update(metas[name])
                     st.meta.setdefault("node", node)
                 self._seq += 1
+                self._ingested_total += 1
+                if rec[_SRC] == "m":  # suppression marker record
+                    self._suppressed_total += 1
                 line = rec[_LINE]
                 stored = (self._seq, rec[_TS], rec[_SRC], rec[_JOB],
                           rec[_TASK], rec[_ACTOR], rec[_TRACE], line)
@@ -287,6 +295,14 @@ class LogStore:
         with self._lock:
             st = self._streams.get(stream)
             return dict(st.meta) if st is not None else {}
+
+    def counters(self) -> Dict[str, int]:
+        """Cumulative ship-pressure counters: records absorbed since
+        boot and suppression markers among them (each marker stands for
+        a burst the source-side limiter dropped)."""
+        with self._lock:
+            return {"ingested_total": self._ingested_total,
+                    "suppressed_total": self._suppressed_total}
 
     def stats(self) -> List[dict]:
         """One row per stream — the state API's ``logs`` table."""
